@@ -1,0 +1,17 @@
+"""bdlz_tpu — TPU-native framework for baryon & dark-matter densities from
+bounce-sourced distributed Landau–Zener transport.
+
+A fresh JAX/XLA/pjit/pallas implementation of the SFV/dSB yields pipeline
+(reference analysed in SURVEY.md): the physics layer is backend-neutral
+(NumPy bit-reproduces the archived golden outputs; jax.numpy runs jitted on
+TPU), and around it sit the pieces the reference only gestures at — a
+batched KJMA quadrature, a real two-channel Landau–Zener kernel on batched
+matrix exponentials, a stiff ESDIRK Boltzmann integrator, a mesh-sharded
+parameter-sweep engine with checkpoint/resume, and a native ensemble
+sampler.
+
+Heavy imports (JAX) are deferred to the modules that need them.
+"""
+__version__ = "0.1.0"
+
+from bdlz_tpu.config import Config, default_config, load_config  # noqa: F401

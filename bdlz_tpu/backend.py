@@ -1,0 +1,55 @@
+"""Execution-backend selection (the `"backend"` config key).
+
+The physics layer (:mod:`bdlz_tpu.physics`) is written against an abstract
+array namespace ``xp`` so the same formulas run on
+
+* ``numpy`` — the bit-reproducible CPU reference path (golden outputs of
+  the archived run, reference `first_principles_yields.py` via run.txt), and
+* ``jax.numpy`` — the jitted / vmapped / mesh-sharded TPU path.
+
+JAX is imported lazily so that pure-NumPy usage never pays JAX start-up, and
+so tests can set ``XLA_FLAGS`` / ``JAX_PLATFORMS`` before first import.
+
+The TPU path runs in float64 (``jax_enable_x64``): the north-star accuracy
+contract is <=1e-6 relative error on Omega_b/Omega_DM versus the SciPy
+reference, and the quadrature reductions (8000-point y-grid x 1200-point
+z-grid trapezoids) need f64 accumulation to hold that with margin.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+_JAX_BACKENDS = ("jax", "tpu", "gpu", "cpu-jax")
+_NUMPY_BACKENDS = ("numpy", "reference", "cpu")
+
+VALID_BACKENDS = _NUMPY_BACKENDS + _JAX_BACKENDS
+
+
+def is_jax_backend(backend: str) -> bool:
+    b = str(backend).lower()
+    if b in _JAX_BACKENDS:
+        return True
+    if b in _NUMPY_BACKENDS:
+        return False
+    raise ValueError(
+        f"Unknown backend {backend!r}; expected one of {VALID_BACKENDS}"
+    )
+
+
+def get_namespace(backend: str) -> Any:
+    """Return the array namespace (``numpy`` or ``jax.numpy``) for a backend."""
+    if is_jax_backend(backend):
+        return jax_numpy()
+    import numpy
+
+    return numpy
+
+
+def jax_numpy() -> Any:
+    """Import and return ``jax.numpy`` with float64 enabled."""
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    import jax.numpy as jnp
+
+    return jnp

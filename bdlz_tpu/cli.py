@@ -1,0 +1,193 @@
+"""CLI / driver (framework layer L6).
+
+Flag surface, printed result block, diagnostics table and exit behavior
+match the reference `main()` (`first_principles_yields.py:346-441`) so that
+`run.txt` reproduces byte-for-byte on the NumPy backend; the only additions
+are the `--backend` override and the framework config keys, which default to
+reference behavior.
+"""
+from __future__ import annotations
+
+import argparse
+import math
+from typing import Optional
+
+import numpy as np
+
+from bdlz_tpu import backend as backend_mod
+from bdlz_tpu.config import (
+    Config,
+    load_config,
+    point_params_from_config,
+    static_choices_from_config,
+    validate,
+    write_template,
+)
+from bdlz_tpu.models.yields_pipeline import YieldsResult, point_yields, present_day
+from bdlz_tpu.physics.percolation import area_over_volume, make_kjma_grid, y_of_T
+from bdlz_tpu.physics.source import source_window
+from bdlz_tpu.physics.thermo import entropy_density, n_chi_equilibrium, wall_flux
+from bdlz_tpu.solvers.boltzmann import solve_scipy_radau
+from bdlz_tpu.utils.io import write_yields_out
+
+
+def resolve_P(cfg: Config, profile_csv: Optional[str]) -> float:
+    """LZ-probability resolution order (reference `maybe_P`, :317-328).
+
+    Profile CSV (through the framework's two-channel LZ kernel — the seam
+    the reference only stubs via dynamic imports, :170-187) takes precedence
+    over the config value; both absent is a hard error. Prints are part of
+    the CLI contract.
+    """
+    P_used = cfg.P_chi_to_B
+    if profile_csv:
+        P_try, reason = None, None
+        try:
+            from bdlz_tpu.lz import probability_from_profile
+
+            P_try = float(probability_from_profile(profile_csv, cfg.v_w))
+            P_try = max(min(P_try, 1.0), 0.0)
+        except Exception as exc:  # fall back to config, like the reference
+            P_try, reason = None, f"{type(exc).__name__}: {exc}"
+        if P_try is not None:
+            print(f"[info] Using P_chi_to_B from profile: {P_try:.6g}")
+            P_used = P_try
+        else:
+            print("[warn] Could not compute P from profile automatically; falling back to config.")
+            if reason:
+                print(f"[info] profile P computation failed with: {reason}")
+    if P_used is None:
+        raise RuntimeError("P_chi_to_B is not set and could not be computed from profile.")
+    return float(P_used)
+
+
+def can_use_quadrature(cfg: Config) -> bool:
+    """Fast-path guard (reference :372)."""
+    return (
+        not cfg.deplete_DM_from_source
+        and cfg.sigma_v_chi_GeV_m2 == 0.0
+        and cfg.Gamma_wash_over_H == 0.0
+    )
+
+
+def run_point(cfg: Config, P_used: float, backend: str) -> YieldsResult:
+    """Evaluate one parameter point on the selected backend."""
+    xp = backend_mod.get_namespace(backend)
+    pp = point_params_from_config(cfg, P_used)
+    static = static_choices_from_config(cfg)
+    grid = make_kjma_grid(xp)
+
+    if can_use_quadrature(cfg):
+        if backend_mod.is_jax_backend(backend):
+            import jax
+
+            fn = jax.jit(point_yields, static_argnums=(1, 3))
+            return jax.device_get(fn(pp, static, grid, xp))
+        return point_yields(pp, static, grid, xp)
+
+    # General (stiff ODE) path.
+    T_hi = cfg.T_max_over_Tp * cfg.T_p_GeV
+    T_lo = cfg.T_min_over_Tp * cfg.T_p_GeV
+    if cfg.regime.lower().startswith("therm"):
+        Ychi0 = float(
+            n_chi_equilibrium(T_hi, cfg.m_chi_GeV, cfg.g_chi, cfg.chi_stats, np)
+            / entropy_density(T_hi, cfg.g_star_s, np)
+        )
+    else:
+        Ychi0 = pp.Y_chi_init
+
+    if backend_mod.is_jax_backend(backend):
+        from bdlz_tpu.solvers.sdirk import solve_boltzmann_esdirk
+
+        grid_np = make_kjma_grid(np)
+        sol = solve_boltzmann_esdirk(
+            pp, static_choices_from_config(cfg), grid_np, (Ychi0, 0.0), T_lo, T_hi
+        )
+        if not bool(sol.success):
+            # warn-but-continue, like the reference ODE path (:408-409)
+            print(
+                "[warn] ODE solver reported failure: ESDIRK did not converge "
+                f"in {int(sol.n_steps)} steps"
+            )
+        return present_day(
+            float(sol.y[1]), float(sol.y[0]), pp.m_chi_GeV, pp.m_B_kg, np
+        )
+
+    sol = solve_scipy_radau(
+        pp, cfg.chi_stats, cfg.deplete_DM_from_source, grid, (Ychi0, 0.0), T_lo, T_hi,
+        reference_step_cap=cfg.ode_reference_step_cap,
+    )
+    if not sol.success:
+        print("[warn] ODE solver reported failure:", sol.message)
+    return present_day(sol.Y_B, sol.Y_chi, pp.m_chi_GeV, pp.m_B_kg, np)
+
+
+def print_results(result: YieldsResult) -> None:
+    """The printed result block — byte-contract (reference :419-422)."""
+    print("\n=== Results (today) ===")
+    print(f"rho_B^0   = {float(result.rho_B_kg_m3):.3e} kg/m^3")
+    print(f"rho_DM^0  = {float(result.rho_DM_kg_m3):.3e} kg/m^3")
+    print(f"DM/B ratio= {float(result.DM_over_B):.6g}")
+
+
+def print_diagnostics(cfg: Config, P_used: float) -> None:
+    """21-row geomspace table around T_p — byte-contract (reference :430-438).
+
+    Always evaluated with NumPy: it is 21 scalar samples, and byte parity of
+    the printed digits matters more than the backend here.
+    """
+    pp = point_params_from_config(cfg, P_used)
+    grid = make_kjma_grid(np)
+    print("\n# Diagnostics around percolation")
+    Ts = np.geomspace(cfg.T_p_GeV * 0.5, cfg.T_p_GeV * 2.0, 21)
+    print(" T/Tp      y(T)        A/V [GeV]         J_chi [GeV^3]      S_B [GeV^3]")
+    for T in Ts:
+        y = y_of_T(T, pp.T_p_GeV, pp.beta_over_H, np)
+        aov = float(
+            area_over_volume(
+                y, pp.I_p, pp.beta_over_H, pp.T_p_GeV, pp.v_w, pp.g_star, grid, np
+            )
+        )
+        J = pp.flux_scale * wall_flux(T, pp.m_chi_GeV, pp.g_chi, cfg.chi_stats, np)
+        SB = pp.P * J * aov * float(source_window(y, pp.sigma_y, np))
+        print(f"{T/cfg.T_p_GeV:7.3f}  {y:9.3f}  {aov:14.6e}  {J:16.6e}  {SB:14.6e}")
+
+
+def main(argv: Optional[list] = None) -> None:
+    ap = argparse.ArgumentParser(
+        description="First-principles DM/Baryon yields from bounce-sourced transport"
+    )
+    ap.add_argument("--config", required=False, help="Path to yields_config.json")
+    ap.add_argument("--write-template", action="store_true",
+                    help="Write a template config and exit")
+    ap.add_argument("--maybe-compute-P-from-profile", dest="profile_csv", default=None,
+                    help="Try to compute P_chi_to_B from the LZ kernel using this profile CSV.")
+    ap.add_argument("--diagnostics", action="store_true",
+                    help="Print a small table of y(T), A/V(T), J_chi(T), S_B(T) around T_p.")
+    ap.add_argument("--backend", default=None,
+                    help="Override the config 'backend' key (numpy | tpu).")
+    args = ap.parse_args(argv)
+
+    if args.write_template:
+        write_template(args.config or "yields_config.json")
+        return
+    if not args.config:
+        print("ERROR: --config is required (or use --write-template).")
+        return
+
+    cfg = validate(load_config(args.config))
+    P_used = resolve_P(cfg, args.profile_csv)
+    backend = args.backend or cfg.backend
+
+    result = run_point(cfg, P_used, backend)
+
+    print_results(result)
+    write_yields_out("yields_out.json", cfg, P_used, result)
+    print("Wrote yields_out.json")
+
+    if args.diagnostics:
+        print_diagnostics(cfg, P_used)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,238 @@
+"""Config system (framework layer L5).
+
+The JSON schema is the reference's 20-key `yields_config_*.json` contract
+(`first_principles_yields.py:44-79`, :291-312): a JSON file is merged *over*
+the defaults and instantiated into a frozen dataclass, so an unknown key is
+a hard error (the reference's implicit strict schema via
+``Config(**merged)`` TypeError, :307 — here made explicit with a clearer
+message). New framework-only keys are appended with defaults that leave the
+reference path byte-identical when absent:
+
+* ``backend``  — "numpy" (bit-reproducible CPU reference) or "tpu"/"jax";
+* ``m_B_GeV``  — baryon mass for the present-day conversion (None keeps the
+  reference's hard-coded proton mass, :415), enabling the unequal-mass
+  (m_DM/m_B ratio) scans of the north star;
+* ``n_y``      — quadrature y-grid resolution (reference `main` hard-codes
+  8000 at :374).
+
+Known reference quirk, resolved here: ``regime: "auto"`` is documented in
+the reference (:50) but crashes its quadrature path with
+``UnboundLocalError`` (:376-384 has no else). This framework
+validates-and-errors on both backends instead; see ``validate()``.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+from dataclasses import dataclass
+from typing import Any, Dict, NamedTuple, Optional
+
+from bdlz_tpu.constants import GEV_TO_KG, M_PROTON_KG
+
+#: Keys understood by the reference pipeline, in its declaration order.
+REFERENCE_KEYS = (
+    "m_chi_GeV", "g_chi", "chi_stats", "regime", "sigma_v_chi_GeV_m2",
+    "T_p_GeV", "beta_over_H", "v_w", "I_p", "g_star", "g_star_s",
+    "P_chi_to_B", "source_shape_sigma_y", "Gamma_wash_over_H",
+    "incident_flux_scale", "deplete_DM_from_source",
+    "T_max_over_Tp", "T_min_over_Tp", "Y_chi_init", "n_chi_at_Tp_GeV3",
+)
+
+VALID_REGIMES = ("thermal", "nonthermal")
+VALID_STATS = ("fermion", "boson")
+
+
+class ConfigError(ValueError):
+    """Raised for unknown keys or invalid field values."""
+
+
+@dataclass(frozen=True)
+class Config:
+    """One parameter point of the yields pipeline.
+
+    Field names/defaults mirror the reference `Config` dataclass
+    (`first_principles_yields.py:44-79`); trailing fields are
+    framework-only extensions.
+    """
+
+    # Microphysics / DM
+    m_chi_GeV: float = 0.95
+    g_chi: int = 2
+    chi_stats: str = "fermion"
+    regime: str = "nonthermal"
+    sigma_v_chi_GeV_m2: float = 0.0
+
+    # Transition / percolation inputs
+    T_p_GeV: float = 100.0
+    beta_over_H: float = 100.0
+    v_w: float = 0.30
+    I_p: float = 0.34
+
+    # Effective relativistic dof (assumed constant over the window)
+    g_star: float = 106.75
+    g_star_s: float = 106.75
+
+    # Source normalisation / shape
+    P_chi_to_B: Optional[float] = None
+    source_shape_sigma_y: float = 15.0
+    Gamma_wash_over_H: float = 0.0
+
+    # Incident flux scaling and optional DM depletion
+    incident_flux_scale: float = 1.0
+    deplete_DM_from_source: bool = False
+
+    # Integration window
+    T_max_over_Tp: float = 5.0
+    T_min_over_Tp: float = 1e-3
+
+    # Nonthermal initial condition
+    Y_chi_init: Optional[float] = 4.90e-10
+    n_chi_at_Tp_GeV3: Optional[float] = None
+
+    # ---- framework extensions (absent => reference behavior) ----
+    backend: str = "numpy"
+    m_B_GeV: Optional[float] = None
+    n_y: int = 8000
+    # The reference caps Radau steps so hard its ODE path takes >=1e6 steps
+    # at defaults (documented hang, SURVEY §2.1). True keeps that behavior
+    # for parity; False lets SciPy pick adaptive steps.
+    ode_reference_step_cap: bool = True
+
+
+def default_config() -> Dict[str, Any]:
+    """Defaults as a plain dict (the template payload), reference :291-301."""
+    return {f.name: f.default for f in dataclasses.fields(Config)}
+
+
+def config_from_dict(raw: Dict[str, Any]) -> Config:
+    """Merge ``raw`` over the defaults; unknown keys are a hard error."""
+    base = default_config()
+    unknown = sorted(set(raw) - set(base))
+    if unknown:
+        raise ConfigError(
+            f"Unknown config key(s) {unknown}; valid keys are {sorted(base)}"
+        )
+    base.update(raw)
+    return Config(**base)
+
+
+def load_config(path: str) -> Config:
+    """Load a yields_config JSON file (reference :303-307 semantics)."""
+    with open(path, "r", encoding="utf-8") as f:
+        raw = json.load(f)
+    return config_from_dict(raw)
+
+
+def write_template(path: str) -> None:
+    """Write the default config as a JSON template (reference :309-312)."""
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(default_config(), f, indent=2)
+    print(f"Wrote template config to {path}")
+
+
+def validate(cfg: Config) -> Config:
+    """Check field values that the reference either trusts or crashes on.
+
+    In particular ``regime`` must be "thermal" or "nonthermal" (by the
+    reference's prefix convention): the reference documents "auto" (:50) but
+    its quadrature path dies with ``UnboundLocalError`` (:376-384). This
+    framework rejects it up-front on every backend.
+    """
+    r = cfg.regime.lower()
+    if not (r.startswith("therm") or r.startswith("non")):
+        raise ConfigError(
+            f"regime={cfg.regime!r} is not supported: use 'thermal' or "
+            "'nonthermal'. (The reference pipeline documents 'auto' but "
+            "crashes on it; this framework rejects it explicitly.)"
+        )
+    # chi_stats follows the reference convention deliberately: any string
+    # not starting with "ferm" is treated as a boson (reference :96).
+    if cfg.n_y < 2:
+        raise ConfigError("n_y must be >= 2")
+    return cfg
+
+
+class PointParams(NamedTuple):
+    """Dynamic (sweepable, traceable) per-point parameters.
+
+    Everything in here may be a scalar or a batched array under ``vmap``;
+    categorical/structural choices (``chi_stats``, ``regime``, grid sizes)
+    stay static and live outside, in :class:`StaticChoices`.
+    """
+
+    m_chi_GeV: Any
+    g_chi: Any
+    T_p_GeV: Any
+    beta_over_H: Any
+    v_w: Any
+    I_p: Any
+    g_star: Any
+    g_star_s: Any
+    P: Any
+    sigma_y: Any
+    flux_scale: Any
+    Y_chi_init: Any
+    m_B_kg: Any
+    T_max_over_Tp: Any
+    T_min_over_Tp: Any
+    sigma_v: Any
+    Gamma_wash_over_H: Any
+
+
+class StaticChoices(NamedTuple):
+    """Trace-static structural choices of a run."""
+
+    chi_stats: str = "fermion"
+    regime: str = "nonthermal"
+    deplete_DM_from_source: bool = False
+    n_y: int = 8000
+
+
+def resolve_Y_chi_init(cfg: Config) -> float:
+    """Nonthermal initial-yield policy (reference :378-384 / :392-398).
+
+    Y_chi_init if set, else n_chi(T_p)/s(T_p), else 1e-12. For the thermal
+    regime the value is unused (the pipeline computes n_eq(T_hi)/s(T_hi)).
+    """
+    if cfg.Y_chi_init is not None:
+        return float(cfg.Y_chi_init)
+    if cfg.n_chi_at_Tp_GeV3 is not None:
+        from bdlz_tpu.physics.thermo import entropy_density
+        import numpy as np
+
+        s_p = entropy_density(cfg.T_p_GeV, cfg.g_star_s, np)
+        return float(cfg.n_chi_at_Tp_GeV3) / max(s_p, 1e-300)
+    return 1.0e-12
+
+
+def point_params_from_config(cfg: Config, P: float) -> PointParams:
+    """Bind a Config + resolved LZ probability into the dynamic parameter tuple."""
+    m_B_kg = M_PROTON_KG if cfg.m_B_GeV is None else float(cfg.m_B_GeV) * GEV_TO_KG
+    return PointParams(
+        m_chi_GeV=float(cfg.m_chi_GeV),
+        g_chi=float(cfg.g_chi),
+        T_p_GeV=float(cfg.T_p_GeV),
+        beta_over_H=float(cfg.beta_over_H),
+        v_w=float(cfg.v_w),
+        I_p=float(cfg.I_p),
+        g_star=float(cfg.g_star),
+        g_star_s=float(cfg.g_star_s),
+        P=float(P),
+        sigma_y=float(cfg.source_shape_sigma_y),
+        flux_scale=float(cfg.incident_flux_scale),
+        Y_chi_init=resolve_Y_chi_init(cfg),
+        m_B_kg=m_B_kg,
+        T_max_over_Tp=float(cfg.T_max_over_Tp),
+        T_min_over_Tp=float(cfg.T_min_over_Tp),
+        sigma_v=float(cfg.sigma_v_chi_GeV_m2),
+        Gamma_wash_over_H=float(cfg.Gamma_wash_over_H),
+    )
+
+
+def static_choices_from_config(cfg: Config) -> StaticChoices:
+    return StaticChoices(
+        chi_stats=cfg.chi_stats,
+        regime=cfg.regime,
+        deplete_DM_from_source=bool(cfg.deplete_DM_from_source),
+        n_y=int(cfg.n_y),
+    )

@@ -1,0 +1,30 @@
+"""Physical constants and unit conversions (framework layer L0).
+
+Numerical values match the reference pipeline exactly
+(/root/reference/first_principles_yields.py:33-39) so that the NumPy
+execution path reproduces the archived golden outputs bit-for-bit.
+"""
+from __future__ import annotations
+
+import math
+
+#: Riemann zeta(3), used in relativistic equilibrium number densities.
+ZETA3: float = 1.202056903159594
+
+PI: float = math.pi
+
+#: Planck mass in GeV entering H = 1.66 sqrt(g*) T^2 / M_Pl.
+MPL_GEV: float = 1.220890e19
+
+#: Present-day entropy density, cm^-3 and m^-3.
+S0_CM3: float = 2891.0
+S0_M3: float = S0_CM3 * 1e6
+
+#: GeV -> kg mass conversion.
+GEV_TO_KG: float = 1.78266192e-27
+
+#: Proton mass in kg (CODATA).
+M_PROTON_KG: float = 1.67262192369e-27
+
+#: Planck 2018 target for Omega_DM / Omega_b (reference PDF section 7, Eq. 22).
+PLANCK_DM_OVER_B: float = 5.357

@@ -1,0 +1,26 @@
+"""Two-channel Landau–Zener transport kernel.
+
+Fills the seam the reference leaves dormant: its `try_compute_P_from_profile`
+(`first_principles_yields.py:170-187`) dynamically imports LZ modules that
+are absent from the snapshot, so the archived run takes P_chi_to_B from the
+config. Here the kernel is first-class: bounce-profile ingestion, crossing
+finding, and distributed multi-crossing propagation via batched 2x2 matrix
+exponentials (arXiv:1004.2914 pattern), reducing to P = 1 - exp(-2*pi*lambda)
+in the single-crossing limit (reference PDF Eqs. 8-9).
+
+Seam contract (reference `maybe_P`, :317-328): (profile, v_w) -> P in [0, 1].
+"""
+from bdlz_tpu.lz.kernel import (  # noqa: F401
+    lambda_eff_from_profile,
+    local_lambdas,
+    probability_from_lambda,
+    probability_from_profile,
+    transfer_matrix_propagation,
+)
+from bdlz_tpu.lz.profile import (  # noqa: F401
+    BounceProfile,
+    Crossings,
+    ProfileError,
+    find_crossings,
+    load_profile_csv,
+)

@@ -1,0 +1,92 @@
+"""Bounce-profile ingestion for the Landau–Zener kernel.
+
+The reference's dormant seam (`first_principles_yields.py:170-187`) passes a
+"profile CSV" to an absent module; the paper (§3, §6.1) defines the physics
+that CSV must carry: along the wall coordinate ξ, the diabatic mass
+splitting Δ(ξ) between the χ and B channels and their mixing m_mix(ξ).
+
+Accepted column schemas (header row required, names case-insensitive):
+
+* ``xi, delta, m_mix``            — the splitting and mixing directly;
+* ``xi, m11, m22, m12``           — mass-matrix entries, from which
+  Δ = m11 − m22 and m_mix = m12.
+
+All quantities in GeV (ξ in GeV⁻¹). Parsing happens host-side with NumPy —
+profile IO is not on the hot path; the propagation kernel is.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import numpy as np
+
+
+class BounceProfile(NamedTuple):
+    """Sampled two-channel profile along the wall coordinate."""
+
+    xi: np.ndarray      # wall coordinate, strictly increasing [GeV^-1]
+    delta: np.ndarray   # diabatic splitting Δ(ξ) = m_χχ − m_BB [GeV]
+    mix: np.ndarray     # off-diagonal mixing m_mix(ξ) [GeV]
+
+
+class ProfileError(ValueError):
+    """Raised for malformed profile files."""
+
+
+def load_profile_csv(path: str) -> BounceProfile:
+    data = np.genfromtxt(path, delimiter=",", names=True, dtype=float)
+    if data.dtype.names is None:
+        raise ProfileError(f"{path}: expected a CSV header row")
+    names = {n.lower(): n for n in data.dtype.names}
+
+    def col(key: str) -> np.ndarray:
+        return np.atleast_1d(np.asarray(data[names[key]], dtype=float))
+
+    if "xi" not in names:
+        raise ProfileError(f"{path}: missing required column 'xi' (has {list(names)})")
+    xi = col("xi")
+    if xi.size < 2:
+        raise ProfileError(f"{path}: need at least 2 profile samples, got {xi.size}")
+    if not np.all(np.diff(xi) > 0):
+        order = np.argsort(xi)
+        xi = xi[order]
+    else:
+        order = slice(None)
+
+    if "delta" in names and "m_mix" in names:
+        delta, mix = col("delta")[order], col("m_mix")[order]
+    elif all(k in names for k in ("m11", "m22", "m12")):
+        delta = (col("m11") - col("m22"))[order]
+        mix = col("m12")[order]
+    else:
+        raise ProfileError(
+            f"{path}: columns must be (xi, delta, m_mix) or (xi, m11, m22, m12); "
+            f"got {list(names)}"
+        )
+    if not (np.all(np.isfinite(delta)) and np.all(np.isfinite(mix))):
+        raise ProfileError(f"{path}: non-finite profile values")
+    return BounceProfile(xi=xi, delta=delta, mix=mix)
+
+
+class Crossings(NamedTuple):
+    """Level crossings Δ(ξ*) = 0 located in a profile (host-side arrays)."""
+
+    xi_star: np.ndarray   # crossing positions
+    slope: np.ndarray     # dΔ/dξ at each crossing
+    mix: np.ndarray       # m_mix interpolated at each crossing
+
+
+def find_crossings(profile: BounceProfile) -> Crossings:
+    """Locate sign changes of Δ(ξ) by linear interpolation between samples."""
+    d, xi, mix = profile.delta, profile.xi, profile.mix
+    sign_change = np.flatnonzero(d[:-1] * d[1:] < 0.0)
+    exact_zero = np.flatnonzero((d[:-1] == 0.0) & (d[1:] != 0.0))
+    idx = np.unique(np.concatenate([sign_change, exact_zero]))
+
+    dxi = xi[idx + 1] - xi[idx]
+    dd = d[idx + 1] - d[idx]
+    frac = np.where(dd != 0.0, -d[idx] / np.where(dd == 0.0, 1.0, dd), 0.0)
+    xi_star = xi[idx] + frac * dxi
+    slope = np.where(dxi != 0.0, dd / np.where(dxi == 0.0, 1.0, dxi), 0.0)
+    mix_star = mix[idx] + frac * (mix[idx + 1] - mix[idx])
+    return Crossings(xi_star=xi_star, slope=slope, mix=mix_star)

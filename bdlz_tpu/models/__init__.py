@@ -1,0 +1,5 @@
+"""Model layer: the end-to-end yields pipeline (the framework's flagship
+"model" — one parameter point in, present-day observables out)."""
+from bdlz_tpu.models.yields_pipeline import YieldsResult, point_yields
+
+__all__ = ["YieldsResult", "point_yields"]

@@ -1,0 +1,35 @@
+"""Backend-neutral physics kernels (layers L1-L3 of the framework).
+
+Every function is pure, vectorized, and written against an array namespace
+``xp`` (``numpy`` or ``jax.numpy``) so the identical formula serves both the
+bit-reproducible CPU reference path and the jitted TPU path.
+"""
+from bdlz_tpu.physics.thermo import (
+    hubble_rate,
+    entropy_density,
+    n_chi_equilibrium,
+    mean_speed_chi,
+    wall_flux,
+)
+from bdlz_tpu.physics.percolation import (
+    y_of_T,
+    T_of_y,
+    KJMAGrid,
+    make_kjma_grid,
+    area_over_volume,
+)
+from bdlz_tpu.physics.source import source_window
+
+__all__ = [
+    "hubble_rate",
+    "entropy_density",
+    "n_chi_equilibrium",
+    "mean_speed_chi",
+    "wall_flux",
+    "y_of_T",
+    "T_of_y",
+    "KJMAGrid",
+    "make_kjma_grid",
+    "area_over_volume",
+    "source_window",
+]

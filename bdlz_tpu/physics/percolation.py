@@ -1,0 +1,110 @@
+"""Percolation-time maps and the KJMA area-to-volume kernel (layer L2).
+
+The KJMA kernel is *the* hot spot of the reference pipeline: there it is a
+scalar-in/scalar-out method called 8000 times per parameter point through a
+Python list comprehension (`first_principles_yields.py:158-165` and :261,
+measured 21.7 µs/call ≈ 75% of a point's runtime). Here it is a pure,
+batched function: all y-values at once against a fixed z-grid, one
+(n_y × n_z) elementwise tensor and one trapezoid reduction — XLA fuses the
+whole thing into a single pass suitable for the TPU VPU, and `vmap` extends
+it across parameter sweeps with no Python in the loop.
+
+Scalar semantics (floors, clamps, cut-offs) match the reference exactly:
+
+* ``y_of_T`` floors T at 1e-30 (reference :128);
+* ``T_of_y`` returns T_p·1e6 when the inverse-map denominator ≤ 1e-12
+  (reference :133-134);
+* A/V is hard-zeroed for y > 50, e^y is clamped to y ∈ [−50, 50], and the
+  wall velocity is floored at 1e-12 (reference :146, :159-161).
+"""
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+from bdlz_tpu.physics.thermo import hubble_rate
+
+Array = Any
+
+#: Default z-grid extent and resolution (reference `AoverVKernel.__init__`,
+#: `first_principles_yields.py:142`).
+Z_MAX_DEFAULT: float = 30.0
+NZ_DEFAULT: int = 1200
+
+
+def y_of_T(T: Array, T_p: Array, beta_over_H: Array, xp) -> Array:
+    """Percolation time variable y(T) = ½ (β/H)_p [(T_p/T)² − 1].
+
+    Closed form for radiation domination with constant g* (paper Eq. 10);
+    reference `first_principles_yields.py:126-128`.
+    """
+    return 0.5 * beta_over_H * ((T_p / xp.maximum(T, 1e-30)) ** 2 - 1.0)
+
+
+def T_of_y(y: Array, T_p: Array, beta_over_H: Array, xp) -> Array:
+    """Inverse map T(y) = T_p / √(1 + 2y/B); T_p·1e6 outside the sensible range.
+
+    Reference `first_principles_yields.py:130-135` (dead code there). The
+    quadrature solver inlines its own copy of this map because it needs the
+    reference's *other* guard variant (floor the denominator at 1e-12,
+    :252-254) for bit parity; this function keeps the documented
+    out-of-range → T_p·1e6 contract for library users.
+    """
+    denom = 1.0 + 2.0 * y / xp.maximum(beta_over_H, 1e-30)
+    safe = xp.maximum(denom, 1e-12)
+    return xp.where(denom <= 1e-12, T_p * 1e6, T_p / xp.sqrt(safe))
+
+
+class KJMAGrid(NamedTuple):
+    """Precomputed z-quadrature data for the KJMA integral.
+
+    ``z``       — the quadrature nodes, linspace(0, z_max, nz);
+    ``weight``  — z² e^{−z}, the y-independent part of the integrand;
+    ``gamma4``  — γ₄(z) = 6 − e^{−z}(z³ + 3z² + 6z + 6), the incomplete-Γ
+                  factor of the KJMA extended-volume integral (paper Eq. 12).
+    """
+
+    z: Array
+    weight: Array
+    gamma4: Array
+
+
+def make_kjma_grid(xp, z_max: float = Z_MAX_DEFAULT, nz: int = NZ_DEFAULT) -> KJMAGrid:
+    """Build the fixed z-grid (reference `first_principles_yields.py:154-156`)."""
+    z = xp.linspace(0.0, z_max, nz)
+    ez = xp.exp(-z)
+    gamma4 = 6.0 - ez * (z**3 + 3.0 * z**2 + 6.0 * z + 6.0)
+    return KJMAGrid(z=z, weight=z**2 * ez, gamma4=gamma4)
+
+
+def area_over_volume(
+    y: Array,
+    I_p: Array,
+    beta_over_H: Array,
+    T_p: Array,
+    v_w: Array,
+    g_star: Array,
+    grid: KJMAGrid,
+    xp,
+) -> Array:
+    """KJMA bubble-wall area per unit volume [A/V](y)  [GeV], batched over y.
+
+    [A/V](y) = (I_p/2)(β/v_w) e^y ∫₀^∞ dz z² e^{−z} exp(−(I_p/6) e^y γ₄(z)),
+    paper Eqs. 11-12; scalar semantics of reference
+    `first_principles_yields.py:158-165`. ``y`` may have any shape; the
+    z-axis is appended for the reduction and contracted by the trapezoid.
+    """
+    H_p = hubble_rate(T_p, g_star, xp)
+    beta = beta_over_H * H_p
+    v_w_safe = xp.maximum(v_w, 1e-12)
+
+    y_arr = xp.asarray(y)
+    expy = xp.exp(xp.clip(y_arr, -50.0, 50.0))
+    prefactor = (I_p / 2.0) * (beta / v_w_safe) * expy
+
+    # (..., n_z) tensor: broadcast e^y against the fixed z-grid. This is the
+    # batched replacement for the reference's per-scalar 1200-point loop.
+    exponent = -(I_p / 6.0) * expy[..., None] * grid.gamma4
+    integrand = grid.weight * xp.exp(exponent)
+    F = xp.trapezoid(integrand, grid.z, axis=-1)
+
+    return xp.where(y_arr > 50.0, 0.0, prefactor * F)

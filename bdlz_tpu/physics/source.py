@@ -1,0 +1,25 @@
+"""Baryon source-term model (framework layer L3).
+
+S_B(T) = P_{χ→B} · N_flux · J_χ(T) · [A/V](y(T)) · W(y), paper Eqs. 13-15;
+reference `first_principles_yields.py:225-228`.
+
+Only the Gaussian window lives here as a named function. The S_B *product*
+is deliberately assembled inline at each consumer (quadrature integrand,
+Boltzmann RHS, diagnostics table) rather than through a shared helper: the
+reference inlines it at each site with *different* floating-point
+association orders (:260-264 vs :277 vs :437), and the NumPy backend's
+bit-reproducibility contract requires matching each site's order exactly.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+Array = Any
+
+
+def source_window(y: Array, sigma_y: Array, xp) -> Array:
+    """Gaussian envelope W(y) = exp(−y²/2σ_y²) with σ_y floored at 1e-6.
+
+    Reference `first_principles_yields.py:227` / :262.
+    """
+    return xp.exp(-0.5 * (y / xp.maximum(sigma_y, 1e-6)) ** 2)

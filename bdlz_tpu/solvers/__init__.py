@@ -1,0 +1,5 @@
+"""Yield solvers (framework layer L4): direct quadrature and the stiff
+Boltzmann ODE path."""
+from bdlz_tpu.solvers.quadrature import integrate_YB_quadrature
+
+__all__ = ["integrate_YB_quadrature"]

@@ -1,0 +1,157 @@
+"""Bounce-epoch Boltzmann system (the general ODE path, layer L4).
+
+State Y = [Y_χ, Y_B] evolved in x = m_χ/T:
+
+    dY_χ/dx = (−⟨σv⟩ s (Y_χ² − Y_χ,eq²) − [deplete]·S_B/s) / (H x)
+    dY_B/dx = (S_B/s − Γ_wash H Y_B) / (H x)
+
+Scalar semantics of reference `first_principles_yields.py:270-286`
+(floors H, s at 1e-300; x at 1e-30; σv and Γ_wash at 0).
+
+Two execution paths:
+
+* :func:`solve_scipy_radau` — the reference-parity CPU path: an 800-point
+  A/V(T) cubic-spline table with clamped queries (reference :208-219) and
+  SciPy Radau with the reference's step cap (:405-407). Kept for golden
+  parity; note the reference's cap makes default-tolerance runs take ≥1e6
+  steps (documented hang, SURVEY §2.1) — pass ``reference_step_cap=False``
+  for a usable adaptive run.
+* the JAX path in :mod:`bdlz_tpu.solvers.sdirk` — an embedded stiff ESDIRK
+  integrator under ``lax.while_loop`` used by the TPU backend (fast, and
+  the one the sweep engine vmaps).
+"""
+from __future__ import annotations
+
+import warnings
+from typing import Any, Callable, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+from bdlz_tpu.config import PointParams
+from bdlz_tpu.physics.percolation import KJMAGrid, area_over_volume, y_of_T
+from bdlz_tpu.physics.source import source_window
+from bdlz_tpu.physics.thermo import (
+    entropy_density,
+    hubble_rate,
+    n_chi_equilibrium,
+    wall_flux,
+)
+
+Array = Any
+
+
+def make_rhs(
+    pp: PointParams,
+    chi_stats: str,
+    deplete: bool,
+    grid: KJMAGrid,
+    xp,
+    A_over_V_T: Optional[Callable[[Array], Array]] = None,
+) -> Callable[[Array, Array], Array]:
+    """Build the pure RHS f(x, Y) -> dY/dx.
+
+    ``A_over_V_T`` optionally replaces the direct KJMA evaluation with a
+    tabulated lookup (the reference uses an 800-point spline on its ODE
+    path, :211-212; the JAX path evaluates the batched kernel directly —
+    cheap once tensorized, and exact).
+    """
+
+    def rhs(x: Array, Y: Array) -> Array:
+        Ychi, YB = Y[..., 0], Y[..., 1]
+        T = pp.m_chi_GeV / xp.maximum(x, 1e-30)
+        H = xp.maximum(hubble_rate(T, pp.g_star, xp), 1e-300)
+        s = xp.maximum(entropy_density(T, pp.g_star_s, xp), 1e-300)
+        y = y_of_T(T, pp.T_p_GeV, pp.beta_over_H, xp)
+        if A_over_V_T is None:
+            av = area_over_volume(
+                y, pp.I_p, pp.beta_over_H, pp.T_p_GeV, pp.v_w, pp.g_star, grid, xp
+            )
+        else:
+            av = A_over_V_T(T)
+        J = pp.flux_scale * wall_flux(T, pp.m_chi_GeV, pp.g_chi, chi_stats, xp)
+        SB = pp.P * J * av * source_window(y, pp.sigma_y, xp)
+
+        sigmav = xp.maximum(pp.sigma_v, 0.0)
+        Ychi_eq = n_chi_equilibrium(T, pp.m_chi_GeV, pp.g_chi, chi_stats, xp) / s
+        depletion = (SB / s) if deplete else 0.0
+        dYchi = (-sigmav * s * (Ychi**2 - Ychi_eq**2) - depletion) / (H * x)
+        gamma_w = xp.maximum(pp.Gamma_wash_over_H, 0.0)
+        dYB = (SB / s - gamma_w * H * YB) / (H * x)
+        return xp.stack([dYchi, dYB], axis=-1)
+
+    return rhs
+
+
+class SplineAovTable:
+    """Clamped-query cubic-spline table of A/V(T) (reference :208-219)."""
+
+    def __init__(self, pp: PointParams, grid: KJMAGrid, T_lo: float, T_hi: float, n: int = 800):
+        from scipy.interpolate import CubicSpline
+
+        self.T_lo, self.T_hi = float(T_lo), float(T_hi)
+        Ts = np.linspace(self.T_lo, self.T_hi, n)
+        ys = y_of_T(Ts, pp.T_p_GeV, pp.beta_over_H, np)
+        Av = area_over_volume(
+            ys, pp.I_p, pp.beta_over_H, pp.T_p_GeV, pp.v_w, pp.g_star, grid, np
+        )
+        self._spline = CubicSpline(Ts, np.maximum(Av, 0.0), extrapolate=True)
+
+    def __call__(self, T: Array) -> Array:
+        return self._spline(np.clip(T, self.T_lo, self.T_hi))
+
+
+class ODESolution(NamedTuple):
+    Y_chi: float
+    Y_B: float
+    success: bool
+    message: str
+    n_steps: int
+
+
+def reference_max_step(x0: float, x1: float, x_p: float) -> float:
+    """The reference's hard step cap (`first_principles_yields.py:405`)."""
+    return min(abs(x1 - x0) / 20000.0, x_p / 1000.0, 5e-4)
+
+
+def solve_scipy_radau(
+    pp: PointParams,
+    chi_stats: str,
+    deplete: bool,
+    grid: KJMAGrid,
+    Y0: Tuple[float, float],
+    T_lo: float,
+    T_hi: float,
+    rtol: float = 1e-8,
+    atol: float = 1e-12,
+    reference_step_cap: bool = True,
+    table_n: int = 800,
+) -> ODESolution:
+    """Reference-parity ODE integration in x = m/T over [m/T_hi, m/T_lo]."""
+    from scipy.integrate import solve_ivp
+
+    table = SplineAovTable(pp, grid, T_lo, T_hi, n=table_n)
+    rhs = make_rhs(pp, chi_stats, deplete, grid, np, A_over_V_T=table)
+
+    x0 = pp.m_chi_GeV / T_hi
+    x1 = pp.m_chi_GeV / max(T_lo, 1e-30)
+    kwargs = {}
+    if reference_step_cap:
+        x_p = pp.m_chi_GeV / max(pp.T_p_GeV, 1e-30)
+        kwargs["max_step"] = reference_max_step(x0, x1, x_p)
+
+    def fun(x, Y):
+        return rhs(x, np.asarray(Y, dtype=float))
+
+    sol = solve_ivp(
+        fun, (x0, x1), np.asarray(Y0, dtype=float),
+        method="Radau", rtol=rtol, atol=atol, **kwargs,
+    )
+    if not sol.success:
+        warnings.warn(f"ODE solver reported failure: {sol.message}")
+    return ODESolution(
+        Y_chi=float(sol.y[0, -1]),
+        Y_B=float(sol.y[1, -1]),
+        success=bool(sol.success),
+        message=str(sol.message),
+        n_steps=int(sol.t.size),
+    )

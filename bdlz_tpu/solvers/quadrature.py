@@ -1,0 +1,85 @@
+"""Direct-quadrature yield solver (the fast path, framework layer L4).
+
+Y_B = ∫ S_B(T) / (s(T) H(T) T) dT evaluated on a uniform y-grid over the
+kernel's support (paper Eqs. 16-17). Scalar semantics match the reference
+(`first_principles_yields.py:231-267`) exactly — y-support clips [−80, +50],
+the 1e-12 denominator floor, the analytic Jacobian dT/dy, the n_y ≥ 2000
+floor — but the evaluation is fully tensorized: where the reference runs a
+Python list-comprehension of 8000 scalar KJMA calls (:261, its measured hot
+loop), this builds one (n_y × n_z) integrand and contracts it with two
+trapezoid reductions, which XLA fuses into a single VPU pass under ``jit``
+and which ``vmap`` batches across parameter sweeps.
+
+The same code serves the NumPy path (bit-reproducing the archived golden
+outputs) and the traced JAX path: all control flow is `where`-masked, so the
+function is jit/vmap-safe with static ``n_y``.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+from bdlz_tpu.config import PointParams
+from bdlz_tpu.physics.percolation import KJMAGrid, area_over_volume, y_of_T
+from bdlz_tpu.physics.source import source_window
+from bdlz_tpu.physics.thermo import (
+    entropy_density,
+    hubble_rate,
+    mean_speed_chi,
+    n_chi_equilibrium,
+)
+
+Array = Any
+
+#: Physical support of the KJMA kernel in y (reference :238-241). A/V is
+#: hard-zeroed above +50 anyway; below ≈−80 the integrand is negligible.
+Y_NEG_CUT: float = -80.0
+Y_POS_CUT: float = +50.0
+
+
+def integrate_YB_quadrature(
+    pp: PointParams,
+    chi_stats: str,
+    grid: KJMAGrid,
+    xp,
+    n_y: int = 8000,
+) -> Array:
+    """Comoving baryon yield Y_B for one parameter point (batched internally).
+
+    ``n_y`` is trace-static (it fixes array shapes); everything in ``pp``
+    may be traced, so this function vmaps cleanly over parameter grids.
+    Returns exactly 0.0 when the requested T-window maps to an empty
+    y-interval after support clipping (reference :242-243).
+    """
+    n_y = max(int(n_y), 2000)
+
+    T_hi = pp.T_max_over_Tp * pp.T_p_GeV
+    T_lo = pp.T_min_over_Tp * pp.T_p_GeV
+
+    # y-bounds: high T -> small y. Clip to the kernel support.
+    y_lo = xp.maximum(y_of_T(T_hi, pp.T_p_GeV, pp.beta_over_H, xp), Y_NEG_CUT)
+    y_hi = xp.minimum(y_of_T(T_lo, pp.T_p_GeV, pp.beta_over_H, xp), Y_POS_CUT)
+
+    ys = xp.linspace(y_lo, y_hi, n_y)
+
+    # Inverse map T(y) and the analytic Jacobian dT/dy (reference :252-255).
+    B_safe = xp.maximum(pp.beta_over_H, 1e-30)
+    denom = xp.maximum(1.0 + 2.0 * ys / B_safe, 1e-12)
+    Ts = pp.T_p_GeV / xp.sqrt(denom)
+    dTdy = -(pp.T_p_GeV / B_safe) * denom ** (-1.5)
+
+    Hs = hubble_rate(Ts, pp.g_star, xp)
+    ss = entropy_density(Ts, pp.g_star_s, xp)
+    Js = (
+        pp.flux_scale
+        * 0.25
+        * n_chi_equilibrium(Ts, pp.m_chi_GeV, pp.g_chi, chi_stats, xp)
+        * mean_speed_chi(Ts, pp.m_chi_GeV, xp)
+    )
+    Av = area_over_volume(
+        ys, pp.I_p, pp.beta_over_H, pp.T_p_GeV, pp.v_w, pp.g_star, grid, xp
+    )
+    SB = pp.P * Js * Av * source_window(ys, pp.sigma_y, xp)
+
+    integrand = SB / (ss * Hs * Ts) * xp.abs(dTdy)
+    YB = xp.trapezoid(integrand, ys)
+    return xp.where(y_hi > y_lo, YB, 0.0)

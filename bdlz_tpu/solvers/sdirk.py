@@ -1,0 +1,256 @@
+"""Stiff ESDIRK integrator in pure JAX (diffrax-like, in-repo).
+
+The reference's general path hands the Boltzmann system to SciPy Radau with
+a hard step cap that forces ≥1e6 steps at the benchmark point — measured to
+not finish in 90 s (`first_principles_yields.py:405-407`, SURVEY §3.2).
+diffrax is not installable in this environment (no network), so this module
+provides the replacement: an embedded Kvaernø(4,2,3) ESDIRK method —
+L-stable, stiffly accurate, 3rd order with a 2nd-order embedded error
+estimate — with adaptive step control, entirely inside ``lax.while_loop``
+so it jits, vmaps across parameter sweeps, and runs on the TPU.
+
+Design notes for TPU/XLA:
+
+* all control flow is ``lax.while_loop`` / ``lax.fori_loop`` / ``where``
+  masking — one trace, no data-dependent Python;
+* each implicit stage is solved by a fixed number of Newton iterations with
+  the exact 2×2 Jacobian from ``jax.jacfwd`` and a closed-form 2×2 linear
+  solve — no LU, no dynamic iteration counts, so vmapped lanes stay in
+  lockstep;
+* under ``vmap`` each lane carries its own adaptive step size; finished
+  lanes idle via masking until the whole batch converges.
+
+Tableau: Kvaernø (2004), "Singly diagonally implicit Runge–Kutta methods
+with an explicit first stage", BIT 44 — the 4-stage order-3/2 ESDIRK pair
+(the method diffrax ships as ``Kvaerno3``).
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from bdlz_tpu.config import PointParams, StaticChoices
+from bdlz_tpu.physics.percolation import KJMAGrid
+from bdlz_tpu.solvers.boltzmann import make_rhs
+
+jax.config.update("jax_enable_x64", True)
+
+#: Kvaernø(4,2,3) diagonal coefficient.
+_GAMMA = 0.4358665215084589994160194511935568425
+
+
+def _tableau():
+    g = _GAMMA
+    a31 = (-4.0 * g * g + 6.0 * g - 1.0) / (4.0 * g)
+    a32 = (-2.0 * g + 1.0) / (4.0 * g)
+    b1 = (6.0 * g - 1.0) / (12.0 * g)
+    b2 = -1.0 / ((24.0 * g - 12.0) * g)
+    b3 = (-6.0 * g * g + 6.0 * g - 1.0) / (6.0 * g - 3.0)
+    c = (0.0, 2.0 * g, 1.0, 1.0)
+    A = (
+        (0.0, 0.0, 0.0, 0.0),
+        (g, g, 0.0, 0.0),
+        (a31, a32, g, 0.0),
+        (b1, b2, b3, g),
+    )
+    # b = row 4 (stiffly accurate, 3rd order); embedded = row 3 (2nd order).
+    return c, A, A[3], A[2]
+
+
+class ESDIRKSolution(NamedTuple):
+    y: object          # final state, shape like y0
+    success: object    # bool: reached x1 with finite state within max_steps
+    n_steps: object    # attempted steps
+    n_accepted: object
+    n_rejected: object
+
+
+def _solve_2x2(J, r):
+    """Closed-form solve J @ d = r for 2-vectors."""
+    det = J[0, 0] * J[1, 1] - J[0, 1] * J[1, 0]
+    det = jnp.where(jnp.abs(det) > 1e-300, det, 1e-300)
+    d0 = (r[0] * J[1, 1] - r[1] * J[0, 1]) / det
+    d1 = (r[1] * J[0, 0] - r[0] * J[1, 0]) / det
+    return jnp.stack([d0, d1])
+
+
+def esdirk_solve(
+    rhs: Callable,
+    x0,
+    x1,
+    y0,
+    rtol: float = 1e-8,
+    atol: float = 1e-16,
+    max_steps: int = 10_000,
+    newton_iters: int = 6,
+    h_max=None,
+) -> ESDIRKSolution:
+    """Integrate dy/dx = rhs(x, y), y shape (2,), x0 < x1, adaptively.
+
+    Pure traceable function: wrap in ``jit`` at the call boundary and
+    ``vmap`` over closures' parameters for sweeps. ``h_max`` (optional,
+    traced) caps the step size — essential when the RHS contains a narrow
+    feature (the bounce source pulse) that pure local error control could
+    step across without ever sampling.
+    """
+    c, A, b, b_emb = _tableau()
+    g = _GAMMA
+    order = 3.0
+
+    y0 = jnp.asarray(y0, dtype=jnp.float64)
+    x0 = jnp.asarray(x0, dtype=jnp.float64)
+    x1 = jnp.asarray(x1, dtype=jnp.float64)
+    span = x1 - x0
+    h_cap = jnp.abs(span) if h_max is None else jnp.asarray(h_max, dtype=jnp.float64)
+
+    def newton_stage(x_s, rhs_const, y_guess, h):
+        """Solve Y = rhs_const + h·γ·f(x_s, Y) by fixed-iteration Newton."""
+
+        def body(_, Y):
+            F = Y - h * g * rhs(x_s, Y) - rhs_const
+            J = jnp.eye(2) - h * g * jax.jacfwd(lambda yy: rhs(x_s, yy))(Y)
+            return Y - _solve_2x2(J, F)
+
+        return jax.lax.fori_loop(0, newton_iters, body, y_guess)
+
+    def attempt_step(x, y, h, f0):
+        """One step attempt; stage 1 is explicit (f0 = rhs(x, y) reused)."""
+        ks = [f0]
+        for i in (1, 2, 3):
+            x_s = x + c[i] * h
+            acc = y
+            for j in range(i):
+                acc = acc + h * A[i][j] * ks[j]
+            Y_i = newton_stage(x_s, acc, acc + h * g * ks[i - 1], h)
+            ks.append(rhs(x_s, Y_i))
+
+        y_new, y_emb = y, y
+        for j in range(4):
+            y_new = y_new + h * b[j] * ks[j]
+            y_emb = y_emb + h * b_emb[j] * ks[j]
+
+        scale = atol + rtol * jnp.maximum(jnp.abs(y), jnp.abs(y_new))
+        err = jnp.sqrt(jnp.mean(((y_new - y_emb) / scale) ** 2))
+        return y_new, err, ks[3]
+
+    def cond(state):
+        _, _, _, _, n, _, _, done = state
+        return jnp.logical_and(~done, n < max_steps)
+
+    def body(state):
+        x, y, h, f, n, n_acc, n_rej, _ = state
+        h_eff = jnp.minimum(h, x1 - x)
+        y_new, err, f_last = attempt_step(x, y, h_eff, f)
+
+        err = jnp.where(jnp.isfinite(err), err, jnp.inf)
+        accept = err <= 1.0
+
+        factor = 0.9 * jnp.where(err > 0.0, err, 1e-10) ** (-1.0 / order)
+        factor = jnp.clip(factor, 0.2, 5.0)
+        h_next = jnp.clip(h_eff * factor, jnp.abs(span) * 1e-12, h_cap)
+
+        x = jnp.where(accept, x + h_eff, x)
+        y = jnp.where(accept, y_new, y)
+        f = jnp.where(accept, f_last, f)
+        done = x >= x1 - jnp.abs(span) * 1e-14
+        return (
+            x, y, h_next, f,
+            n + 1,
+            n_acc + accept.astype(jnp.int64),
+            n_rej + (~accept).astype(jnp.int64),
+            done,
+        )
+
+    f0 = rhs(x0, y0)
+    state0 = (
+        x0, y0, jnp.minimum(span * 1e-4, h_cap), f0,
+        jnp.int64(0), jnp.int64(0), jnp.int64(0),
+        jnp.asarray(False),
+    )
+    _, y_f, _, _, n, n_acc, n_rej, done = jax.lax.while_loop(cond, body, state0)
+    success = jnp.logical_and(done, jnp.all(jnp.isfinite(y_f)))
+    return ESDIRKSolution(
+        y=y_f, success=success, n_steps=n, n_accepted=n_acc, n_rejected=n_rej
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=("chi_stats", "deplete", "rtol", "atol", "max_steps"),
+)
+def _boltzmann_esdirk_jit(
+    pp: PointParams,
+    Y0,
+    T_lo,
+    T_hi,
+    grid: KJMAGrid,
+    chi_stats: str,
+    deplete: bool,
+    rtol: float,
+    atol: float,
+    max_steps: int,
+):
+    rhs = make_rhs(pp, chi_stats, deplete, grid, jnp)
+    x0 = pp.m_chi_GeV / T_hi
+    x1 = pp.m_chi_GeV / jnp.maximum(T_lo, 1e-30)
+
+    # Integrate in u = ln x. The bounce source is a pulse around
+    # x_p = m/T_p whose width in u is ~σ_y/(β/H) — known a priori from the
+    # window/percolation parameters, independent of where x_p sits in the
+    # span. Capping the u-step at a third of that guarantees the adaptive
+    # controller cannot step across the pulse after coasting through the
+    # quiet pre-percolation region (in plain x the required cap would
+    # force ~1e4 steps; in log-x it costs a few hundred).
+    u0, u1 = jnp.log(x0), jnp.log(x1)
+
+    def rhs_u(u, Y):
+        x = jnp.exp(u)
+        return x * rhs(x, Y)
+
+    h_max = jnp.minimum(0.05, (pp.sigma_y / jnp.maximum(pp.beta_over_H, 1e-30)) / 3.0)
+    return esdirk_solve(
+        rhs_u, u0, u1, Y0, rtol=rtol, atol=atol, max_steps=max_steps, h_max=h_max
+    )
+
+
+def boltzmann_final_yields(sol: ESDIRKSolution):
+    """Convenience: (Y_chi, Y_B) from a Boltzmann ESDIRK solution."""
+    return sol.y[0], sol.y[1]
+
+
+def solve_boltzmann_esdirk(
+    pp: PointParams,
+    static: StaticChoices,
+    grid: KJMAGrid,
+    Y0: Tuple[float, float],
+    T_lo: float,
+    T_hi: float,
+    rtol: float = 1e-8,
+    atol: float = 1e-16,
+    max_steps: int = 10_000,
+):
+    """Boltzmann evolution in x = m/T over [m/T_hi, m/T_lo], JAX path.
+
+    Same RHS semantics as the reference ODE path (`first_principles_yields.py
+    :270-286`) but with the batched KJMA kernel evaluated exactly (no
+    spline table) and genuinely adaptive steps — the Γ_wash/H = 0.01
+    configuration the reference cannot finish (SURVEY §2.1) completes in
+    well under a second once compiled. Returns an :class:`ESDIRKSolution`
+    (``sol.y = [Y_chi, Y_B]``).
+
+    Tolerance guidance: Y_B ramps exponentially over ~8 decades before the
+    pulse peak. With a 3rd-order method, an absolute tolerance far below
+    the *final* Y_B scale (e.g. 1e-24 against Y_B ~ 1e-10) puts the
+    controller on a treadmill in the ramp — it shrinks h as fast as the
+    source grows — and the step budget dies before percolation. The
+    default atol=1e-16 resolves Ω ratios to ≲1e-6 relative without that
+    pathology.
+    """
+    grid = KJMAGrid(*(jnp.asarray(a) for a in grid))
+    return _boltzmann_esdirk_jit(
+        pp, jnp.asarray(Y0, dtype=jnp.float64), T_lo, T_hi, grid,
+        static.chi_stats, static.deplete_DM_from_source, rtol, atol, max_steps,
+    )

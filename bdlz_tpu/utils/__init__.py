@@ -1,0 +1,1 @@
+"""Utility layer: structured output, logging, manifests."""

@@ -1,0 +1,46 @@
+"""Output layer: the `yields_out.json` artifact.
+
+Schema is the reference contract (`first_principles_yields.py:423-427`):
+``{"inputs": {<20 reference keys in declaration order>, "P_used": P},
+"final": {Y_B, Y_chi, rho_B_kg_m3, rho_DM_kg_m3, DM_over_B}}``. Framework
+extension keys are appended to "inputs" only when they differ from their
+defaults, so a pure reference run produces a byte-identical file.
+"""
+from __future__ import annotations
+
+import json
+from typing import Any, Dict
+
+from bdlz_tpu.config import REFERENCE_KEYS, Config, default_config
+from bdlz_tpu.models.yields_pipeline import YieldsResult
+
+
+def _scalar(v: Any) -> Any:
+    """Coerce numpy/jax scalars to plain Python types for JSON."""
+    if hasattr(v, "item"):
+        return v.item()
+    return v
+
+
+def yields_out_payload(cfg: Config, P_used: float, result: YieldsResult) -> Dict[str, Any]:
+    inputs: Dict[str, Any] = {k: getattr(cfg, k) for k in REFERENCE_KEYS}
+    inputs["P_used"] = _scalar(P_used)
+    defaults = default_config()
+    for key in ("backend", "m_B_GeV", "n_y", "ode_reference_step_cap"):
+        if getattr(cfg, key) != defaults[key]:
+            inputs[key] = getattr(cfg, key)
+    return {
+        "inputs": inputs,
+        "final": {
+            "Y_B": _scalar(result.Y_B),
+            "Y_chi": _scalar(result.Y_chi),
+            "rho_B_kg_m3": _scalar(result.rho_B_kg_m3),
+            "rho_DM_kg_m3": _scalar(result.rho_DM_kg_m3),
+            "DM_over_B": _scalar(result.DM_over_B),
+        },
+    }
+
+
+def write_yields_out(path: str, cfg: Config, P_used: float, result: YieldsResult) -> None:
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(yields_out_payload(cfg, P_used, result), f, indent=2)

@@ -1,0 +1,16 @@
+#!/usr/bin/env python3
+"""Reference-compatible entry point.
+
+The archived reproduction command (reference run.txt:1) is
+
+    python first_principles_yields.py --config yields_config_equal_mass.json --diagnostics
+
+This shim forwards to the framework CLI (`bdlz_tpu.cli`), whose NumPy
+backend reproduces the archived golden outputs byte-for-byte; add
+``"backend": "tpu"`` to the config (or pass ``--backend tpu``) for the
+jitted TPU path.
+"""
+from bdlz_tpu.cli import main
+
+if __name__ == "__main__":
+    main()

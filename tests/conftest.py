@@ -1,0 +1,78 @@
+"""Test bootstrap: force JAX onto an 8-device host-CPU platform.
+
+The build environment has exactly one physical TPU chip, so multi-chip mesh
+code is validated the standard JAX way: 8 virtual CPU devices via
+``xla_force_host_platform_device_count`` (SURVEY §4.4). These env vars must
+be set before the first ``import jax`` anywhere in the test process, which
+is why they live at conftest import time.
+"""
+import os
+
+# NOTE on this environment (gotchas, see .claude/skills/verify/SKILL.md):
+# * JAX_PLATFORMS=cpu is IGNORED (the axon TPU plugin still wins) and the
+#   interpreter pre-imports parts of jax at startup, so env vars set here
+#   can be too late. jax.config.update() before first backend use is the
+#   reliable override.
+# * The axon TPU rejects complex128, and every eager op goes through a
+#   remote compile — tests MUST run on host CPU.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("JAX_PLATFORM_NAME", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "true")
+
+import jax
+
+# 'jax_platforms' (not the deprecated 'jax_platform_name') is what
+# reliably undoes the sitecustomize-forced axon platform: with it set to
+# cpu, the axon backend is never initialized — which also keeps the suite
+# alive when the axon relay is down (observed: a dead relay makes ANY
+# jax.devices() call hang if axon is still in the platform list).
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+jax.config.update("jax_enable_x64", True)
+assert jax.devices()[0].platform == "cpu", "tests must run on host CPU"
+
+import pathlib
+import sys
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
+if str(REPO_ROOT) not in sys.path:
+    sys.path.insert(0, str(REPO_ROOT))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def benchmark_config_path(tmp_path_factory):
+    """A copy of the archived benchmark config (equal-mass point)."""
+    import json
+
+    cfg = {
+        "regime": "nonthermal",
+        "m_chi_GeV": 0.95,
+        "g_chi": 2,
+        "chi_stats": "fermion",
+        "sigma_v_chi_GeV_m2": 0.0,
+        "T_p_GeV": 100.0,
+        "beta_over_H": 100.0,
+        "v_w": 0.30,
+        "I_p": 0.34,
+        "g_star": 106.75,
+        "g_star_s": 106.75,
+        "P_chi_to_B": 0.14925839040304145,
+        "source_shape_sigma_y": 9.0,
+        "Gamma_wash_over_H": 0.0,
+        "incident_flux_scale": 1.07e-9,
+        "deplete_DM_from_source": False,
+        "T_max_over_Tp": 5.0,
+        "T_min_over_Tp": 0.001,
+        "Y_chi_init": 4.90e-10,
+        "n_chi_at_Tp_GeV3": None,
+    }
+    path = tmp_path_factory.mktemp("cfg") / "yields_config_equal_mass.json"
+    path.write_text(json.dumps(cfg, indent=2))
+    return str(path)

@@ -1,0 +1,96 @@
+"""Config-system tests: strict schema, reference defaults, template
+round-trip, and the regime:"auto" latent-bug regression (SURVEY §4.5)."""
+import json
+
+import pytest
+
+from bdlz_tpu.config import (
+    REFERENCE_KEYS,
+    Config,
+    ConfigError,
+    config_from_dict,
+    default_config,
+    load_config,
+    resolve_Y_chi_init,
+    validate,
+    write_template,
+)
+
+# The reference's 20 defaults (`first_principles_yields.py:291-301`).
+REFERENCE_DEFAULTS = {
+    "m_chi_GeV": 0.95, "g_chi": 2, "chi_stats": "fermion", "regime": "nonthermal",
+    "sigma_v_chi_GeV_m2": 0.0,
+    "T_p_GeV": 100.0, "beta_over_H": 100.0, "v_w": 0.30, "I_p": 0.34,
+    "g_star": 106.75, "g_star_s": 106.75,
+    "P_chi_to_B": None, "source_shape_sigma_y": 15.0, "Gamma_wash_over_H": 0.0,
+    "incident_flux_scale": 1.0, "deplete_DM_from_source": False,
+    "T_max_over_Tp": 5.0, "T_min_over_Tp": 1.0e-3,
+    "Y_chi_init": 4.90e-10, "n_chi_at_Tp_GeV3": None,
+}
+
+
+def test_defaults_match_reference():
+    d = default_config()
+    for k, v in REFERENCE_DEFAULTS.items():
+        assert d[k] == v, k
+    assert tuple(list(d)[: len(REFERENCE_KEYS)]) == REFERENCE_KEYS
+
+
+def test_unknown_key_rejected():
+    with pytest.raises(ConfigError, match="Unknown config key"):
+        config_from_dict({"m_chi_GEV": 1.0})  # typo'd case
+
+
+def test_merge_over_defaults():
+    cfg = config_from_dict({"m_chi_GeV": 2.0})
+    assert cfg.m_chi_GeV == 2.0
+    assert cfg.beta_over_H == 100.0
+
+
+def test_template_roundtrip(tmp_path):
+    path = tmp_path / "template.json"
+    write_template(str(path))
+    cfg = load_config(str(path))
+    assert cfg == Config()
+
+
+def test_regime_auto_rejected():
+    """The reference documents regime:"auto" but crashes on it
+    (UnboundLocalError at :376-384); this framework errors up-front."""
+    cfg = config_from_dict({"regime": "auto"})
+    with pytest.raises(ConfigError, match="regime"):
+        validate(cfg)
+
+
+def test_backend_key_accepted():
+    cfg = config_from_dict({"backend": "tpu"})
+    assert cfg.backend == "tpu"
+
+
+def test_Y_chi_init_resolution_order():
+    assert resolve_Y_chi_init(config_from_dict({"Y_chi_init": 3e-10})) == 3e-10
+    # n_chi_at_Tp fallback: n/s at T_p
+    cfg = config_from_dict({"Y_chi_init": None, "n_chi_at_Tp_GeV3": 1.0})
+    import numpy as np
+    from bdlz_tpu.physics.thermo import entropy_density
+
+    expected = 1.0 / entropy_density(cfg.T_p_GeV, cfg.g_star_s, np)
+    assert resolve_Y_chi_init(cfg) == pytest.approx(expected, rel=1e-15)
+    # final fallback
+    cfg = config_from_dict({"Y_chi_init": None})
+    assert resolve_Y_chi_init(cfg) == 1.0e-12
+
+
+def test_benchmark_config_loads(benchmark_config_path):
+    cfg = validate(load_config(benchmark_config_path))
+    assert cfg.P_chi_to_B == 0.14925839040304145
+    assert cfg.source_shape_sigma_y == 9.0
+    assert cfg.incident_flux_scale == 1.07e-9
+    assert cfg.backend == "numpy"
+
+
+def test_config_json_rejects_unknown(tmp_path):
+    path = tmp_path / "bad.json"
+    path.write_text(json.dumps({"not_a_key": 1}))
+    with pytest.raises(ConfigError):
+        load_config(str(path))

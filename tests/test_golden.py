@@ -1,0 +1,74 @@
+"""Golden-output tests (SURVEY §4.1): the NumPy backend must reproduce the
+archived benchmark numbers, and — when the reference snapshot is mounted —
+match the actual reference script's stdout and yields_out.json byte-for-byte.
+"""
+import json
+import os
+import pathlib
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from bdlz_tpu.config import (
+    load_config,
+    point_params_from_config,
+    static_choices_from_config,
+)
+from bdlz_tpu.models.yields_pipeline import point_yields
+from bdlz_tpu.physics.percolation import make_kjma_grid
+
+# Archived golden values (reference PDF §6.3 Eqs. 19-21; BASELINE.md).
+GOLDEN_Y_B = 8.7208853627e-11
+GOLDEN_Y_CHI = 4.9e-10
+GOLDEN_RATIO = 5.6889263349
+
+REFERENCE_DIR = pathlib.Path("/root/reference")
+REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+
+def test_numpy_backend_reproduces_archived_numbers(benchmark_config_path):
+    cfg = load_config(benchmark_config_path)
+    pp = point_params_from_config(cfg, cfg.P_chi_to_B)
+    static = static_choices_from_config(cfg)
+    result = point_yields(pp, static, make_kjma_grid(np), np)
+
+    assert float(result.Y_B) == pytest.approx(GOLDEN_Y_B, rel=2e-11)
+    assert float(result.Y_chi) == GOLDEN_Y_CHI
+    assert float(result.DM_over_B) == pytest.approx(GOLDEN_RATIO, rel=2e-11)
+    # Densities are exact functions of the yields (reference :413-417).
+    assert float(result.rho_B_kg_m3) == pytest.approx(4.217e-28, rel=1e-3)
+    assert float(result.rho_DM_kg_m3) == pytest.approx(2.399e-27, rel=1e-3)
+
+
+@pytest.mark.skipif(not REFERENCE_DIR.exists(), reason="reference snapshot not mounted")
+def test_bit_parity_with_reference_script(benchmark_config_path, tmp_path):
+    """Run the actual reference pipeline and our CLI side by side; stdout
+    and yields_out.json must match byte-for-byte on the NumPy backend."""
+    env = {k: v for k, v in os.environ.items() if not k.startswith(("JAX_", "XLA_"))}
+
+    ref_dir = tmp_path / "ref"
+    ref_dir.mkdir()
+    ref = subprocess.run(
+        [sys.executable, str(REFERENCE_DIR / "first_principles_yields.py"),
+         "--config", benchmark_config_path, "--diagnostics"],
+        cwd=ref_dir, capture_output=True, text=True, env=env, timeout=300,
+    )
+    assert ref.returncode == 0, ref.stderr
+
+    ours_dir = tmp_path / "ours"
+    ours_dir.mkdir()
+    ours = subprocess.run(
+        [sys.executable, str(REPO_ROOT / "first_principles_yields.py"),
+         "--config", benchmark_config_path, "--diagnostics"],
+        cwd=ours_dir, capture_output=True, text=True, env=env, timeout=300,
+    )
+    assert ours.returncode == 0, ours.stderr
+
+    assert ours.stdout == ref.stdout
+
+    ref_out = json.loads((ref_dir / "yields_out.json").read_text())
+    our_out = json.loads((ours_dir / "yields_out.json").read_text())
+    assert our_out["final"] == ref_out["final"]
+    assert our_out["inputs"] == ref_out["inputs"]

@@ -1,0 +1,185 @@
+"""Two-channel LZ kernel tests (SURVEY §7.6): profile ingestion, crossing
+finding, the analytic single-crossing limit, the batched-expm cross-check,
+and the maybe_P seam contract."""
+import numpy as np
+import pytest
+
+from bdlz_tpu.lz import (
+    BounceProfile,
+    ProfileError,
+    find_crossings,
+    lambda_eff_from_profile,
+    local_lambdas,
+    probability_from_lambda,
+    probability_from_profile,
+    transfer_matrix_propagation,
+    load_profile_csv,
+)
+
+
+def linear_profile(alpha=1.0, kappa=0.1, L=200.0, N=40000):
+    xi = np.linspace(-L, L, N)
+    return BounceProfile(xi=xi, delta=alpha * xi, mix=np.full_like(xi, kappa))
+
+
+class TestProfileIO:
+    def test_delta_mix_schema(self, tmp_path):
+        p = tmp_path / "p.csv"
+        p.write_text("xi,delta,m_mix\n-1.0,-2.0,0.1\n0.0,0.0,0.1\n1.0,2.0,0.1\n")
+        prof = load_profile_csv(str(p))
+        assert prof.xi.tolist() == [-1.0, 0.0, 1.0]
+        assert prof.delta.tolist() == [-2.0, 0.0, 2.0]
+
+    def test_mass_matrix_schema(self, tmp_path):
+        p = tmp_path / "m.csv"
+        p.write_text("xi,m11,m22,m12\n0.0,1.0,2.0,0.3\n1.0,3.0,1.0,0.4\n")
+        prof = load_profile_csv(str(p))
+        np.testing.assert_allclose(prof.delta, [-1.0, 2.0])
+        np.testing.assert_allclose(prof.mix, [0.3, 0.4])
+
+    def test_missing_columns_raise(self, tmp_path):
+        p = tmp_path / "bad.csv"
+        p.write_text("xi,foo\n0,1\n1,2\n")
+        with pytest.raises(ProfileError, match="columns"):
+            load_profile_csv(str(p))
+
+    def test_too_few_rows_raise(self, tmp_path):
+        p = tmp_path / "short.csv"
+        p.write_text("xi,delta,m_mix\n0,1,0.1\n")
+        with pytest.raises(ProfileError, match="at least 2"):
+            load_profile_csv(str(p))
+
+    def test_unsorted_xi_sorted(self, tmp_path):
+        p = tmp_path / "u.csv"
+        p.write_text("xi,delta,m_mix\n1.0,2.0,0.2\n-1.0,-2.0,0.1\n")
+        prof = load_profile_csv(str(p))
+        assert prof.xi.tolist() == [-1.0, 1.0]
+        assert prof.mix.tolist() == [0.1, 0.2]
+
+
+class TestCrossingFinder:
+    def test_single_linear_crossing(self):
+        prof = linear_profile(alpha=2.0, kappa=0.3, L=10.0, N=1001)
+        c = find_crossings(prof)
+        assert c.xi_star.size == 1
+        assert c.xi_star[0] == pytest.approx(0.0, abs=1e-12)
+        assert c.slope[0] == pytest.approx(2.0, rel=1e-12)
+        assert c.mix[0] == pytest.approx(0.3, rel=1e-12)
+
+    def test_multi_crossing(self):
+        xi = np.linspace(0.0, 4 * np.pi, 4001)
+        prof = BounceProfile(xi=xi, delta=np.sin(xi), mix=np.full_like(xi, 0.1))
+        c = find_crossings(prof)
+        # sin is exactly zero at the xi=0 boundary sample and changes sign
+        # at pi, 2pi, 3pi
+        assert c.xi_star.size == 4
+        np.testing.assert_allclose(
+            c.xi_star, [0.0, np.pi, 2 * np.pi, 3 * np.pi], atol=1e-4
+        )
+
+    def test_lambda_locals(self):
+        prof = linear_profile(alpha=2.0, kappa=0.3, L=10.0, N=1001)
+        lams = local_lambdas(find_crossings(prof), v_w=0.5)
+        assert lams[0] == pytest.approx(0.3**2 / (0.5 * 2.0), rel=1e-9)
+
+
+class TestProbabilityMaps:
+    def test_lambda_to_P(self):
+        assert probability_from_lambda(0.0) == 0.0
+        assert probability_from_lambda(-1.0) == 0.0  # clamped (reference :183)
+        assert probability_from_lambda(1e9) == 1.0
+        lam = 0.25
+        assert probability_from_lambda(lam) == pytest.approx(
+            1.0 - np.exp(-2 * np.pi * lam), rel=1e-15
+        )
+
+    def test_lambda_eff_sums_crossings(self):
+        xi = np.linspace(0.5, 3.5 * np.pi, 40001)  # avoid boundary zeros
+        prof = BounceProfile(xi=xi, delta=np.sin(xi), mix=np.full_like(xi, 0.1))
+        lam = lambda_eff_from_profile(prof, v_w=1.0)
+        # three crossings (pi, 2pi, 3pi), each |slope|=1, mix=0.1 -> 0.01 each
+        assert lam == pytest.approx(0.03, rel=1e-3)
+
+
+class TestCoherentPropagation:
+    def test_single_crossing_matches_analytic_LZ(self):
+        """The distributed kernel must reduce to P = 1 − e^(−2πλ) in the
+        single-crossing limit (paper Eq. 9) — the only analytic anchor."""
+        alpha, kappa, v_w = 1.0, 0.1, 1.0
+        prof = linear_profile(alpha=alpha, kappa=kappa)
+        _, P = transfer_matrix_propagation(prof, v_w)
+        lam = kappa**2 / (v_w * alpha)
+        assert P == pytest.approx(probability_from_lambda(lam), rel=1e-3)
+
+    def test_wall_velocity_dependence(self):
+        """Slower wall => more adiabatic => larger conversion."""
+        prof = linear_profile()
+        _, P_slow = transfer_matrix_propagation(prof, 0.5)
+        _, P_fast = transfer_matrix_propagation(prof, 2.0)
+        assert P_slow > P_fast
+
+    def test_su2_equals_generic_expm(self):
+        """Real-quaternion path == vmapped jax.scipy.linalg.expm path."""
+        xi = np.linspace(-5.0, 5.0, 301)
+        prof = BounceProfile(xi=xi, delta=xi.copy(), mix=np.full_like(xi, 0.3))
+        U1, P1 = transfer_matrix_propagation(prof, 0.5)
+        U2, P2 = transfer_matrix_propagation(prof, 0.5, use_generic_expm=True)
+        np.testing.assert_allclose(U1, U2, atol=1e-13)
+        assert P1 == pytest.approx(P2, abs=1e-13)
+
+    def test_unitarity(self):
+        U, P = transfer_matrix_propagation(linear_profile(N=5001), 0.7)
+        np.testing.assert_allclose(U @ U.conj().T, np.eye(2), atol=1e-12)
+        assert 0.0 <= P <= 1.0
+
+    def test_zero_mixing_no_conversion(self):
+        xi = np.linspace(-10, 10, 1001)
+        prof = BounceProfile(xi=xi, delta=xi.copy(), mix=np.zeros_like(xi))
+        _, P = transfer_matrix_propagation(prof, 0.5)
+        assert P == 0.0
+
+    def test_adiabatic_limit_full_conversion(self):
+        """Huge mixing / slow wall: adiabatic following, P -> 1."""
+        prof = linear_profile(alpha=1.0, kappa=2.0, L=50.0, N=20000)
+        _, P = transfer_matrix_propagation(prof, 0.1)
+        assert P > 0.99
+
+
+class TestSeamContract:
+    """(profile_csv, v_w) -> P in [0,1] — the reference maybe_P plug-in
+    contract (`first_principles_yields.py:317-328`)."""
+
+    def _write_profile(self, tmp_path, prof):
+        p = tmp_path / "profile.csv"
+        rows = "\n".join(
+            f"{x},{d},{m}" for x, d, m in zip(prof.xi, prof.delta, prof.mix)
+        )
+        p.write_text("xi,delta,m_mix\n" + rows + "\n")
+        return str(p)
+
+    def test_coherent_and_local_agree_single_crossing(self, tmp_path):
+        prof = linear_profile()
+        path = self._write_profile(tmp_path, prof)
+        P_coh = probability_from_profile(path, 1.0)
+        P_loc = probability_from_profile(path, 1.0, method="local")
+        assert 0.0 <= P_coh <= 1.0 and 0.0 <= P_loc <= 1.0
+        assert P_coh == pytest.approx(P_loc, rel=2e-3)
+
+    def test_cli_seam(self, tmp_path, benchmark_config_path, capsys):
+        """CLI --maybe-compute-P-from-profile actually uses the kernel."""
+        from bdlz_tpu.cli import resolve_P
+        from bdlz_tpu.config import load_config
+
+        prof = linear_profile(N=2001)
+        path = self._write_profile(tmp_path, prof)
+        cfg = load_config(benchmark_config_path)
+        P = resolve_P(cfg, path)
+        out = capsys.readouterr().out
+        assert "[info] Using P_chi_to_B from profile:" in out
+        assert 0.0 < P < 1.0
+        assert P != cfg.P_chi_to_B
+
+    def test_bad_method_raises(self, tmp_path):
+        path = self._write_profile(tmp_path, linear_profile(N=101))
+        with pytest.raises(ValueError, match="method"):
+            probability_from_profile(path, 1.0, method="bogus")

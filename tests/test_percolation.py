@@ -1,0 +1,103 @@
+"""Unit tests for the y<->T maps and the batched KJMA kernel (SURVEY §4.2)."""
+import math
+
+import numpy as np
+import pytest
+
+from bdlz_tpu.physics.percolation import (
+    KJMAGrid,
+    T_of_y,
+    area_over_volume,
+    make_kjma_grid,
+    y_of_T,
+)
+from bdlz_tpu.physics.thermo import hubble_rate
+
+BENCH = dict(I_p=0.34, beta_over_H=100.0, T_p=100.0, v_w=0.30, g_star=106.75)
+
+
+def aov(y, grid, **kw):
+    p = {**BENCH, **kw}
+    return area_over_volume(
+        y, p["I_p"], p["beta_over_H"], p["T_p"], p["v_w"], p["g_star"], grid, np
+    )
+
+
+def test_y_of_T_closed_form():
+    # y = B/2 [(T_p/T)^2 - 1]: zero at T_p, positive below, negative above.
+    assert y_of_T(100.0, 100.0, 100.0, np) == 0.0
+    assert y_of_T(50.0, 100.0, 100.0, np) == pytest.approx(150.0)
+    assert y_of_T(200.0, 100.0, 100.0, np) == pytest.approx(-37.5)
+
+
+def test_y_T_roundtrip():
+    Ts = np.geomspace(0.1, 500.0, 64)
+    ys = y_of_T(Ts, 100.0, 100.0, np)
+    back = T_of_y(ys, 100.0, 100.0, np)
+    np.testing.assert_allclose(back, Ts, rtol=1e-12)
+
+
+def test_T_of_y_out_of_range_guard():
+    # denom <= 1e-12 -> T_p * 1e6 (reference :133-134).
+    assert T_of_y(-50.0001, 100.0, 100.0, np) == 100.0 * 1e6
+
+
+def test_grid_matches_reference_spec():
+    grid = make_kjma_grid(np)
+    assert grid.z.shape == (1200,)
+    assert grid.z[0] == 0.0 and grid.z[-1] == 30.0
+    # gamma4(0) = 0, gamma4(inf) = 6 = Gamma(4)
+    assert grid.gamma4[0] == pytest.approx(0.0, abs=1e-12)
+    # gamma4(30) = 6 − e⁻³⁰·29886 ≈ 6 − 2.8e-9
+    assert grid.gamma4[-1] == pytest.approx(6.0, abs=1e-8)
+
+
+def test_aov_hard_zero_above_y50():
+    grid = make_kjma_grid(np)
+    assert aov(50.0001, grid) == 0.0
+    assert aov(np.array([60.0, 1e3]), grid).tolist() == [0.0, 0.0]
+
+
+def test_aov_batched_matches_scalar_loop():
+    """The tensorized kernel must equal per-scalar evaluation bitwise —
+    this is the hot-loop replacement (reference :261)."""
+    grid = make_kjma_grid(np)
+    ys = np.linspace(-80.0, 49.0, 777)
+    batched = aov(ys, grid)
+    scalars = np.array([aov(float(y), grid) for y in ys])
+    np.testing.assert_array_equal(batched, scalars)
+
+
+def test_aov_against_independent_quadrature():
+    """Check the KJMA integral against scipy adaptive quadrature on the
+    *continuum* integrand (not the fixed grid): the 1200-point trapezoid on
+    [0, 30] should agree to its own discretisation error (~1e-7 rel)."""
+    from scipy.integrate import quad
+
+    grid = make_kjma_grid(np)
+    p = BENCH
+    H_p = hubble_rate(p["T_p"], p["g_star"], np)
+    beta = p["beta_over_H"] * H_p
+    for y in (-5.0, 0.0, 2.0):
+        expy = math.exp(y)
+
+        def integrand(z):
+            g4 = 6.0 - math.exp(-z) * (z**3 + 3 * z**2 + 6 * z + 6)
+            return z**2 * math.exp(-z) * math.exp(-(p["I_p"] / 6.0) * expy * g4)
+
+        F, _ = quad(integrand, 0.0, 30.0, epsabs=1e-14, epsrel=1e-12)
+        expected = (p["I_p"] / 2.0) * (beta / p["v_w"]) * expy * F
+        assert aov(y, grid) == pytest.approx(expected, rel=5e-7)
+
+
+def test_aov_exp_clamp_continuity():
+    """e^y is clamped at y=±50 (reference :161): below −50 the prefactor
+    saturates, so A/V(-60) == A/V(-50)."""
+    grid = make_kjma_grid(np)
+    assert aov(-60.0, grid) == aov(-50.0, grid)
+
+
+def test_aov_wall_velocity_floor():
+    grid = make_kjma_grid(np)
+    assert np.isfinite(aov(0.0, grid, v_w=0.0))
+    assert aov(0.0, grid, v_w=0.0) == aov(0.0, grid, v_w=1e-12)

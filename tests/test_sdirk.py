@@ -1,0 +1,161 @@
+"""ESDIRK stiff-integrator tests (SURVEY §4.2/§4.5): analytic solutions,
+stiff stability, the quadrature cross-check on a washout-free config, and
+the Γ_wash=0.01 regression the reference cannot finish."""
+import time
+
+import numpy as np
+import pytest
+
+from bdlz_tpu.config import (
+    config_from_dict,
+    point_params_from_config,
+    static_choices_from_config,
+)
+from bdlz_tpu.physics.percolation import make_kjma_grid
+from bdlz_tpu.solvers.quadrature import integrate_YB_quadrature
+from bdlz_tpu.solvers.sdirk import esdirk_solve, solve_boltzmann_esdirk
+
+
+def bench_cfg(**over):
+    base = {
+        "regime": "nonthermal",
+        "P_chi_to_B": 0.14925839040304145,
+        "source_shape_sigma_y": 9.0,
+        "incident_flux_scale": 1.07e-9,
+        "Y_chi_init": 4.90e-10,
+    }
+    base.update(over)
+    return config_from_dict(base)
+
+
+class TestESDIRKCore:
+    def test_linear_decay_exact(self):
+        import jax.numpy as jnp
+
+        lam = 3.0
+
+        def rhs(x, y):
+            return -lam * y
+
+        sol = esdirk_solve(rhs, 0.0, 2.0, jnp.array([1.0, 0.5]), rtol=1e-10, atol=1e-14)
+        assert bool(sol.success)
+        expected = np.array([1.0, 0.5]) * np.exp(-lam * 2.0)
+        np.testing.assert_allclose(np.asarray(sol.y), expected, rtol=1e-8)
+
+    def test_stiff_decay_stable(self):
+        """λ = 1e6 over unit interval: explicit methods explode, an
+        L-stable ESDIRK takes few steps."""
+        import jax.numpy as jnp
+
+        def rhs(x, y):
+            return -1e6 * (y - jnp.array([2.0, 3.0]))
+
+        sol = esdirk_solve(rhs, 0.0, 1.0, jnp.array([0.0, 0.0]), rtol=1e-8, atol=1e-12)
+        assert bool(sol.success)
+        # An explicit method would need ~1e6 steps (stability limit
+        # h < 2/λ); the L-stable ESDIRK needs only enough to *resolve*
+        # the initial transient to rtol.
+        assert int(sol.n_steps) < 2000
+        np.testing.assert_allclose(np.asarray(sol.y), [2.0, 3.0], atol=1e-7)
+
+    def test_nonautonomous_quadrature(self):
+        """y' = cos(x): pure quadrature through the solver."""
+        import jax.numpy as jnp
+
+        def rhs(x, y):
+            return jnp.full_like(y, jnp.cos(x))
+
+        sol = esdirk_solve(rhs, 0.0, 1.5, jnp.zeros(2), rtol=1e-10, atol=1e-14)
+        np.testing.assert_allclose(np.asarray(sol.y), np.sin(1.5), rtol=1e-7)
+
+    def test_max_steps_reports_failure(self):
+        import jax.numpy as jnp
+
+        def rhs(x, y):
+            return -1e6 * y
+
+        sol = esdirk_solve(rhs, 0.0, 1.0, jnp.ones(2), rtol=1e-12, atol=1e-18, max_steps=3)
+        assert not bool(sol.success)
+
+
+class TestBoltzmannESDIRK:
+    def test_matches_quadrature_when_source_only(self):
+        """With σv=0, Γ_wash=0, no depletion, the ODE must reproduce the
+        quadrature Y_B (the two solvers share only the RHS physics)."""
+        import jax.numpy as jnp
+
+        cfg = bench_cfg()
+        pp = point_params_from_config(cfg, cfg.P_chi_to_B)
+        static = static_choices_from_config(cfg)
+        grid = make_kjma_grid(np)
+
+        YB_quad = float(
+            integrate_YB_quadrature(
+                pp, static.chi_stats, make_kjma_grid(jnp), jnp, n_y=8000
+            )
+        )
+        T_p = cfg.T_p_GeV
+        sol = solve_boltzmann_esdirk(
+            pp, static, grid, (4.90e-10, 0.0), 0.001 * T_p, 5.0 * T_p,
+            rtol=1e-10, atol=1e-18,
+        )
+        assert bool(sol.success)
+        assert float(sol.y[0]) == pytest.approx(4.90e-10, rel=1e-12)  # untouched
+        assert float(sol.y[1]) == pytest.approx(YB_quad, rel=1e-4)
+
+    def test_washout_config_finishes_fast(self):
+        """The Γ_wash/H=0.01 config the reference cannot finish in 90 s
+        (SURVEY §2.1) must complete here in seconds and show washout."""
+        import jax.numpy as jnp
+
+        cfg = bench_cfg(Gamma_wash_over_H=0.01)
+        pp = point_params_from_config(cfg, cfg.P_chi_to_B)
+        static = static_choices_from_config(cfg)
+        grid = make_kjma_grid(np)
+        T_p = cfg.T_p_GeV
+
+        t0 = time.time()
+        sol = solve_boltzmann_esdirk(
+            pp, static, grid, (4.90e-10, 0.0), 0.001 * T_p, 5.0 * T_p,
+            rtol=1e-10, atol=1e-18,
+        )
+        assert bool(sol.success)
+        YB = float(sol.y[1])
+        elapsed = time.time() - t0
+
+        YB_no_wash = float(
+            integrate_YB_quadrature(
+                pp, static.chi_stats, make_kjma_grid(jnp), jnp, n_y=8000
+            )
+        )
+        assert elapsed < 60.0  # includes compile; execution is ~ms
+        assert 0.0 < YB < YB_no_wash  # washout strictly reduces Y_B
+        assert YB == pytest.approx(YB_no_wash, rel=0.2)  # but mildly at 0.01
+
+    def test_cross_check_scipy_radau_uncapped(self):
+        """Backend parity on the ODE path: ESDIRK (JAX) vs SciPy Radau with
+        the step cap disabled, on a depletion+washout toy config."""
+        from bdlz_tpu.solvers.boltzmann import solve_scipy_radau
+
+        cfg = bench_cfg(
+            Gamma_wash_over_H=0.05,
+            deplete_DM_from_source=True,
+            T_min_over_Tp=0.05,
+        )
+        pp = point_params_from_config(cfg, cfg.P_chi_to_B)
+        static = static_choices_from_config(cfg)
+        grid = make_kjma_grid(np)
+        T_p = cfg.T_p_GeV
+        T_lo, T_hi = 0.05 * T_p, 5.0 * T_p
+
+        ref = solve_scipy_radau(
+            pp, static.chi_stats, True, grid, (4.90e-10, 0.0), T_lo, T_hi,
+            rtol=1e-10, atol=1e-18, reference_step_cap=False,
+        )
+        assert ref.success
+        sol = solve_boltzmann_esdirk(
+            pp, static, grid, (4.90e-10, 0.0), T_lo, T_hi, rtol=1e-10, atol=1e-18
+        )
+        assert bool(sol.success)
+        assert float(sol.y[1]) == pytest.approx(ref.Y_B, rel=1e-5)
+        assert float(sol.y[0]) == pytest.approx(ref.Y_chi, rel=1e-6)

@@ -1,0 +1,112 @@
+"""Unit tests for the thermo/cosmo library (SURVEY §4.2), including both
+sides of the T = m/3 branch seam the archived numbers depend on."""
+import math
+
+import numpy as np
+import pytest
+
+from bdlz_tpu.constants import MPL_GEV, PI, ZETA3
+from bdlz_tpu.physics.thermo import (
+    entropy_density,
+    hubble_rate,
+    mean_speed_chi,
+    n_chi_equilibrium,
+    wall_flux,
+)
+
+
+def test_hubble_rate_formula():
+    T, g = 100.0, 106.75
+    assert hubble_rate(T, g, np) == pytest.approx(
+        1.66 * math.sqrt(g) * T * T / MPL_GEV, rel=1e-15
+    )
+
+
+def test_entropy_density_formula():
+    T, g = 3.7, 106.75
+    assert entropy_density(T, g, np) == pytest.approx(
+        (2 * PI**2 / 45) * g * T**3, rel=1e-15
+    )
+
+
+class TestEquilibriumDensity:
+    m, g = 0.95, 2
+
+    def test_relativistic_fermion(self):
+        T = self.m  # T > m/3
+        expected = self.g * (3 * ZETA3 / (4 * PI**2)) * T**3
+        assert n_chi_equilibrium(T, self.m, self.g, "fermion", np) == expected
+
+    def test_relativistic_boson(self):
+        T = self.m
+        expected = self.g * (ZETA3 / PI**2) * T**3
+        assert n_chi_equilibrium(T, self.m, self.g, "boson", np) == expected
+
+    def test_boltzmann_branch(self):
+        T = self.m / 10.0
+        expected = (
+            self.g * (self.m / (2 * PI)) ** 1.5 * T**1.5 * math.exp(-self.m / T)
+        )
+        assert n_chi_equilibrium(T, self.m, self.g, "fermion", np) == pytest.approx(
+            expected, rel=1e-15
+        )
+
+    def test_branch_seam_is_at_m_over_3_exclusive(self):
+        """The predicate is strictly T > m/3 (reference :95): at exactly m/3
+        the Maxwell-Boltzmann branch applies."""
+        T_seam = self.m / 3.0
+        mb = self.g * (self.m / (2 * PI)) ** 1.5 * T_seam**1.5 * math.exp(-3.0)
+        assert n_chi_equilibrium(T_seam, self.m, self.g, "fermion", np) == pytest.approx(
+            mb, rel=1e-14
+        )
+        just_above = np.nextafter(T_seam, np.inf)
+        rel = self.g * (3 * ZETA3 / (4 * PI**2)) * just_above**3
+        assert n_chi_equilibrium(just_above, self.m, self.g, "fermion", np) == rel
+
+    def test_seam_discontinuity_magnitude(self):
+        """The jump at the seam is ~x5.6 for the benchmark mass (SURVEY §2.1)."""
+        T = self.m / 3.0
+        below = n_chi_equilibrium(T, self.m, self.g, "fermion", np)
+        above = n_chi_equilibrium(np.nextafter(T, np.inf), self.m, self.g, "fermion", np)
+        assert 5.0 < above / below < 6.0
+
+    def test_tiny_T_floor_no_warning(self):
+        with np.errstate(over="raise", invalid="raise", divide="raise"):
+            out = n_chi_equilibrium(np.array([0.0, 1e-40]), self.m, self.g, "fermion", np)
+        assert np.all(out == 0.0)
+
+    def test_vectorized_matches_scalar(self):
+        Ts = np.geomspace(1e-3, 10.0, 101) * self.m
+        vec = n_chi_equilibrium(Ts, self.m, self.g, "fermion", np)
+        scl = np.array(
+            [n_chi_equilibrium(float(T), self.m, self.g, "fermion", np) for T in Ts]
+        )
+        np.testing.assert_array_equal(vec, scl)
+
+
+class TestMeanSpeed:
+    def test_relativistic(self):
+        assert mean_speed_chi(1.0, 0.95, np) == 1.0
+
+    def test_nonrelativistic(self):
+        T, m = 0.01, 0.95
+        assert mean_speed_chi(T, m, np) == pytest.approx(
+            math.sqrt(8 * T / (PI * m)), rel=1e-15
+        )
+
+    def test_mass_floor(self):
+        # m floored at 1e-20 (reference :117); T <= m/3 needs tiny T too.
+        T = 1e-30
+        v = mean_speed_chi(T, 1e-25, np)
+        assert v == pytest.approx(math.sqrt(8 * T / (PI * 1e-20)), rel=1e-15)
+
+
+def test_wall_flux_composition():
+    T, m, g = 0.1, 0.95, 2
+    J = wall_flux(T, m, g, "fermion", np)
+    assert J == pytest.approx(
+        0.25
+        * n_chi_equilibrium(T, m, g, "fermion", np)
+        * mean_speed_chi(T, m, np),
+        rel=1e-15,
+    )
