@@ -73,3 +73,24 @@ def point_yields(
     Y_B = integrate_YB_quadrature(pp, static.chi_stats, grid, xp, n_y=static.n_y)
     Y_chi = final_Y_chi_quadrature(pp, static, xp)
     return present_day(Y_B, Y_chi, pp.m_chi_GeV, pp.m_B_kg, xp)
+
+
+def point_yields_fast(
+    pp: PointParams,
+    static: StaticChoices,
+    table,
+    xp,
+    n_y: int = 8000,
+) -> YieldsResult:
+    """Pipeline with the tabulated KJMA kernel — the sweep engine's hot path.
+
+    Identical semantics to :func:`point_yields` for fixed I_p, with the
+    per-y z-integral replaced by a 4-point interpolation into a
+    :class:`bdlz_tpu.ops.kjma_table.KJMATable` (≲1e-11 relative deviation
+    on Y_B, tested): ~1000× fewer transcendentals per point.
+    """
+    from bdlz_tpu.solvers.quadrature import integrate_YB_quadrature_tabulated
+
+    Y_B = integrate_YB_quadrature_tabulated(pp, static.chi_stats, table, xp, n_y=n_y)
+    Y_chi = final_Y_chi_quadrature(pp, static, xp)
+    return present_day(Y_B, Y_chi, pp.m_chi_GeV, pp.m_B_kg, xp)

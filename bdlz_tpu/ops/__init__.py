@@ -1,0 +1,4 @@
+"""Custom ops: the tabulated KJMA kernel and (future) pallas kernels."""
+from bdlz_tpu.ops.kjma_table import KJMATable, eval_f_table, make_f_table
+
+__all__ = ["KJMATable", "make_f_table", "eval_f_table"]

@@ -1,0 +1,57 @@
+"""Grid-sharded (sequence-parallel analog) quadrature.
+
+The reference workload has no sequences or attention; the honest
+sequence/context-parallel axis for this pipeline is the *intra-point*
+quadrature grid (SURVEY §5): for giant-grid convergence studies, one
+point's y-grid is split into contiguous chunks across the mesh's ``sp``
+axis, each device evaluates the integrand on its chunk, and the trapezoid
+reduces with a single ``psum`` over ICI.
+
+Trapezoid-as-weighted-sum: for a uniform grid, ∫ ≈ Σᵢ wᵢ f(yᵢ) with
+wᵢ = dy·(½ at the two global endpoints, 1 elsewhere) — exactly
+``xp.trapezoid`` up to summation order, and embarrassingly shardable: each
+device dots its local f-chunk with its local weights, then one psum.
+"""
+from __future__ import annotations
+
+from bdlz_tpu.config import PointParams, StaticChoices
+from bdlz_tpu.solvers.quadrature import quadrature_bounds, yb_integrand_tabulated
+
+
+def make_sp_quadrature(static: StaticChoices, mesh, n_y: int = 8192):
+    """Build the sp-sharded Y_B quadrature: ``fn(pp, table) -> Y_B``.
+
+    ``n_y`` must be divisible by the mesh's sp size. ``pp`` and ``table``
+    are replicated; only the y-grid is sharded. Returns a jitted function.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    try:
+        shard_map = jax.shard_map  # jax >= 0.6
+    except AttributeError:  # pragma: no cover - older jax
+        from jax.experimental.shard_map import shard_map
+
+    n_sp = mesh.shape["sp"]
+    if n_y % n_sp != 0:
+        raise ValueError(f"n_y={n_y} not divisible by sp={n_sp}")
+    n_local = n_y // n_sp
+
+    def local_piece(pp: PointParams, table):
+        idx = jax.lax.axis_index("sp")
+        y_lo, y_hi = quadrature_bounds(pp, jnp)
+        dy = (y_hi - y_lo) / (n_y - 1)
+        gidx = idx * n_local + jnp.arange(n_local)
+        ys = y_lo + gidx * dy
+        f = yb_integrand_tabulated(ys, pp, static.chi_stats, table, jnp)
+        w = jnp.where((gidx == 0) | (gidx == n_y - 1), 0.5, 1.0) * dy
+        partial_sum = jnp.sum(f * w)
+        YB = jax.lax.psum(partial_sum, "sp")
+        return jnp.where(y_hi > y_lo, YB, 0.0)
+
+    # P() as a pytree-prefix spec: every leaf of pp/table is replicated.
+    sharded = shard_map(
+        local_piece, mesh=mesh, in_specs=(P(), P()), out_specs=P()
+    )
+    return jax.jit(sharded)

@@ -1,0 +1,45 @@
+"""Device-mesh construction and canonical shardings."""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def make_mesh(
+    shape: Optional[Tuple[int, int]] = None,
+    axis_names: Sequence[str] = ("dp", "sp"),
+    devices=None,
+):
+    """Build a 2-D (dp × sp) mesh over the available devices.
+
+    Default: all devices on the batch (dp) axis, sp = 1 — the right layout
+    for parameter sweeps, which are embarrassingly parallel over points.
+    Pass e.g. ``shape=(n // 2, 2)`` to reserve an sp axis for grid-sharded
+    single-point quadrature.
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if shape is None:
+        shape = (n, 1)
+    if shape[0] * shape[1] != n:
+        raise ValueError(f"mesh shape {shape} != device count {n}")
+    dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, axis_names=tuple(axis_names))
+
+
+def batch_sharding(mesh):
+    """Shard a leading batch axis across every mesh axis (dp and sp)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return NamedSharding(mesh, P(tuple(mesh.axis_names)))
+
+
+def replicated_sharding(mesh):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return NamedSharding(mesh, P())

@@ -1,0 +1,292 @@
+"""The mesh-sharded parameter-sweep engine.
+
+This is the capability the north star adds on top of the reference's
+one-point-per-process CLI (`first_principles_yields.py:346-441`): vmapped
+evaluation of the full yields pipeline over flattened (m_DM, m_B, coupling,
+bounce-scale, …) grids, the batch axis sharded across the TPU mesh, with
+chunked execution and a manifest so a preempted sweep resumes at the last
+completed block.
+
+Execution model per chunk (size fixed ⇒ one XLA program for the whole
+sweep):
+
+    host grid block ──device_put(dp-sharded)──▶ jit(vmap(point_yields_fast))
+        └─ per-chip pure compute, no collectives ─▶ host gather, .npz
+
+Failed points (non-finite outputs — e.g. absurd parameter corners) are
+masked and reported per chunk, never aborting the sweep (SURVEY §5
+"mask-and-report").
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, Mapping, Optional, Sequence
+
+import numpy as np
+
+from bdlz_tpu.config import Config, PointParams, StaticChoices, point_params_from_config
+from bdlz_tpu.constants import GEV_TO_KG, M_PROTON_KG
+
+#: Config-key → PointParams-field mapping for sweep axes (JSON-schema names
+#: on the left, the internal dynamic-parameter names on the right).
+AXIS_MAP: Dict[str, str] = {
+    "m_chi_GeV": "m_chi_GeV",
+    "g_chi": "g_chi",
+    "T_p_GeV": "T_p_GeV",
+    "beta_over_H": "beta_over_H",
+    "v_w": "v_w",
+    "I_p": "I_p",
+    "g_star": "g_star",
+    "g_star_s": "g_star_s",
+    "P_chi_to_B": "P",
+    "source_shape_sigma_y": "sigma_y",
+    "incident_flux_scale": "flux_scale",
+    "Y_chi_init": "Y_chi_init",
+    "m_B_GeV": "m_B_kg",
+    "T_max_over_Tp": "T_max_over_Tp",
+    "T_min_over_Tp": "T_min_over_Tp",
+    "sigma_v_chi_GeV_m2": "sigma_v",
+    "Gamma_wash_over_H": "Gamma_wash_over_H",
+}
+
+
+def build_grid(
+    base: Config,
+    axes: Mapping[str, Sequence[float]],
+    P_base: Optional[float] = None,
+    product: bool = True,
+) -> PointParams:
+    """Flatten sweep axes into a PointParams-of-arrays.
+
+    ``axes`` maps config-schema key names (see AXIS_MAP) to 1-D value
+    lists. ``product=True`` takes the full cartesian product (a 4-entry
+    dict of lengths (a,b,c,d) → a·b·c·d points, C-order so the *first*
+    axis varies slowest); ``product=False`` zips equal-length axes.
+    """
+    unknown = sorted(set(axes) - set(AXIS_MAP))
+    if unknown:
+        raise ValueError(f"Unknown sweep axes {unknown}; valid: {sorted(AXIS_MAP)}")
+
+    pp0 = point_params_from_config(base, base.P_chi_to_B if P_base is None else P_base)
+
+    values = [np.asarray(v, dtype=np.float64) for v in axes.values()]
+    if product:
+        mesh_vals = np.meshgrid(*values, indexing="ij")
+        cols = [m.reshape(-1) for m in mesh_vals]
+    else:
+        n = len(values[0])
+        if any(len(v) != n for v in values):
+            raise ValueError("product=False requires equal-length axes")
+        cols = values
+    n_points = len(cols[0]) if cols else 1
+
+    fields = {f: np.full(n_points, getattr(pp0, f), dtype=np.float64)
+              for f in PointParams._fields}
+    for key, col in zip(axes.keys(), cols):
+        pf = AXIS_MAP[key]
+        if key == "m_B_GeV":
+            col = col * GEV_TO_KG
+        fields[pf] = np.asarray(col, dtype=np.float64)
+    return PointParams(**fields)
+
+
+def grid_hash(base: Config, axes: Mapping[str, Sequence[float]], n_y: int) -> str:
+    """Identity of a sweep for resume validation: base config + axes + grid."""
+    import dataclasses
+
+    payload = {
+        "base": dataclasses.asdict(base),
+        "axes": {k: list(map(float, v)) for k, v in axes.items()},
+        "n_y": n_y,
+    }
+    return hashlib.sha256(json.dumps(payload, sort_keys=True).encode()).hexdigest()[:16]
+
+
+def make_sweep_step(static: StaticChoices, mesh=None, n_y: int = 8000, use_table: bool = True):
+    """Compile the per-chunk step: vmapped pipeline, batch sharded over the mesh.
+
+    Returns ``step(pp_chunk, table_or_grid) -> YieldsResult`` of arrays.
+    With a mesh, inputs are expected dp-sharded (see ``shard_chunk``); XLA
+    compiles a pure SPMD program with no collectives.
+    """
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    import jax.numpy as jnp
+
+    from bdlz_tpu.models.yields_pipeline import point_yields, point_yields_fast
+
+    if use_table:
+        def one(pp, table):
+            return point_yields_fast(pp, static, table, jnp, n_y=n_y)
+    else:
+        def one(pp, grid):
+            return point_yields(pp, static, grid, jnp)
+
+    batched = jax.vmap(one, in_axes=(0, None))
+
+    if mesh is None:
+        return jax.jit(batched)
+
+    from bdlz_tpu.parallel.mesh import batch_sharding, replicated_sharding
+
+    return jax.jit(
+        batched,
+        in_shardings=(
+            jax.tree.map(lambda _: batch_sharding(mesh), PointParams(*PointParams._fields)),
+            None,
+        ),
+        out_shardings=batch_sharding(mesh),
+    )
+
+
+def sweep_step(pp_chunk: PointParams, static: StaticChoices, table, mesh=None, n_y: int = 8000):
+    """One-shot convenience wrapper around :func:`make_sweep_step`."""
+    step = make_sweep_step(static, mesh=mesh, n_y=n_y, use_table=True)
+    return step(pp_chunk, table)
+
+
+@dataclass
+class SweepResult:
+    n_points: int
+    n_failed: int
+    seconds: float
+    points_per_sec: float
+    out_dir: Optional[str]
+    chunks: int
+    resumed_chunks: int = 0
+    outputs: Optional[Dict[str, np.ndarray]] = field(default=None, repr=False)
+
+
+def _pad_chunk(pp: PointParams, lo: int, hi: int, chunk: int) -> PointParams:
+    """Slice [lo:hi] padded to `chunk` by repeating the last point (masked out later)."""
+    def cut(a):
+        seg = a[lo:hi]
+        if len(seg) < chunk:
+            seg = np.concatenate([seg, np.repeat(seg[-1:], chunk - len(seg), axis=0)])
+        return seg
+    return PointParams(*(cut(np.asarray(f)) for f in pp))
+
+
+def run_sweep(
+    base: Config,
+    axes: Mapping[str, Sequence[float]],
+    static: StaticChoices,
+    mesh=None,
+    chunk_size: int = 4096,
+    n_y: int = 8000,
+    out_dir: Optional[str] = None,
+    keep_outputs: bool = True,
+    table_nodes: int = 16384,
+) -> SweepResult:
+    """Run a full sweep: grid build → per-chunk jitted sharded evaluation →
+    (optional) chunk files + manifest with resume.
+
+    If ``axes`` sweeps I_p the tabulated fast path is invalid (the F-table
+    is per-I_p); the engine falls back to the direct (n_y × n_z) kernel
+    automatically.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from bdlz_tpu.models.yields_pipeline import YieldsResult
+    from bdlz_tpu.ops.kjma_table import make_f_table
+    from bdlz_tpu.physics.percolation import make_kjma_grid
+
+    pp_all = build_grid(base, axes)
+    n_total = len(np.asarray(pp_all.m_chi_GeV))
+    if mesh is not None:
+        # The sharded batch axis must divide evenly across the mesh; chunks
+        # are padded to chunk_size, so just round chunk_size itself up.
+        n_dev = int(mesh.devices.size)
+        chunk_size = ((max(chunk_size, n_dev) + n_dev - 1) // n_dev) * n_dev
+    use_table = "I_p" not in axes
+    aux = (
+        make_f_table(float(base.I_p), jnp, n=table_nodes)
+        if use_table
+        else make_kjma_grid(jnp)
+    )
+    step = make_sweep_step(static, mesh=mesh, n_y=n_y, use_table=use_table)
+
+    manifest_path = None
+    manifest: Dict[str, Any] = {}
+    h = grid_hash(base, axes, n_y)
+    if out_dir is not None:
+        import os
+
+        os.makedirs(out_dir, exist_ok=True)
+        manifest_path = f"{out_dir}/manifest.json"
+        if os.path.exists(manifest_path):
+            with open(manifest_path) as f:
+                manifest = json.load(f)
+            if manifest.get("hash") != h:
+                manifest = {}
+        manifest.setdefault("hash", h)
+        manifest.setdefault("n_total", n_total)
+        manifest.setdefault("chunk_size", chunk_size)
+        manifest.setdefault("chunks", {})
+
+    n_chunks = (n_total + chunk_size - 1) // chunk_size
+    fields = YieldsResult._fields
+    collected = {f: [] for f in fields} if keep_outputs else None
+    n_failed = 0
+    resumed = 0
+    t0 = time.time()
+
+    for ci in range(n_chunks):
+        lo, hi = ci * chunk_size, min((ci + 1) * chunk_size, n_total)
+        n_valid = hi - lo
+        chunk_file = f"{out_dir}/chunk_{ci:05d}.npz" if out_dir else None
+
+        if manifest and str(ci) in manifest["chunks"]:
+            resumed += 1
+            if keep_outputs and chunk_file:
+                data = np.load(chunk_file)
+                for f in fields:
+                    collected[f].append(data[f])
+                n_failed += int(manifest["chunks"][str(ci)]["n_failed"])
+            continue
+
+        pp_chunk = _pad_chunk(pp_all, lo, hi, chunk_size)
+        if mesh is not None:
+            from bdlz_tpu.parallel.mesh import batch_sharding
+
+            sharding = batch_sharding(mesh)
+            pp_chunk = jax.tree.map(
+                lambda a: jax.device_put(jnp.asarray(a), sharding), pp_chunk
+            )
+        res = step(pp_chunk, aux)
+        host = {f: np.asarray(getattr(res, f))[:n_valid] for f in fields}
+        bad = ~np.isfinite(host["DM_over_B"])
+        n_failed += int(bad.sum())
+
+        if chunk_file:
+            np.savez(chunk_file, **host, failed=bad)
+            manifest["chunks"][str(ci)] = {
+                "file": chunk_file,
+                "n_valid": n_valid,
+                "n_failed": int(bad.sum()),
+            }
+            with open(manifest_path, "w") as f:
+                json.dump(manifest, f)
+        if keep_outputs:
+            for f in fields:
+                collected[f].append(host[f])
+
+    seconds = time.time() - t0
+    outputs = (
+        {f: np.concatenate(collected[f]) for f in fields} if keep_outputs else None
+    )
+    return SweepResult(
+        n_points=n_total,
+        n_failed=n_failed,
+        seconds=seconds,
+        points_per_sec=n_total / max(seconds, 1e-9),
+        out_dir=out_dir,
+        chunks=n_chunks,
+        resumed_chunks=resumed,
+        outputs=outputs,
+    )

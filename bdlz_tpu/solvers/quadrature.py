@@ -83,3 +83,69 @@ def integrate_YB_quadrature(
     integrand = SB / (ss * Hs * Ts) * xp.abs(dTdy)
     YB = xp.trapezoid(integrand, ys)
     return xp.where(y_hi > y_lo, YB, 0.0)
+
+
+def quadrature_bounds(pp: PointParams, xp):
+    """Clipped y-integration bounds for a point (reference :234-241)."""
+    T_hi = pp.T_max_over_Tp * pp.T_p_GeV
+    T_lo = pp.T_min_over_Tp * pp.T_p_GeV
+    y_lo = xp.maximum(y_of_T(T_hi, pp.T_p_GeV, pp.beta_over_H, xp), Y_NEG_CUT)
+    y_hi = xp.minimum(y_of_T(T_lo, pp.T_p_GeV, pp.beta_over_H, xp), Y_POS_CUT)
+    return y_lo, y_hi
+
+
+def yb_integrand_tabulated(ys: Array, pp: PointParams, chi_stats: str, table, xp) -> Array:
+    """dY_B/dy at the given y-nodes, with the tabulated KJMA kernel.
+
+    The full quadrature integrand S_B/(s·H·T)·|dT/dy| — shared by the
+    per-point fast path and the grid-sharded (sp) path, which evaluates it
+    on per-device y-chunks and psums the weighted partial sums.
+    """
+    from bdlz_tpu.ops.kjma_table import area_over_volume_tabulated
+
+    B_safe = xp.maximum(pp.beta_over_H, 1e-30)
+    denom = xp.maximum(1.0 + 2.0 * ys / B_safe, 1e-12)
+    Ts = pp.T_p_GeV / xp.sqrt(denom)
+    dTdy = -(pp.T_p_GeV / B_safe) * denom ** (-1.5)
+
+    Hs = hubble_rate(Ts, pp.g_star, xp)
+    ss = entropy_density(Ts, pp.g_star_s, xp)
+    Js = (
+        pp.flux_scale
+        * 0.25
+        * n_chi_equilibrium(Ts, pp.m_chi_GeV, pp.g_chi, chi_stats, xp)
+        * mean_speed_chi(Ts, pp.m_chi_GeV, xp)
+    )
+    Av = area_over_volume_tabulated(
+        ys, pp.beta_over_H, pp.T_p_GeV, pp.v_w, pp.g_star, table, xp
+    )
+    SB = pp.P * Js * Av * source_window(ys, pp.sigma_y, xp)
+    return SB / (ss * Hs * Ts) * xp.abs(dTdy)
+
+
+def integrate_YB_quadrature_tabulated(
+    pp: PointParams,
+    chi_stats: str,
+    table,
+    xp,
+    n_y: int = 8000,
+) -> Array:
+    """Fast-path Y_B: identical quadrature with the KJMA z-integral looked
+    up from a :class:`bdlz_tpu.ops.kjma_table.KJMATable` instead of
+    re-integrated per y.
+
+    This is the sweep engine's hot path: ~2e3 fused interpolation flops per
+    point instead of ~2.4e6 transcendentals, with the table built from the
+    exact reference z-trapezoid so there is no scheme bias — only the
+    interpolation error (≲1e-11 on Y_B, tested on randomized configs). The
+    default n_y = 8000 matches the reference CLI's grid (:374) so the only
+    deviation from the direct path is the interpolation itself; the
+    y-integrand is smooth, so n_y can be lowered to 2000 (the reference's
+    floor, :246) for a further ~4x when ~1e-5 agreement suffices.
+    """
+    n_y = max(int(n_y), 2000)
+    y_lo, y_hi = quadrature_bounds(pp, xp)
+    ys = xp.linspace(y_lo, y_hi, n_y)
+    integrand = yb_integrand_tabulated(ys, pp, chi_stats, table, xp)
+    YB = xp.trapezoid(integrand, ys)
+    return xp.where(y_hi > y_lo, YB, 0.0)

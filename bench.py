@@ -1,0 +1,137 @@
+#!/usr/bin/env python3
+"""Benchmark: parameter-sweep throughput of the TPU yields pipeline.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
+
+Metric: parameter-grid points/sec through the full flagship pipeline
+(PointParams → Y_B quadrature → present-day Ω ratio) using the tabulated
+KJMA fast path on a 4-D (m_χ, T_p, P, v_w) grid, batch sharded over all
+local devices. Baseline: the measured reference throughput of 4.3
+points/sec/core (BASELINE.md — SciPy pipeline, single CPU core), so
+``vs_baseline`` is the speedup over the reference implementation.
+
+Accuracy gate: before timing, a sample of points is checked against the
+bit-reproducible NumPy reference path; the max relative error on Ω_DM/Ω_b
+is reported in the JSON line and must stay ≤1e-6 (north-star contract).
+
+Env knobs: BDLZ_BENCH_POINTS (default 262144), BDLZ_BENCH_CHUNK (default
+65536), BDLZ_BENCH_NY (default 8000), BDLZ_BENCH_PLATFORM=cpu to force the
+host platform (debug only).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def main() -> None:
+    if os.environ.get("BDLZ_BENCH_PLATFORM") == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    import jax.numpy as jnp
+    import numpy as np
+
+    from bdlz_tpu.config import config_from_dict, static_choices_from_config
+    from bdlz_tpu.models.yields_pipeline import point_yields, point_yields_fast
+    from bdlz_tpu.ops.kjma_table import make_f_table
+    from bdlz_tpu.parallel.mesh import batch_sharding, make_mesh
+    from bdlz_tpu.parallel.sweep import build_grid, _pad_chunk
+    from bdlz_tpu.physics.percolation import make_kjma_grid
+
+    n_points = int(os.environ.get("BDLZ_BENCH_POINTS", 262144))
+    chunk = int(os.environ.get("BDLZ_BENCH_CHUNK", 65536))
+    n_y = int(os.environ.get("BDLZ_BENCH_NY", 8000))
+
+    devices = jax.devices()
+    n_dev = len(devices)
+    chunk = ((chunk + n_dev - 1) // n_dev) * n_dev
+
+    base = config_from_dict(
+        {
+            "regime": "nonthermal",
+            "P_chi_to_B": 0.14925839040304145,
+            "source_shape_sigma_y": 9.0,
+            "incident_flux_scale": 1.07e-9,
+            "Y_chi_init": 4.90e-10,
+        }
+    )
+    static = static_choices_from_config(base)
+
+    # 4-D grid around the archived benchmark point (BASELINE.json configs).
+    side = max(2, int(round(n_points ** 0.25)))
+    axes = {
+        "m_chi_GeV": np.geomspace(0.1, 10.0, side),
+        "T_p_GeV": np.geomspace(30.0, 300.0, side),
+        "P_chi_to_B": np.linspace(0.02, 0.9, side),
+        "v_w": np.linspace(0.05, 0.9, side),
+    }
+    pp_all = build_grid(base, axes)
+    n_total = int(np.asarray(pp_all.m_chi_GeV).shape[0])
+
+    mesh = make_mesh(shape=(n_dev, 1))
+    sharding = batch_sharding(mesh)
+    table = make_f_table(base.I_p, jnp)
+
+    batched = jax.jit(
+        jax.vmap(lambda p: point_yields_fast(p, static, table, jnp, n_y=n_y).DM_over_B)
+    )
+
+    def run_chunk(lo: int, hi: int):
+        ppc = _pad_chunk(pp_all, lo, hi, chunk)
+        ppc = jax.tree.map(lambda a: jax.device_put(jnp.asarray(a), sharding), ppc)
+        return batched(ppc)
+
+    # --- accuracy gate: sample vs the NumPy reference path ---
+    rng = np.random.default_rng(0)
+    sample = rng.choice(n_total, size=8, replace=False)
+    grid_np = make_kjma_grid(np)
+    max_rel = 0.0
+    ratios0 = np.asarray(run_chunk(0, min(chunk, n_total)))  # also warms up/compiles
+    for i in sample:
+        pp_i = type(pp_all)(*(float(np.asarray(f)[i]) for f in pp_all))
+        ref = float(point_yields(pp_i, static, grid_np, np).DM_over_B)
+        lo_c = (i // chunk) * chunk
+        if lo_c == 0:
+            got = float(ratios0[i - lo_c])
+        else:
+            got = float(np.asarray(run_chunk(lo_c, min(lo_c + chunk, n_total)))[i - lo_c])
+        if ref != 0.0:
+            max_rel = max(max_rel, abs(got / ref - 1.0))
+
+    # --- timed sweep over the full grid ---
+    t0 = time.time()
+    done = 0
+    while done < n_total:
+        hi = min(done + chunk, n_total)
+        out = run_chunk(done, hi)
+        done = hi
+    out.block_until_ready()
+    seconds = time.time() - t0
+
+    pps = n_total / seconds
+    per_chip = pps / n_dev
+    print(
+        json.dumps(
+            {
+                "metric": "sweep_points_per_sec_per_chip",
+                "value": round(per_chip, 2),
+                "unit": "param-points/sec/chip (full pipeline, n_y=%d)" % n_y,
+                "vs_baseline": round(per_chip / 4.3, 1),
+                "n_points": n_total,
+                "n_devices": n_dev,
+                "seconds": round(seconds, 3),
+                "rel_err_vs_reference": float(f"{max_rel:.3e}"),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())
