@@ -28,3 +28,12 @@ M_PROTON_KG: float = 1.67262192369e-27
 
 #: Planck 2018 target for Omega_DM / Omega_b (reference PDF section 7, Eq. 22).
 PLANCK_DM_OVER_B: float = 5.357
+
+#: Critical density / h^2, kg m^-3 (Planck-normalisation for Omega h^2).
+RHO_CRIT_OVER_H2_KG_M3: float = 1.87834e-26
+
+#: Planck 2018 baryon / cold-DM density measurements (TT,TE,EE+lowE+lensing).
+PLANCK_OMEGA_B_H2: float = 0.02237
+PLANCK_OMEGA_B_H2_SIGMA: float = 0.00015
+PLANCK_OMEGA_DM_H2: float = 0.1200
+PLANCK_OMEGA_DM_H2_SIGMA: float = 0.0012

@@ -33,14 +33,31 @@ class ProfileError(ValueError):
     """Raised for malformed profile files."""
 
 
-def load_profile_csv(path: str) -> BounceProfile:
+def _read_csv(path: str):
+    """(column_names, data[rows, cols]) — native C++ parser when available
+    (bdlz_tpu.native, ~40× faster on large profiles), NumPy otherwise."""
+    try:
+        from bdlz_tpu.native import read_csv_native
+
+        return read_csv_native(path)
+    except Exception:
+        pass
     data = np.genfromtxt(path, delimiter=",", names=True, dtype=float)
     if data.dtype.names is None:
         raise ProfileError(f"{path}: expected a CSV header row")
-    names = {n.lower(): n for n in data.dtype.names}
+    names = list(data.dtype.names)
+    table = np.column_stack([np.atleast_1d(np.asarray(data[n], float)) for n in names])
+    return names, table
+
+
+def load_profile_csv(path: str) -> BounceProfile:
+    raw_names, table = _read_csv(path)
+    if table.ndim != 2 or table.shape[0] < 1:
+        raise ProfileError(f"{path}: no data rows")
+    names = {n.lower(): i for i, n in enumerate(raw_names)}
 
     def col(key: str) -> np.ndarray:
-        return np.atleast_1d(np.asarray(data[names[key]], dtype=float))
+        return np.atleast_1d(table[:, names[key]].astype(float))
 
     if "xi" not in names:
         raise ProfileError(f"{path}: missing required column 'xi' (has {list(names)})")

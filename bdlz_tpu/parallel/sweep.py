@@ -181,6 +181,8 @@ def run_sweep(
     out_dir: Optional[str] = None,
     keep_outputs: bool = True,
     table_nodes: int = 16384,
+    event_log=None,
+    trace_dir: Optional[str] = None,
 ) -> SweepResult:
     """Run a full sweep: grid build → per-chunk jitted sharded evaluation →
     (optional) chunk files + manifest with resume.
@@ -236,6 +238,14 @@ def run_sweep(
     resumed = 0
     t0 = time.time()
 
+    from bdlz_tpu.utils.profiling import trace as profiler_trace
+
+    if event_log is not None:
+        event_log.emit(
+            "sweep_start", n_points=n_total, chunks=n_chunks,
+            chunk_size=chunk_size, hash=h, use_table=use_table,
+        )
+
     for ci in range(n_chunks):
         lo, hi = ci * chunk_size, min((ci + 1) * chunk_size, n_total)
         n_valid = hi - lo
@@ -258,10 +268,17 @@ def run_sweep(
             pp_chunk = jax.tree.map(
                 lambda a: jax.device_put(jnp.asarray(a), sharding), pp_chunk
             )
-        res = step(pp_chunk, aux)
-        host = {f: np.asarray(getattr(res, f))[:n_valid] for f in fields}
+        t_chunk = time.time()
+        with profiler_trace(trace_dir):
+            res = step(pp_chunk, aux)
+            host = {f: np.asarray(getattr(res, f))[:n_valid] for f in fields}
         bad = ~np.isfinite(host["DM_over_B"])
         n_failed += int(bad.sum())
+        if event_log is not None:
+            event_log.emit(
+                "chunk_done", chunk=ci, n_valid=n_valid,
+                n_failed=int(bad.sum()), seconds=round(time.time() - t_chunk, 4),
+            )
 
         if chunk_file:
             np.savez(chunk_file, **host, failed=bad)

@@ -1,0 +1,24 @@
+"""Ensemble MCMC layer: native JAX affine-invariant sampling.
+
+emcee is not installable in this environment (no network), so the
+Goodman–Weare stretch move is implemented natively (SURVEY §2.3): walkers
+live in a single device array, both red-black half-updates are vmapped,
+chains run under `lax.scan`, and the walker axis shards across the mesh
+like any other batch axis. The physics likelihood is the vmapped yields
+pipeline mapped to (Ω_b h², Ω_DM h²) against the Planck 2018 measurements.
+"""
+from bdlz_tpu.sampling.ensemble import EnsembleState, run_ensemble, stretch_step
+from bdlz_tpu.sampling.likelihoods import (
+    make_pipeline_logprob,
+    omegas_from_result,
+    planck_gaussian_logp,
+)
+
+__all__ = [
+    "run_ensemble",
+    "stretch_step",
+    "EnsembleState",
+    "planck_gaussian_logp",
+    "make_pipeline_logprob",
+    "omegas_from_result",
+]

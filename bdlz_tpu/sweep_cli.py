@@ -1,0 +1,118 @@
+"""Sweep driver CLI: grid scans from the command line.
+
+The single-point CLI (`bdlz_tpu.cli`) keeps the reference's surface; this
+command adds the capability the reference lacks — multi-dimensional
+parameter scans on the TPU mesh:
+
+    python -m bdlz_tpu.sweep_cli \\
+        --config yields_config_equal_mass.json \\
+        --axis "m_chi_GeV=geom:0.1:10:64" --axis "P_chi_to_B=lin:0.01:0.9:16" \\
+        --out sweep_out --chunk 8192
+
+Axis syntax: ``name=geom:start:stop:n`` (geomspace), ``lin:start:stop:n``
+(linspace), or an explicit comma list ``name=0.1,0.5,1.0``. Outputs land in
+``--out`` as chunk .npz files plus a manifest (resumable); a JSON summary
+(throughput, failures, best Planck-likelihood point) goes to stdout.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+from typing import Dict
+
+import numpy as np
+
+
+def parse_axis(spec: str):
+    name, _, rhs = spec.partition("=")
+    if not rhs:
+        raise ValueError(f"--axis must look like name=geom:a:b:n, got {spec!r}")
+    if rhs.startswith(("geom:", "lin:")):
+        kind, a, b, n = rhs.split(":")
+        a, b, n = float(a), float(b), int(n)
+        vals = np.geomspace(a, b, n) if kind == "geom" else np.linspace(a, b, n)
+    else:
+        vals = np.asarray([float(v) for v in rhs.split(",")])
+    return name.strip(), vals
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description="bdlz_tpu parameter-sweep driver")
+    ap.add_argument("--config", required=True, help="Base yields_config JSON")
+    ap.add_argument("--axis", action="append", default=[], required=False,
+                    help="Sweep axis, e.g. m_chi_GeV=geom:0.1:10:64 (repeatable)")
+    ap.add_argument("--out", default=None, help="Output dir (chunks + manifest; resumable)")
+    ap.add_argument("--chunk", type=int, default=8192)
+    ap.add_argument("--n-y", type=int, default=8000, dest="n_y")
+    ap.add_argument("--mesh-sp", type=int, default=1,
+                    help="Devices reserved for the sp (grid) mesh axis")
+    ap.add_argument("--events", default=None,
+                    help="Write JSON-lines sweep events to this file")
+    ap.add_argument("--profile-dir", default=None,
+                    help="Capture a jax.profiler trace per chunk into this dir")
+    ap.add_argument("--debug-nans", action="store_true",
+                    help="Raise on any NaN produced under jit (sanitizer mode)")
+    args = ap.parse_args(argv)
+
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    if args.debug_nans:
+        from bdlz_tpu.utils.profiling import enable_nan_debugging
+
+        enable_nan_debugging(True)
+
+    from bdlz_tpu.config import load_config, static_choices_from_config, validate
+    from bdlz_tpu.constants import PLANCK_DM_OVER_B
+    from bdlz_tpu.parallel import make_mesh, run_sweep
+
+    cfg = validate(load_config(args.config))
+    axes: Dict[str, np.ndarray] = dict(parse_axis(s) for s in args.axis)
+    if not axes:
+        raise SystemExit("at least one --axis is required")
+
+    n_dev = len(jax.devices())
+    sp = max(1, args.mesh_sp)
+    if n_dev % sp:
+        raise SystemExit(f"--mesh-sp {sp} does not divide device count {n_dev}")
+    mesh = make_mesh(shape=(n_dev // sp, sp))
+
+    event_log = None
+    if args.events:
+        from bdlz_tpu.utils.logging import EventLog
+
+        event_log = EventLog(path=args.events)
+
+    res = run_sweep(
+        cfg, axes, static_choices_from_config(cfg),
+        mesh=mesh, chunk_size=args.chunk, n_y=args.n_y, out_dir=args.out,
+        event_log=event_log, trace_dir=args.profile_dir,
+    )
+
+    ratios = res.outputs["DM_over_B"]
+    finite = np.isfinite(ratios)
+    best = int(np.argmin(np.abs(np.where(finite, ratios, np.inf) - PLANCK_DM_OVER_B)))
+    # recover the best point's axis values from its flat index (C-order grid)
+    shape = tuple(len(v) for v in axes.values())
+    best_idx = np.unravel_index(best, shape)
+    best_params = {
+        name: float(vals[i]) for (name, vals), i in zip(axes.items(), best_idx)
+    }
+    print(json.dumps({
+        "n_points": res.n_points,
+        "n_failed": res.n_failed,
+        "seconds": round(res.seconds, 3),
+        "points_per_sec": round(res.points_per_sec, 1),
+        "resumed_chunks": res.resumed_chunks,
+        "out_dir": res.out_dir,
+        "closest_to_planck": {
+            "index": best,
+            "DM_over_B": float(ratios[best]),
+            "target": PLANCK_DM_OVER_B,
+            "params": best_params,
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,54 @@
+"""Tracing / profiling utilities (SURVEY §5: none in the reference —
+print-statements only; here: jax.profiler traces + throughput reporting).
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+from dataclasses import dataclass
+
+
+@contextlib.contextmanager
+def trace(trace_dir: str | None):
+    """Wrap a region in a jax.profiler trace (viewable in TensorBoard /
+    xprof). No-op when trace_dir is None."""
+    if trace_dir is None:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(trace_dir):
+        yield
+
+
+@dataclass
+class Throughput:
+    """Simple wall-clock throughput meter for sweep blocks."""
+
+    n_items: int = 0
+    seconds: float = 0.0
+    _t0: float | None = None
+
+    def __enter__(self):
+        self._t0 = time.time()
+        return self
+
+    def __exit__(self, *exc):
+        self.seconds += time.time() - self._t0
+        self._t0 = None
+
+    def add(self, n: int) -> None:
+        self.n_items += n
+
+    @property
+    def per_sec(self) -> float:
+        return self.n_items / max(self.seconds, 1e-9)
+
+
+def enable_nan_debugging(enable: bool = True) -> None:
+    """NaN-checking mode — the numerical analog of a sanitizer (SURVEY §5):
+    the reference papers over edge cases with floors (1e-30…1e-300); this
+    makes any NaN produced under jit raise with a traceback instead."""
+    import jax
+
+    jax.config.update("jax_debug_nans", enable)

