@@ -37,11 +37,14 @@ def _read_csv(path: str):
     """(column_names, data[rows, cols]) — native C++ parser when available
     (bdlz_tpu.native, ~40× faster on large profiles), NumPy otherwise."""
     try:
-        from bdlz_tpu.native import read_csv_native
+        from bdlz_tpu.native import NativeParseError, read_csv_native
 
-        return read_csv_native(path)
-    except Exception:
-        pass
+        try:
+            return read_csv_native(path)
+        except NativeParseError as e:
+            raise ProfileError(str(e)) from e  # uniform parse-failure contract
+    except OSError:
+        pass  # library unavailable → NumPy fallback
     data = np.genfromtxt(path, delimiter=",", names=True, dtype=float)
     if data.dtype.names is None:
         raise ProfileError(f"{path}: expected a CSV header row")

@@ -39,6 +39,10 @@ def main(argv=None) -> None:
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--out", default=None, help="Write the chain to this .npz")
     args = ap.parse_args(argv)
+    if not 0 <= args.burn < args.steps:
+        raise SystemExit(
+            f"--burn {args.burn} must satisfy 0 <= burn < --steps {args.steps}"
+        )
 
     import jax
 

@@ -253,11 +253,11 @@ def run_sweep(
 
         if manifest and str(ci) in manifest["chunks"]:
             resumed += 1
+            n_failed += int(manifest["chunks"][str(ci)]["n_failed"])
             if keep_outputs and chunk_file:
                 data = np.load(chunk_file)
                 for f in fields:
                     collected[f].append(data[f])
-                n_failed += int(manifest["chunks"][str(ci)]["n_failed"])
             continue
 
         pp_chunk = _pad_chunk(pp_all, lo, hi, chunk_size)

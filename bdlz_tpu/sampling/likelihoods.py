@@ -15,6 +15,7 @@ import jax.numpy as jnp
 
 from bdlz_tpu.config import Config, PointParams, StaticChoices, point_params_from_config
 from bdlz_tpu.constants import (
+    GEV_TO_KG,
     PLANCK_OMEGA_B_H2,
     PLANCK_OMEGA_B_H2_SIGMA,
     PLANCK_OMEGA_DM_H2,
@@ -61,6 +62,12 @@ def make_pipeline_logprob(
     for k in param_keys:
         if k not in AXIS_MAP:
             raise ValueError(f"unknown parameter {k!r}; valid: {sorted(AXIS_MAP)}")
+    if "I_p" in param_keys:
+        raise ValueError(
+            "I_p cannot be a sampled parameter on the tabulated fast path: "
+            "the KJMA F-table is built for one I_p (see run_sweep's "
+            "use_table guard); pin I_p or sample with the direct kernel"
+        )
     bounds = dict(bounds or {})
     pp0 = point_params_from_config(base, base.P_chi_to_B or 0.0)
 
@@ -75,8 +82,10 @@ def make_pipeline_logprob(
                 lo, hi = bounds[k]
                 inside = jnp.logical_and(theta[i] >= lo, theta[i] <= hi)
                 lp = jnp.where(inside, lp, -jnp.inf)
+            if k == "m_B_GeV":
+                v = v * GEV_TO_KG  # PointParams stores the baryon mass in kg
             values[AXIS_MAP[k]] = v
-        pp = pp0._replace(**{f: jnp.asarray(v) for f, v in values.items()})
+        pp = pp0._replace(**values)
         pp = PointParams(*(jnp.asarray(f) for f in pp))
         res = point_yields_fast(pp, static, table, jnp, n_y=n_y)
         ob, od = omegas_from_result(res)

@@ -91,13 +91,21 @@ def main(argv=None) -> None:
 
     ratios = res.outputs["DM_over_B"]
     finite = np.isfinite(ratios)
-    best = int(np.argmin(np.abs(np.where(finite, ratios, np.inf) - PLANCK_DM_OVER_B)))
-    # recover the best point's axis values from its flat index (C-order grid)
-    shape = tuple(len(v) for v in axes.values())
-    best_idx = np.unravel_index(best, shape)
-    best_params = {
-        name: float(vals[i]) for (name, vals), i in zip(axes.items(), best_idx)
-    }
+    if finite.any():
+        best = int(np.argmin(np.abs(np.where(finite, ratios, np.inf) - PLANCK_DM_OVER_B)))
+        # recover the best point's axis values from its flat index (C-order grid)
+        shape = tuple(len(v) for v in axes.values())
+        best_idx = np.unravel_index(best, shape)
+        closest = {
+            "index": best,
+            "DM_over_B": float(ratios[best]),
+            "target": PLANCK_DM_OVER_B,
+            "params": {
+                name: float(vals[i]) for (name, vals), i in zip(axes.items(), best_idx)
+            },
+        }
+    else:
+        closest = None  # every point failed; keep the summary strict JSON
     print(json.dumps({
         "n_points": res.n_points,
         "n_failed": res.n_failed,
@@ -105,12 +113,7 @@ def main(argv=None) -> None:
         "points_per_sec": round(res.points_per_sec, 1),
         "resumed_chunks": res.resumed_chunks,
         "out_dir": res.out_dir,
-        "closest_to_planck": {
-            "index": best,
-            "DM_over_B": float(ratios[best]),
-            "target": PLANCK_DM_OVER_B,
-            "params": best_params,
-        },
+        "closest_to_planck": closest,
     }))
 
 

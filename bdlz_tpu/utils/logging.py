@@ -39,7 +39,3 @@ class EventLog:
         if self._fh is not None and self._path is not None:
             self._fh.close()
             self._fh = None
-
-
-#: Default process-wide logger (stderr). Swap for a file logger in drivers.
-log = EventLog()

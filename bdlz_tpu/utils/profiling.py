@@ -4,8 +4,6 @@ print-statements only; here: jax.profiler traces + throughput reporting).
 from __future__ import annotations
 
 import contextlib
-import time
-from dataclasses import dataclass
 
 
 @contextlib.contextmanager
@@ -19,30 +17,6 @@ def trace(trace_dir: str | None):
 
     with jax.profiler.trace(trace_dir):
         yield
-
-
-@dataclass
-class Throughput:
-    """Simple wall-clock throughput meter for sweep blocks."""
-
-    n_items: int = 0
-    seconds: float = 0.0
-    _t0: float | None = None
-
-    def __enter__(self):
-        self._t0 = time.time()
-        return self
-
-    def __exit__(self, *exc):
-        self.seconds += time.time() - self._t0
-        self._t0 = None
-
-    def add(self, n: int) -> None:
-        self.n_items += n
-
-    @property
-    def per_sec(self) -> float:
-        return self.n_items / max(self.seconds, 1e-9)
 
 
 def enable_nan_debugging(enable: bool = True) -> None:

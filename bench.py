@@ -16,8 +16,9 @@ bit-reproducible NumPy reference path; the max relative error on Ω_DM/Ω_b
 is reported in the JSON line and must stay ≤1e-6 (north-star contract).
 
 Env knobs: BDLZ_BENCH_POINTS (default 262144), BDLZ_BENCH_CHUNK (default
-65536), BDLZ_BENCH_NY (default 8000), BDLZ_BENCH_PLATFORM=cpu to force the
-host platform (debug only).
+8192 per device — sized so the (chunk × n_y) integrand temporaries fit a
+single v5e chip's 16G HBM), BDLZ_BENCH_NY (default 8000),
+BDLZ_BENCH_PLATFORM=cpu to force the host platform (debug only).
 """
 from __future__ import annotations
 
@@ -46,12 +47,10 @@ def main() -> None:
     from bdlz_tpu.physics.percolation import make_kjma_grid
 
     n_points = int(os.environ.get("BDLZ_BENCH_POINTS", 262144))
-    chunk = int(os.environ.get("BDLZ_BENCH_CHUNK", 65536))
     n_y = int(os.environ.get("BDLZ_BENCH_NY", 8000))
 
     devices = jax.devices()
     n_dev = len(devices)
-    chunk = ((chunk + n_dev - 1) // n_dev) * n_dev
 
     base = config_from_dict(
         {
@@ -74,6 +73,18 @@ def main() -> None:
     }
     pp_all = build_grid(base, axes)
     n_total = int(np.asarray(pp_all.m_chi_GeV).shape[0])
+
+    # Per-device chunk: the fused integrand lives as (chunk/n_dev × n_y)
+    # f64 temporaries; 8192 points/device × 8000 nodes fits a 16G-HBM v5e
+    # chip. Capped at the (device-rounded) grid size so large slices don't
+    # pad every launch and skew the reported per-chip throughput.
+    chunk = int(
+        os.environ.get(
+            "BDLZ_BENCH_CHUNK",
+            min(8192 * n_dev, ((n_total + n_dev - 1) // n_dev) * n_dev),
+        )
+    )
+    chunk = ((chunk + n_dev - 1) // n_dev) * n_dev
 
     mesh = make_mesh(shape=(n_dev, 1))
     sharding = batch_sharding(mesh)

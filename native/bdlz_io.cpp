@@ -13,6 +13,7 @@
 // wrong column count abort the parse (error -3) rather than silently
 // skipping data. Parsing uses strtod, so any standard float format works.
 
+#include <cctype>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>

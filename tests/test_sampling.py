@@ -137,3 +137,55 @@ class TestPlanckLikelihood:
         run = run_ensemble(jax.random.PRNGKey(9), logp, init, n_steps=40)
         assert float(run.logp_chain[-1].max()) > float(run.logp_chain[0].max()) - 1e-9
         assert np.isfinite(np.asarray(run.final.walkers)).all()
+
+
+class TestLikelihoodRegressions:
+    """Regressions for review findings on the likelihood layer."""
+
+    def _base(self):
+        import jax.numpy as jnp
+
+        from bdlz_tpu.ops.kjma_table import make_f_table
+
+        base = config_from_dict(dict(BENCH_OVER))
+        static = static_choices_from_config(base)
+        table = make_f_table(base.I_p, jnp, n=4096)
+        return base, static, table
+
+    def test_m_B_GeV_sampled_in_GeV_not_kg(self):
+        """Sampling m_B_GeV must convert to kg exactly like build_grid does:
+        logp at the proton mass in GeV must equal logp of the base config
+        (whose m_B_kg is the proton mass)."""
+        import jax.numpy as jnp
+
+        from bdlz_tpu.constants import M_PROTON_KG, GEV_TO_KG
+
+        base, static, table = self._base()
+        logp = make_pipeline_logprob(
+            base, static, table, param_keys=("m_B_GeV",), n_y=2000
+        )
+        ref = make_pipeline_logprob(
+            base, static, table, param_keys=("P_chi_to_B",), n_y=2000
+        )
+        m_p_GeV = M_PROTON_KG / GEV_TO_KG
+        got = float(logp(jnp.array([m_p_GeV])))
+        want = float(ref(jnp.array([base.P_chi_to_B])))
+        assert got == pytest.approx(want, rel=1e-12)
+
+    def test_I_p_rejected_on_tabulated_path(self):
+        base, static, table = self._base()
+        with pytest.raises(ValueError, match="I_p"):
+            make_pipeline_logprob(base, static, table, param_keys=("I_p",))
+
+    def test_mcmc_cli_burn_ge_steps_rejected(self, tmp_path):
+        import json as _json
+
+        from bdlz_tpu.mcmc_cli import main as mcmc_main
+
+        cfg = tmp_path / "cfg.json"
+        cfg.write_text(_json.dumps(BENCH_OVER))
+        with pytest.raises(SystemExit, match="burn"):
+            mcmc_main([
+                "--config", str(cfg), "--param", "m_chi_GeV=0.5:2",
+                "--steps", "10", "--burn", "10",
+            ])

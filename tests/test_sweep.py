@@ -156,3 +156,24 @@ class TestGridShardedQuadrature:
         static = static_choices_from_config(base_cfg)
         with pytest.raises(ValueError, match="divisible"):
             make_sp_quadrature(static, mesh8, n_y=8191)
+
+
+def test_sweep_cli_all_failed_summary_is_strict_json(base_cfg, tmp_path, capsys):
+    """When every point fails, the stdout summary must still be valid strict
+    JSON (closest_to_planck: null), not bare NaN (review regression)."""
+    import dataclasses
+    import json
+
+    from bdlz_tpu.sweep_cli import main as sweep_main
+
+    cfg = tmp_path / "cfg.json"
+    cfg.write_text(json.dumps(dataclasses.asdict(base_cfg)))
+    sweep_main([
+        "--config", str(cfg),
+        "--axis", "m_chi_GeV=1e300,1e300",
+        "--chunk", "16", "--n-y", "2000",
+    ])
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    summary = json.loads(out, parse_constant=lambda s: pytest.fail(f"non-strict JSON {s}"))
+    assert summary["closest_to_planck"] is None
+    assert summary["n_failed"] == summary["n_points"] == 2
