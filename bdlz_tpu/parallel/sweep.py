@@ -105,12 +105,24 @@ def grid_hash(base: Config, axes: Mapping[str, Sequence[float]], n_y: int) -> st
     return hashlib.sha256(json.dumps(payload, sort_keys=True).encode()).hexdigest()[:16]
 
 
-def make_sweep_step(static: StaticChoices, mesh=None, n_y: int = 8000, use_table: bool = True):
-    """Compile the per-chunk step: vmapped pipeline, batch sharded over the mesh.
+def make_sweep_step(
+    static: StaticChoices,
+    mesh=None,
+    n_y: int = 8000,
+    use_table: bool = True,
+    impl: str = "tabulated",
+    interpret: bool = False,
+):
+    """Compile the per-chunk step: batched pipeline, batch sharded over the mesh.
 
-    Returns ``step(pp_chunk, table_or_grid) -> YieldsResult`` of arrays.
-    With a mesh, inputs are expected dp-sharded (see ``shard_chunk``); XLA
-    compiles a pure SPMD program with no collectives.
+    Returns ``step(pp_chunk, aux) -> YieldsResult`` of arrays, where ``aux``
+    is the F-table (``impl="tabulated"``), the raw KJMA z-grid
+    (``impl="direct"``), or ``(table, shifted_table)`` (``impl="pallas"`` —
+    the MXU interpolation kernel, the fastest path on real TPU hardware).
+    With a mesh, inputs are expected batch-sharded (see ``shard_chunk``);
+    XLA compiles a pure SPMD program with no collectives; the pallas step
+    is wrapped in ``shard_map`` so each device runs the kernel on its own
+    batch shard.
     """
     import jax
 
@@ -119,12 +131,47 @@ def make_sweep_step(static: StaticChoices, mesh=None, n_y: int = 8000, use_table
 
     from bdlz_tpu.models.yields_pipeline import point_yields, point_yields_fast
 
-    if use_table:
+    if not use_table and impl != "direct":
+        impl = "direct"
+
+    if impl == "pallas":
+        from bdlz_tpu.ops.kjma_pallas import point_yields_pallas
+
+        def batched(pp, aux):
+            table, t4 = aux
+            return point_yields_pallas(
+                pp, static, table, t4, n_y=n_y, interpret=interpret
+            )
+
+        if mesh is None:
+            return jax.jit(batched)
+
+        from jax.sharding import PartitionSpec as P
+
+        try:
+            shard_map = jax.shard_map  # jax >= 0.6
+        except AttributeError:  # pragma: no cover
+            from jax.experimental.shard_map import shard_map
+
+        spec = P(tuple(mesh.axis_names))
+        sharded = shard_map(
+            batched,
+            mesh=mesh,
+            in_specs=(jax.tree.map(lambda _: spec, PointParams(*PointParams._fields)),
+                      P()),
+            out_specs=spec,
+            check_vma=False,
+        )
+        return jax.jit(sharded)
+
+    if impl == "tabulated":
         def one(pp, table):
             return point_yields_fast(pp, static, table, jnp, n_y=n_y)
-    else:
+    elif impl == "direct":
         def one(pp, grid):
             return point_yields(pp, static, grid, jnp)
+    else:
+        raise ValueError(f"unknown sweep impl {impl!r}")
 
     batched = jax.vmap(one, in_axes=(0, None))
 
@@ -183,13 +230,17 @@ def run_sweep(
     table_nodes: int = 16384,
     event_log=None,
     trace_dir: Optional[str] = None,
+    impl: str = "tabulated",
+    interpret: bool = False,
 ) -> SweepResult:
     """Run a full sweep: grid build → per-chunk jitted sharded evaluation →
     (optional) chunk files + manifest with resume.
 
-    If ``axes`` sweeps I_p the tabulated fast path is invalid (the F-table
-    is per-I_p); the engine falls back to the direct (n_y × n_z) kernel
-    automatically.
+    ``impl`` selects the per-point engine: ``"tabulated"`` (vmapped XLA
+    fast path), ``"pallas"`` (MXU interpolation kernel — fastest on real
+    TPU), or ``"direct"``.  If ``axes`` sweeps I_p the tabulated/pallas
+    fast paths are invalid (the F-table is per-I_p); the engine falls back
+    to the direct (n_y × n_z) kernel automatically.
     """
     import jax
     import jax.numpy as jnp
@@ -206,12 +257,22 @@ def run_sweep(
         n_dev = int(mesh.devices.size)
         chunk_size = ((max(chunk_size, n_dev) + n_dev - 1) // n_dev) * n_dev
     use_table = "I_p" not in axes
-    aux = (
-        make_f_table(float(base.I_p), jnp, n=table_nodes)
-        if use_table
-        else make_kjma_grid(jnp)
+    if not use_table:
+        impl = "direct"
+    if impl == "direct":
+        aux = make_kjma_grid(jnp)
+    else:
+        table = make_f_table(float(base.I_p), jnp, n=table_nodes)
+        if impl == "pallas":
+            from bdlz_tpu.ops.kjma_pallas import build_shifted_table
+
+            aux = (table, build_shifted_table(table))
+        else:
+            aux = table
+    step = make_sweep_step(
+        static, mesh=mesh, n_y=n_y, use_table=use_table, impl=impl,
+        interpret=interpret,
     )
-    step = make_sweep_step(static, mesh=mesh, n_y=n_y, use_table=use_table)
 
     manifest_path = None
     manifest: Dict[str, Any] = {}

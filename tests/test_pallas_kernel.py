@@ -1,0 +1,108 @@
+"""Pallas KJMA kernel: interpret-mode parity vs the tabulated fast path.
+
+The kernel itself (`bdlz_tpu/ops/kjma_pallas.py`) reformulates the table
+gather as one-hot MXU matmuls; on CPU we run it through the Pallas
+interpreter, which executes the identical kernel semantics, so these
+tests pin down correctness (the TPU-side speed is covered by bench.py).
+"""
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bdlz_tpu.config import config_from_dict, static_choices_from_config
+from bdlz_tpu.models.yields_pipeline import point_yields_fast
+from bdlz_tpu.ops.kjma_pallas import (
+    build_shifted_table,
+    integrate_YB_pallas,
+    point_yields_pallas,
+)
+from bdlz_tpu.ops.kjma_table import make_f_table
+from bdlz_tpu.parallel.sweep import build_grid
+
+
+@pytest.fixture(scope="module")
+def setup():
+    base = config_from_dict(
+        {
+            "regime": "nonthermal",
+            "P_chi_to_B": 0.14925839040304145,
+            "source_shape_sigma_y": 9.0,
+            "incident_flux_scale": 1.07e-9,
+            "Y_chi_init": 4.90e-10,
+        }
+    )
+    static = static_choices_from_config(base)
+    table = make_f_table(base.I_p, jnp, n=16384)
+    t4 = build_shifted_table(table)
+    return base, static, table, t4
+
+
+def test_shifted_table_layout(setup):
+    _, _, table, t4 = setup
+    vals = np.asarray(table.values)
+    t4 = np.asarray(t4)
+    # spot-check the stencil shifts: T4[m, k*128+c] == F[m*128+c+k-1]
+    rng = np.random.default_rng(0)
+    for _ in range(50):
+        m = int(rng.integers(0, 128))
+        c = int(rng.integers(0, 128))
+        for k in range(4):
+            flat = np.clip(m * 128 + c + k - 1, 0, vals.size - 1)
+            assert t4[m, k * 128 + c] == np.float32(vals[flat])
+
+
+def test_pallas_matches_tabulated_path(setup):
+    base, static, table, t4 = setup
+    rng = np.random.default_rng(42)
+    n = 8
+    grid = build_grid(
+        base,
+        {
+            "m_chi_GeV": rng.uniform(0.1, 5.0, n),
+            "T_p_GeV": rng.uniform(50.0, 200.0, n),
+            "P_chi_to_B": rng.uniform(0.01, 0.9, n),
+            "v_w": rng.uniform(0.05, 0.95, n),
+            "source_shape_sigma_y": rng.uniform(2.0, 20.0, n),
+        },
+        product=False,
+    )
+    grid = jax.tree.map(jnp.asarray, grid)
+
+    ref = jax.vmap(lambda p: point_yields_fast(p, static, table, jnp, n_y=2048).Y_B)(grid)
+    got = integrate_YB_pallas(grid, static.chi_stats, table, t4, n_y=2048, interpret=True)
+
+    ref = np.asarray(ref)
+    got = np.asarray(got)
+    assert np.all(np.isfinite(got))
+    rel = np.abs(got - ref) / np.abs(ref)
+    # f32 streams + f32 interp arithmetic: well inside the 1e-6 contract
+    assert rel.max() < 5e-7, rel.max()
+
+
+def test_pallas_thermal_regime_and_results(setup):
+    base, _, table, t4 = setup
+    cfg = dataclasses.replace(base, regime="thermal")
+    static = static_choices_from_config(cfg)
+    grid = build_grid(cfg, {"m_chi_GeV": [0.5, 0.95, 2.0]})
+    grid = jax.tree.map(jnp.asarray, grid)
+
+    res = point_yields_pallas(grid, static, table, t4, n_y=2048, interpret=True)
+    ref = jax.vmap(lambda p: point_yields_fast(p, static, table, jnp, n_y=2048))(grid)
+    np.testing.assert_allclose(
+        np.asarray(res.DM_over_B), np.asarray(ref.DM_over_B), rtol=1e-6
+    )
+    np.testing.assert_allclose(np.asarray(res.Y_chi), np.asarray(ref.Y_chi), rtol=1e-12)
+
+
+def test_pallas_empty_window_is_zero(setup):
+    base, static, table, t4 = setup
+    # T window entirely above the percolation support: y_hi < y_lo after clip
+    cfg = dataclasses.replace(base, T_min_over_Tp=4.0, T_max_over_Tp=5.0)
+    grid = build_grid(cfg, {"m_chi_GeV": [0.95]})
+    grid = jax.tree.map(jnp.asarray, grid)
+    got = integrate_YB_pallas(grid, static.chi_stats, table, t4, n_y=2048, interpret=True)
+    ref = jax.vmap(lambda p: point_yields_fast(p, static, table, jnp, n_y=2048).Y_B)(grid)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-6)

@@ -177,3 +177,21 @@ def test_sweep_cli_all_failed_summary_is_strict_json(base_cfg, tmp_path, capsys)
     summary = json.loads(out, parse_constant=lambda s: pytest.fail(f"non-strict JSON {s}"))
     assert summary["closest_to_planck"] is None
     assert summary["n_failed"] == summary["n_points"] == 2
+
+
+def test_pallas_impl_sweep_matches_tabulated(base_cfg, mesh8):
+    """run_sweep(impl="pallas") on the 8-device mesh (interpret mode on CPU)
+    agrees with the tabulated XLA path to f32-stream accuracy."""
+    static = static_choices_from_config(base_cfg)
+    axes = {"m_chi_GeV": np.geomspace(0.3, 3.0, 16).tolist()}
+    res_p = run_sweep(
+        base_cfg, axes, static, mesh=mesh8, chunk_size=16, n_y=2048,
+        impl="pallas", interpret=True,
+    )
+    res_t = run_sweep(
+        base_cfg, axes, static, mesh=mesh8, chunk_size=16, n_y=2048,
+    )
+    assert res_p.n_failed == 0
+    np.testing.assert_allclose(
+        res_p.outputs["DM_over_B"], res_t.outputs["DM_over_B"], rtol=1e-6
+    )
