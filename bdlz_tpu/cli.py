@@ -166,6 +166,10 @@ def main(argv: Optional[list] = None) -> None:
                     help="Print a small table of y(T), A/V(T), J_chi(T), S_B(T) around T_p.")
     ap.add_argument("--backend", default=None,
                     help="Override the config 'backend' key (numpy | tpu).")
+    ap.add_argument("--planck", action="store_true",
+                    help="Print the Planck comparison block: settling factor "
+                         "f_settle and effective probability P_eff (paper "
+                         "Eqs. 22-24; framework addition).")
     args = ap.parse_args(argv)
 
     if args.write_template:
@@ -184,6 +188,16 @@ def main(argv: Optional[list] = None) -> None:
     print_results(result)
     write_yields_out("yields_out.json", cfg, P_used, result)
     print("Wrote yields_out.json")
+
+    if args.planck:
+        from bdlz_tpu.analysis import planck_comparison
+
+        cmp_ = planck_comparison(float(result.DM_over_B), P_used)
+        print("\n=== Planck comparison (paper Eqs. 22-24) ===")
+        print(f"(rho_DM/rho_b)_raw    = {float(cmp_['ratio_raw']):.10g}")
+        print(f"(rho_DM/rho_b)_Planck = {float(cmp_['ratio_planck']):.4g}")
+        print(f"f_settle              = {float(cmp_['f_settle']):.5f}")
+        print(f"P_eff                 = {float(cmp_['P_eff']):.5f}")
 
     if args.diagnostics:
         print_diagnostics(cfg, P_used)

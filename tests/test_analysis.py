@@ -1,0 +1,66 @@
+"""Settling-factor diagnostics (paper §7, Eqs. 22-24) and the --planck CLI
+block: the archived benchmark must reproduce f_settle = 0.94168 and
+P_eff ~ 0.15850."""
+import json
+
+import numpy as np
+import pytest
+
+from bdlz_tpu.analysis import effective_probability, planck_comparison, settling_factor
+
+GOLDEN_RATIO_RAW = 5.6889263349
+GOLDEN_P = 0.14925839040304145
+
+
+def test_settling_factor_benchmark_value():
+    # paper Eq. 23 displays 5.357/5.6889263349 = 0.94168, but that quotient
+    # is actually 0.9416540 — the paper's printed value comes from an
+    # unrounded Planck ratio ~5.3571. We evaluate the definition with the
+    # displayed Planck ratio 5.357 and check both to their real precision.
+    assert settling_factor(GOLDEN_RATIO_RAW) == pytest.approx(0.9416540, abs=5e-7)
+    assert settling_factor(GOLDEN_RATIO_RAW) == pytest.approx(0.94168, abs=3e-5)
+
+
+def test_effective_probability_benchmark_value():
+    # paper Eq. 24: P / f_settle ~ 0.15850 (same rounding caveat as Eq. 23)
+    assert effective_probability(GOLDEN_P, GOLDEN_RATIO_RAW) == pytest.approx(
+        0.158506, abs=5e-6
+    )
+    # consistency: P_eff * f_settle == P
+    f = settling_factor(GOLDEN_RATIO_RAW)
+    assert effective_probability(GOLDEN_P, GOLDEN_RATIO_RAW) * f == pytest.approx(
+        GOLDEN_P, rel=1e-12
+    )
+
+
+def test_planck_comparison_batched():
+    ratios = np.array([5.357, 5.6889263349, 10.714])
+    Ps = np.array([0.1, GOLDEN_P, 0.2])
+    cmp_ = planck_comparison(ratios, Ps)
+    np.testing.assert_allclose(cmp_["f_settle"], [1.0, 0.9416540, 0.5], atol=5e-6)
+    np.testing.assert_allclose(cmp_["P_eff"][0], 0.1, rtol=1e-12)
+    np.testing.assert_allclose(cmp_["P_eff"][2], 0.4, rtol=1e-12)
+
+
+def test_cli_planck_block(benchmark_config_path, tmp_path, capsys, monkeypatch):
+    from bdlz_tpu.cli import main
+
+    monkeypatch.chdir(tmp_path)
+    main(["--config", benchmark_config_path, "--planck"])
+    out = capsys.readouterr().out
+    assert "f_settle              = 0.94165" in out
+    assert "P_eff                 = 0.15851" in out
+    # the reference-contract result block is unchanged
+    assert "DM/B ratio= 5.68893" in out
+    assert json.load(open("yields_out.json"))["final"]["DM_over_B"] == pytest.approx(
+        5.688926334903014, rel=1e-12
+    )
+
+
+def test_scalar_zero_ratio_matches_array_semantics():
+    # a point with zero baryon yield: scalar use (CLI) must not raise and
+    # must agree with the batched numpy behavior (inf)
+    assert settling_factor(0.0) == float("inf")
+    with np.errstate(divide="ignore"):
+        arr = settling_factor(np.array([0.0]))
+    assert np.isinf(arr[0])
