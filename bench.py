@@ -31,8 +31,37 @@ import sys
 import time
 
 
+def _axon_relay_alive() -> bool:
+    """True if the axon TPU relay's compile endpoint accepts connections.
+
+    When the relay is down, any jax backend touch with axon in the
+    platform list hangs forever (observed in this environment) — so the
+    bench probes the socket first and falls back to host CPU rather than
+    hanging the driver.
+    """
+    import socket
+
+    s = socket.socket()
+    s.settimeout(2)
+    try:
+        s.connect(("127.0.0.1", 8083))
+        return True
+    except OSError:
+        return False
+    finally:
+        s.close()
+
+
 def main() -> None:
-    if os.environ.get("BDLZ_BENCH_PLATFORM") == "cpu":
+    force_cpu = os.environ.get("BDLZ_BENCH_PLATFORM") == "cpu"
+    # PALLAS_AXON_POOL_IPS is what gates the sitecustomize axon-plugin
+    # registration (it force-registers in every process and overrides
+    # JAX_PLATFORMS), so it — not JAX_PLATFORMS — tells us whether a dead
+    # relay can hang the backend.
+    if not force_cpu and os.environ.get("PALLAS_AXON_POOL_IPS") and not _axon_relay_alive():
+        print("[bench] axon relay unreachable; falling back to host CPU", file=sys.stderr)
+        force_cpu = True
+    if force_cpu:
         import jax
 
         jax.config.update("jax_platforms", "cpu")

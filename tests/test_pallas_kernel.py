@@ -61,8 +61,12 @@ def test_pallas_matches_tabulated_path(setup):
     grid = build_grid(
         base,
         {
-            "m_chi_GeV": rng.uniform(0.1, 5.0, n),
-            "T_p_GeV": rng.uniform(50.0, 200.0, n),
+            # include heavy-mass / low-T_p points so the Maxwell-Boltzmann
+            # branch (T <= m/3) is exercised, not just the relativistic one
+            "m_chi_GeV": np.concatenate([rng.uniform(0.1, 5.0, n - 3),
+                                         [120.0, 400.0, 1000.0]]),
+            "T_p_GeV": np.concatenate([rng.uniform(50.0, 200.0, n - 3),
+                                       [30.0, 35.0, 30.0]]),
             "P_chi_to_B": rng.uniform(0.01, 0.9, n),
             "v_w": rng.uniform(0.05, 0.95, n),
             "source_shape_sigma_y": rng.uniform(2.0, 20.0, n),
