@@ -88,6 +88,84 @@ def build_shifted_table(table: KJMATable) -> jax.Array:
     return jnp.asarray(np.concatenate(cols, axis=1), dtype=f32)
 
 
+#: Cody–Waite constants for the in-kernel f32 exp: ln2 split so n*LN2_HI is
+#: exact for |n| < 2^12, plus log2(e).
+_LOG2E = 1.4426950408889634
+_LN2_HI = 0.693359375
+_LN2_LO = -2.1219444005469057e-4
+
+
+def exp_neg_f32(a_hi, a_lo):
+    """Accurate f32 e^(a_hi + a_lo) (rel err ~2e-7, flush below -87).
+
+    Designed for the normalized exponents of the fused kernel (a <= 0
+    after peak subtraction) but correct over the whole f32-representable
+    domain up to a ~ +87 (the 2^n scale construction clamps n to the
+    normal-exponent range) — tested on [-87, +40].
+
+    The TPU VPU's native f32 exp is only ~7e-6 accurate (measured on v5e) —
+    an order of magnitude outside the 1e-6 parity contract — so the kernel
+    uses Cody–Waite range reduction (n = round(a*log2e); r = a - n*ln2 via
+    the hi/lo split so the reduction is exact) and a degree-7 Taylor
+    polynomial on r in [-0.35, 0.35] (truncation ~1e-9), scaled by 2^n
+    built from exponent bits.  The argument arrives as an exact two-piece
+    f64 split (|a_lo| <= ulp(a_hi)) so large-magnitude arguments lose
+    nothing to the f32 cast.  Pure jnp ops: works identically inside a
+    Pallas kernel and in plain XLA (where the tests pin it against f64).
+    """
+    n = jnp.round(a_hi * f32(_LOG2E))
+    r = (a_hi - n * f32(_LN2_HI)) - n * f32(_LN2_LO)
+    r = r + a_lo
+    # e^r via Horner, degree 7 (truncation ~1e-9 on |r| <= 0.35)
+    p = f32(1.0 / 5040.0)
+    p = p * r + f32(1.0 / 720.0)
+    p = p * r + f32(1.0 / 120.0)
+    p = p * r + f32(1.0 / 24.0)
+    p = p * r + f32(1.0 / 6.0)
+    p = p * r + f32(0.5)
+    p = p * r + f32(1.0)
+    p = p * r + f32(1.0)
+    ni = jnp.clip(n.astype(i32), -126, 127)
+    scale = jax.lax.bitcast_convert_type((ni + 127) << 23, f32)
+    out = p * scale
+    return jnp.where(a_hi < -87.0, 0.0, out)
+
+
+def split_f64(x):
+    """Exact two-piece f32 split of an f64 array: x == hi + lo + O(1e-14)."""
+    hi = x.astype(f32)
+    lo = (x - hi.astype(f64)).astype(f32)
+    return hi, lo
+
+
+def _interp_column(t4, lanes, i1t, st, j):
+    """Cubic F-interpolation for lane column j of a (128, ncol) node tile.
+
+    One-hot row selection on the MXU (exact — each output lane copies one
+    table entry, no summation error), lane-wise `take_along_axis` for the
+    column taps, Lagrange cubic combine.  Shared by both kernel variants.
+    """
+    idx = i1t[:, j:j + 1]                       # (128, 1)
+    r = idx // LANES
+    c = idx - r * LANES
+    rsel = (lanes == r).astype(f32)             # one-hot rows
+    picked = jnp.dot(rsel, t4, preferred_element_type=f32)  # (128, 512)
+    cb = jnp.broadcast_to(c, (ROWS, LANES))
+    s = st[:, j:j + 1]
+    sm1, s0, s1_, s2 = s + 1.0, s, s - 1.0, s - 2.0
+    w = (
+        -(s0 * s1_ * s2) * (1.0 / 6.0),
+        (sm1 * s1_ * s2) * 0.5,
+        -(sm1 * s0 * s2) * 0.5,
+        (sm1 * s0 * s1_) * (1.0 / 6.0),
+    )
+    acc = jnp.zeros((ROWS, 1), f32)
+    for k in range(4):
+        fk = jnp.take_along_axis(picked[:, k * LANES:(k + 1) * LANES], cb, axis=1)
+        acc = acc + w[k] * fk[:, 0:1]
+    return acc
+
+
 def _kernel(ncol: int, ghat_ref, i1_ref, s_ref, t4_ref, out_ref):
     """One parameter point: (128, ncol) node tile -> integrand tile."""
     t4 = t4_ref[:]          # (128, 512) f32, resident in VMEM
@@ -99,27 +177,39 @@ def _kernel(ncol: int, ghat_ref, i1_ref, s_ref, t4_ref, out_ref):
     # Static unroll over lane columns: each j handles 128 consecutive
     # nodes (down the sublanes), so all slicing below is static.
     for j in range(ncol):
-        idx = i1t[:, j:j + 1]                       # (128, 1)
-        r = idx // LANES
-        c = idx - r * LANES
-        rsel = (lanes == r).astype(f32)             # one-hot rows
-        # Exact row selection on the MXU: each output lane copies one
-        # table entry (one-hot contraction has no rounding).
-        picked = jnp.dot(rsel, t4, preferred_element_type=f32)  # (128, 512)
-        cb = jnp.broadcast_to(c, (ROWS, LANES))
-        s = st[:, j:j + 1]
-        sm1, s0, s1_, s2 = s + 1.0, s, s - 1.0, s - 2.0
-        w = (
-            -(s0 * s1_ * s2) * (1.0 / 6.0),
-            (sm1 * s1_ * s2) * 0.5,
-            -(sm1 * s0 * s2) * 0.5,
-            (sm1 * s0 * s1_) * (1.0 / 6.0),
-        )
-        acc = jnp.zeros((ROWS, 1), f32)
-        for k in range(4):
-            fk = jnp.take_along_axis(picked[:, k * LANES:(k + 1) * LANES], cb, axis=1)
-            acc = acc + w[k] * fk[:, 0:1]
+        acc = _interp_column(t4, lanes, i1t, st, j)
         out_ref[0, :, j:j + 1] = ghat[:, j:j + 1] * acc
+
+
+def _kernel_fused(ncol: int, g2_ref, ahi_ref, alo_ref, i1_ref, s_ref, t4_ref, out_ref):
+    """Fused variant: the merged exponent is evaluated in-kernel.
+
+    Same interpolation as `_kernel`, but the per-node integrand is
+    ``g2 * exp_neg_f32(a_hi + a_lo) * F`` — the prep then does no
+    per-node transcendental at all (the f64 exp was its largest remaining
+    cost under TPU f64 emulation)."""
+    t4 = t4_ref[:]
+    g2 = g2_ref[0]
+    i1t = i1_ref[0]
+    st = s_ref[0]
+    lanes = jax.lax.broadcasted_iota(i32, (ROWS, LANES), 1)
+
+    e = exp_neg_f32(ahi_ref[0], alo_ref[0])  # whole tile at once
+
+    for j in range(ncol):
+        acc = _interp_column(t4, lanes, i1t, st, j)
+        out_ref[0, :, j:j + 1] = g2[:, j:j + 1] * e[:, j:j + 1] * acc
+
+
+def _tile_specs(n_streams: int, ncol: int):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    stream = pl.BlockSpec((1, ROWS, ncol), lambda p: (p, 0, 0), memory_space=pltpu.VMEM)
+    table = pl.BlockSpec((ROWS, 4 * LANES), lambda p: (0, 0), memory_space=pltpu.VMEM)
+    return [stream] * n_streams + [table], pl.BlockSpec(
+        (1, ROWS, ncol), lambda p: (p, 0, 0), memory_space=pltpu.VMEM
+    )
 
 
 def interp_multiply(
@@ -132,24 +222,44 @@ def interp_multiply(
 ) -> jax.Array:
     """``ghat * cubic_interp(F, i1 + sfrac)`` for (P, 128, ncol) tiles."""
     from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
 
     P, rows, ncol = ghat.shape
     assert rows == ROWS
-    kern = functools.partial(_kernel, ncol)
+    in_specs, out_spec = _tile_specs(3, ncol)
     return pl.pallas_call(
-        kern,
+        functools.partial(_kernel, ncol),
         grid=(P,),
-        in_specs=[
-            pl.BlockSpec((1, ROWS, ncol), lambda p: (p, 0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, ROWS, ncol), lambda p: (p, 0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, ROWS, ncol), lambda p: (p, 0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((ROWS, 4 * LANES), lambda p: (0, 0), memory_space=pltpu.VMEM),
-        ],
-        out_specs=pl.BlockSpec((1, ROWS, ncol), lambda p: (p, 0, 0), memory_space=pltpu.VMEM),
+        in_specs=in_specs,
+        out_specs=out_spec,
         out_shape=jax.ShapeDtypeStruct((P, ROWS, ncol), f32),
         interpret=interpret,
     )(ghat, i1, sfrac, t4)
+
+
+def interp_multiply_fused(
+    g2: jax.Array,
+    a_hi: jax.Array,
+    a_lo: jax.Array,
+    i1: jax.Array,
+    sfrac: jax.Array,
+    t4: jax.Array,
+    *,
+    interpret: bool = False,
+) -> jax.Array:
+    """``g2 * e^(a_hi+a_lo) * cubic_interp(F, i1 + sfrac)`` on tiles."""
+    from jax.experimental import pallas as pl
+
+    P, rows, ncol = g2.shape
+    assert rows == ROWS
+    in_specs, out_spec = _tile_specs(5, ncol)
+    return pl.pallas_call(
+        functools.partial(_kernel_fused, ncol),
+        grid=(P,),
+        in_specs=in_specs,
+        out_specs=out_spec,
+        out_shape=jax.ShapeDtypeStruct((P, ROWS, ncol), f32),
+        interpret=interpret,
+    )(g2, a_hi, a_lo, i1, sfrac, t4)
 
 
 def _to_tiles(a: jax.Array, n_y: int, ncol: int, fill) -> jax.Array:
@@ -170,6 +280,7 @@ def integrate_YB_pallas(
     n_y: int = 8000,
     *,
     interpret: bool = False,
+    fuse_exp: bool = False,
 ) -> jax.Array:
     """Batched fast-path Y_B with the Pallas interpolation kernel.
 
@@ -233,9 +344,16 @@ def integrate_YB_pallas(
     # exp() to zero for any m > 0.
     mb_arg = (pp.m_chi_GeV / pp.T_p_GeV)[:, None] * sqrt_d
     A = aw - xp.where(rel, 0.0, mb_arg)
-    # analytic upper bound of aw over the interval (MB term only lowers A)
+    # analytic maximum of aw over the interval (MB term only lowers A):
+    # aw is increasing up to min(σ², +clamp) and decreasing after, so the
+    # interval argmax is that point clipped into [y_lo, y_hi]; the VALUE
+    # must apply the same e^y clamp as aw itself (windows entirely below
+    # -Y_CLAMP otherwise understate the max and feed the kernel exp
+    # positive arguments).
     y_star = xp.clip(xp.minimum(sig[:, 0] ** 2, Y_CLAMP), y_lo, y_hi)
-    A_max = y_star - (y_star * y_star) / (2.0 * sig[:, 0] ** 2)
+    A_max = xp.clip(y_star, -Y_CLAMP, Y_CLAMP) - (y_star * y_star) / (
+        2.0 * sig[:, 0] ** 2
+    )
 
     bf = xp.where(rel, 1.0, bf_ratio[:, None] * sqrt_d)
 
@@ -244,24 +362,42 @@ def integrate_YB_pallas(
     dy = (y_hi - y_lo) / (n_y - 1)
     wtrap = xp.ones((n_y,), f64).at[0].set(0.5).at[-1].set(0.5) * dy[:, None]
 
-    g = xp.exp(A - A_max[:, None]) * bf * wtrap
-    g = xp.where(ys > Y_CLAMP, 0.0, g)  # hard A/V = 0 cut (reference :159)
-    # Normalize per point before the f32 cast: with the exponent already
-    # peak-normalized the stream is O(dy), but the per-point max keeps the
-    # f32 cast safe for every parameter corner (the scale re-enters in f64).
-    gscale = xp.max(xp.abs(g), axis=-1, keepdims=True)
-    g = g / xp.maximum(gscale, 1e-300)
-
     t = (yc - table.y0) * table.inv_dy
     n = table.values.shape[0]
     i1 = xp.clip(xp.floor(t).astype(i32), 1, n - 3)
     sfrac = (t - i1).astype(f32)
-
-    ghat_t = _to_tiles(g.astype(f32), n_y, ncol, 0.0)
     i1_t = _to_tiles(i1, n_y, ncol, 1)
     s_t = _to_tiles(sfrac, n_y, ncol, 0.0)
 
-    out = interp_multiply(ghat_t, i1_t, s_t, t4, interpret=interpret)
+    if fuse_exp:
+        # The exponential moves into the kernel (exp_neg_f32 on an exact
+        # two-piece argument); prep ships only bf·wtrap and the split args.
+        g2 = bf * wtrap
+        g2 = xp.where(ys > Y_CLAMP, 0.0, g2)  # hard A/V = 0 cut (ref :159)
+        gscale = xp.max(xp.abs(g2), axis=-1, keepdims=True)
+        g2 = g2 / xp.maximum(gscale, 1e-300)
+        a_hi, a_lo = split_f64(A - A_max[:, None])
+        out = interp_multiply_fused(
+            _to_tiles(g2.astype(f32), n_y, ncol, 0.0),
+            _to_tiles(a_hi, n_y, ncol, 0.0),
+            _to_tiles(a_lo, n_y, ncol, 0.0),
+            i1_t,
+            s_t,
+            t4,
+            interpret=interpret,
+        )
+    else:
+        g = xp.exp(A - A_max[:, None]) * bf * wtrap
+        g = xp.where(ys > Y_CLAMP, 0.0, g)  # hard A/V = 0 cut (reference :159)
+        # Normalize per point before the f32 cast: with the exponent already
+        # peak-normalized the stream is O(dy), but the per-point max keeps the
+        # f32 cast safe for every parameter corner (the scale re-enters in f64).
+        gscale = xp.max(xp.abs(g), axis=-1, keepdims=True)
+        g = g / xp.maximum(gscale, 1e-300)
+        out = interp_multiply(
+            _to_tiles(g.astype(f32), n_y, ncol, 0.0), i1_t, s_t, t4,
+            interpret=interpret,
+        )
     YB = (
         KK
         * xp.exp(A_max)
@@ -279,6 +415,7 @@ def point_yields_pallas(
     n_y: int = 8000,
     *,
     interpret: bool = False,
+    fuse_exp: bool = False,
 ):
     """Batched flagship pipeline on the Pallas hot path.
 
@@ -288,6 +425,8 @@ def point_yields_pallas(
     """
     from bdlz_tpu.models.yields_pipeline import final_Y_chi_quadrature, present_day
 
-    Y_B = integrate_YB_pallas(pp, static.chi_stats, table, t4, n_y, interpret=interpret)
+    Y_B = integrate_YB_pallas(
+        pp, static.chi_stats, table, t4, n_y, interpret=interpret, fuse_exp=fuse_exp
+    )
     Y_chi = jax.vmap(lambda p: final_Y_chi_quadrature(p, static, jnp))(pp)
     return present_day(Y_B, Y_chi, pp.m_chi_GeV, pp.m_B_kg, jnp)

@@ -112,6 +112,7 @@ def make_sweep_step(
     use_table: bool = True,
     impl: str = "tabulated",
     interpret: bool = False,
+    fuse_exp: bool = False,
 ):
     """Compile the per-chunk step: batched pipeline, batch sharded over the mesh.
 
@@ -140,7 +141,8 @@ def make_sweep_step(
         def batched(pp, aux):
             table, t4 = aux
             return point_yields_pallas(
-                pp, static, table, t4, n_y=n_y, interpret=interpret
+                pp, static, table, t4, n_y=n_y, interpret=interpret,
+                fuse_exp=fuse_exp,
             )
 
         if mesh is None:
@@ -232,6 +234,7 @@ def run_sweep(
     trace_dir: Optional[str] = None,
     impl: str = "tabulated",
     interpret: bool = False,
+    fuse_exp: bool = False,
 ) -> SweepResult:
     """Run a full sweep: grid build → per-chunk jitted sharded evaluation →
     (optional) chunk files + manifest with resume.
@@ -271,7 +274,7 @@ def run_sweep(
             aux = table
     step = make_sweep_step(
         static, mesh=mesh, n_y=n_y, use_table=use_table, impl=impl,
-        interpret=interpret,
+        interpret=interpret, fuse_exp=fuse_exp,
     )
 
     manifest_path = None

@@ -52,6 +52,14 @@ def main(argv=None) -> None:
                     help="Capture a jax.profiler trace per chunk into this dir")
     ap.add_argument("--debug-nans", action="store_true",
                     help="Raise on any NaN produced under jit (sanitizer mode)")
+    ap.add_argument("--impl", default="tabulated",
+                    choices=("tabulated", "pallas", "direct"),
+                    help="Per-point engine: tabulated (XLA fast path), pallas "
+                         "(MXU interpolation kernel — fastest on real TPU), "
+                         "direct (raw (n_y x n_z) kernel; forced when I_p is swept)")
+    ap.add_argument("--fuse-exp", action="store_true", dest="fuse_exp",
+                    help="With --impl pallas: evaluate the merged exponential "
+                         "inside the kernel (accurate f32 Cody-Waite exp)")
     args = ap.parse_args(argv)
 
     import jax
@@ -83,10 +91,12 @@ def main(argv=None) -> None:
 
         event_log = EventLog(path=args.events)
 
+    interpret = args.impl == "pallas" and jax.devices()[0].platform == "cpu"
     res = run_sweep(
         cfg, axes, static_choices_from_config(cfg),
         mesh=mesh, chunk_size=args.chunk, n_y=args.n_y, out_dir=args.out,
         event_log=event_log, trace_dir=args.profile_dir,
+        impl=args.impl, interpret=interpret, fuse_exp=args.fuse_exp,
     )
 
     ratios = res.outputs["DM_over_B"]

@@ -131,8 +131,10 @@ def main() -> None:
             # runs it on its own batch shard (pallas_call has no SPMD
             # partitioning rule of its own).
             interpret = jax.devices()[0].platform == "cpu"
+            fuse = os.environ.get("BDLZ_BENCH_FUSE_EXP", "0") == "1"
             step = make_sweep_step(
-                static, mesh=mesh, n_y=n_y, impl="pallas", interpret=interpret
+                static, mesh=mesh, n_y=n_y, impl="pallas", interpret=interpret,
+                fuse_exp=fuse,
             )
             aux = (table, build_shifted_table(table))
             batched = lambda ppc: step(ppc, aux).DM_over_B  # noqa: E731

@@ -110,3 +110,46 @@ def test_pallas_empty_window_is_zero(setup):
     got = integrate_YB_pallas(grid, static.chi_stats, table, t4, n_y=2048, interpret=True)
     ref = jax.vmap(lambda p: point_yields_fast(p, static, table, jnp, n_y=2048).Y_B)(grid)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-6)
+
+
+def test_exp_neg_f32_accuracy():
+    """The in-kernel Cody-Waite exp must hold ~2e-7 relative over the
+    normalized-argument range and the positive overshoot corners
+    (TPU's native f32 exp is ~7e-6)."""
+    from bdlz_tpu.ops.kjma_pallas import exp_neg_f32, split_f64
+
+    a = jnp.asarray(np.linspace(-87.0, 40.0, 200001))
+    hi, lo = split_f64(a)
+    got = np.asarray(exp_neg_f32(hi, lo), dtype=np.float64)
+    ref = np.exp(np.asarray(a))
+    rel = np.abs(got / ref - 1.0)
+    assert rel.max() < 3e-7, rel.max()
+    # flush region
+    hi2, lo2 = split_f64(jnp.asarray(np.array([-88.0, -500.0])))
+    assert np.all(np.asarray(exp_neg_f32(hi2, lo2)) == 0.0)
+
+
+def test_pallas_fused_exp_matches_tabulated(setup):
+    base, static, table, t4 = setup
+    rng = np.random.default_rng(7)
+    n = 8
+    grid = build_grid(
+        base,
+        {
+            "m_chi_GeV": np.concatenate([rng.uniform(0.1, 5.0, n - 3),
+                                         [120.0, 400.0, 1000.0]]),
+            "T_p_GeV": np.concatenate([rng.uniform(50.0, 200.0, n - 3),
+                                       [30.0, 35.0, 30.0]]),
+            "P_chi_to_B": rng.uniform(0.01, 0.9, n),
+            "v_w": rng.uniform(0.05, 0.95, n),
+            "source_shape_sigma_y": rng.uniform(2.0, 20.0, n),
+        },
+        product=False,
+    )
+    grid = jax.tree.map(jnp.asarray, grid)
+    ref = jax.vmap(lambda p: point_yields_fast(p, static, table, jnp, n_y=2048).Y_B)(grid)
+    got = integrate_YB_pallas(
+        grid, static.chi_stats, table, t4, n_y=2048, interpret=True, fuse_exp=True
+    )
+    rel = np.abs(np.asarray(got) - np.asarray(ref)) / np.abs(np.asarray(ref))
+    assert rel.max() < 5e-7, rel.max()
