@@ -31,7 +31,7 @@ from bdlz_tpu.solvers.boltzmann import solve_scipy_radau
 from bdlz_tpu.utils.io import write_yields_out
 
 
-def resolve_P(cfg: Config, profile_csv: Optional[str]) -> float:
+def resolve_P(cfg: Config, profile_csv: Optional[str], momentum_average: bool = False) -> float:
     """LZ-probability resolution order (reference `maybe_P`, :317-328).
 
     Profile CSV (through the framework's two-channel LZ kernel — the seam
@@ -43,9 +43,17 @@ def resolve_P(cfg: Config, profile_csv: Optional[str]) -> float:
     if profile_csv:
         P_try, reason = None, None
         try:
-            from bdlz_tpu.lz import probability_from_profile
+            if momentum_average:
+                from bdlz_tpu.lz import momentum_averaged_probability
 
-            P_try = float(probability_from_profile(profile_csv, cfg.v_w))
+                P_try, F_k = momentum_averaged_probability(
+                    profile_csv, cfg.v_w, cfg.T_p_GeV, cfg.m_chi_GeV
+                )
+                print(f"[info] momentum-averaged LZ kernel: F_k = {F_k:.6g}")
+            else:
+                from bdlz_tpu.lz import probability_from_profile
+
+                P_try = float(probability_from_profile(profile_csv, cfg.v_w))
             P_try = max(min(P_try, 1.0), 0.0)
         except Exception as exc:  # fall back to config, like the reference
             P_try, reason = None, f"{type(exc).__name__}: {exc}"
@@ -166,12 +174,20 @@ def main(argv: Optional[list] = None) -> None:
                     help="Print a small table of y(T), A/V(T), J_chi(T), S_B(T) around T_p.")
     ap.add_argument("--backend", default=None,
                     help="Override the config 'backend' key (numpy | tpu).")
+    ap.add_argument("--lz-momentum-average", action="store_true",
+                    dest="lz_momentum_average",
+                    help="With --maybe-compute-P-from-profile: flux-weighted "
+                         "thermal average of the LZ probability over incident "
+                         "chi momenta at T_p (the paper's F(k) layer; "
+                         "framework addition).")
     ap.add_argument("--planck", action="store_true",
                     help="Print the Planck comparison block: settling factor "
                          "f_settle and effective probability P_eff (paper "
                          "Eqs. 22-24; framework addition).")
     args = ap.parse_args(argv)
 
+    if args.lz_momentum_average and not args.profile_csv:
+        ap.error("--lz-momentum-average requires --maybe-compute-P-from-profile")
     if args.write_template:
         write_template(args.config or "yields_config.json")
         return
@@ -180,7 +196,7 @@ def main(argv: Optional[list] = None) -> None:
         return
 
     cfg = validate(load_config(args.config))
-    P_used = resolve_P(cfg, args.profile_csv)
+    P_used = resolve_P(cfg, args.profile_csv, momentum_average=args.lz_momentum_average)
     backend = args.backend or cfg.backend
 
     result = run_point(cfg, P_used, backend)

@@ -17,6 +17,9 @@ from bdlz_tpu.lz.kernel import (  # noqa: F401
     probability_from_profile,
     transfer_matrix_propagation,
 )
+from bdlz_tpu.lz.momentum import (  # noqa: F401
+    momentum_averaged_probability,
+)
 from bdlz_tpu.lz.profile import (  # noqa: F401
     BounceProfile,
     Crossings,

@@ -129,6 +129,23 @@ def _quat_to_matrix(q) -> np.ndarray:
     )
 
 
+def propagate_quaternion(a, b, dxi, v, xp):
+    """Total SU(2) propagator (as a quaternion) across segments, traced.
+
+    The vmappable core of :func:`transfer_matrix_propagation`: pure jnp/xp
+    ops over per-segment (a, b, dxi) with traversal speed ``v`` (may be a
+    traced scalar — the momentum-averaging layer vmaps over it).  Returns
+    the (4,) quaternion of U_N···U_1; P_{χ→B} = q_x² + q_y².
+    """
+    from jax import lax
+
+    tau = dxi / xp.maximum(v, 1e-12)
+    qs = _su2_quaternions(a, b, tau, xp)
+    compose = lambda qa, qb: _quat_compose(qa, qb, xp)  # noqa: E731
+    prods = lax.associative_scan(compose, qs[::-1])
+    return prods[-1]
+
+
 def transfer_matrix_propagation(
     profile: BounceProfile,
     v_w: float,
@@ -154,9 +171,9 @@ def transfer_matrix_propagation(
 
     v = max(float(v_w), 1e-12)
     a, b, dxi = _segment_hamiltonians(profile, jnp)
-    tau = dxi / v  # traversal time per segment
 
     if use_generic_expm:
+        tau = dxi / v  # traversal time per segment
         H = jnp.stack(
             [jnp.stack([a, b], axis=-1), jnp.stack([b, -a], axis=-1)], axis=-2
         ).astype(jnp.complex128)
@@ -168,10 +185,7 @@ def transfer_matrix_propagation(
         P = float(np.abs(U_total[1, 0]) ** 2)
         return U_total, P
 
-    qs = _su2_quaternions(a, b, tau, jnp)
-    compose = lambda qa, qb: _quat_compose(qa, qb, jnp)  # noqa: E731
-    prods = lax.associative_scan(compose, qs[::-1])
-    q_total = np.asarray(prods[-1])
+    q_total = np.asarray(propagate_quaternion(a, b, dxi, jnp.asarray(v), jnp))
     U_total = _quat_to_matrix(q_total)
     P = float(q_total[1] ** 2 + q_total[2] ** 2)
     return U_total, P

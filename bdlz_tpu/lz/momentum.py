@@ -1,0 +1,196 @@
+"""Momentum-averaged LZ conversion probability — the paper's F(k) layer.
+
+The reference's minimal estimator evaluates the crossing at a single
+traversal speed, the wall speed v_w, and carries a placeholder momentum-
+averaging factor F(k) ≡ 1 (paper §3, Eq. 8 and §10 "Next steps: momentum
+averaging F(k) and the full energy dependence of the LZ crossing").  This
+module implements that next step on top of the coherent transfer-matrix
+kernel (:mod:`bdlz_tpu.lz.kernel`):
+
+* incident χ momenta are drawn from the equilibrium distribution at the
+  percolation temperature, f(k) ∝ k² e^{−E/T} (Maxwell–Jüttner form; the
+  quantum ±1 in the denominator is a ≲8% effect at the relevant E/T and is
+  absorbed into the same "microphysical matching" bucket the paper defers);
+* each (k, μ=cosθ) node is boosted to the wall frame, v_n = (v μ + v_w)/
+  (1 + v μ v_w), and contributes with the kinetic-theory flux weight
+  max(v_n, 0) — the same ¼ n v̄ bookkeeping as the source term
+  (`first_principles_yields.py:122-123`), resolved per momentum instead of
+  averaged;
+* the coherent two-channel propagation runs per node with traversal speed
+  v_n (a vmap over `propagate_quaternion` — segments × nodes stay batched
+  on the TPU), and the flux-weighted average gives
+
+      ⟨P⟩ = Σ w f k² v_n P(v_n) / Σ w f k² v_n,
+      F_k ≡ ⟨P⟩ / P(v_w)          (the paper's F(k), now computed).
+
+Quadrature: piecewise Gauss–Legendre in k over the distribution's support
+(segmented at the μ*-clip kink k* and the thermal-bulk edge, exponential
+t-substitution on the tail) × Gauss–Legendre in μ over the incident cone
+with endpoint clustering — the defaults converge the smooth (local)
+average to ~5e-7 across relativistic, non-relativistic and massless
+regimes (tested).
+"""
+from __future__ import annotations
+
+from typing import Tuple, Union
+
+import numpy as np
+
+from bdlz_tpu.lz.kernel import _segment_hamiltonians, propagate_quaternion
+from bdlz_tpu.lz.profile import BounceProfile, load_profile_csv
+
+
+def _wall_frame_normal_speed(v, mu, v_w):
+    """Relativistic addition of the plasma-frame normal velocity and v_w."""
+    vz = v * mu
+    return (vz + v_w) / (1.0 + vz * v_w)
+
+
+def momentum_averaged_probability(
+    profile: Union[str, BounceProfile],
+    v_w: float,
+    T_GeV: float,
+    m_GeV: float,
+    n_k: int = 128,
+    n_mu: int = 24,
+    method: str = "coherent",
+) -> Tuple[float, float]:
+    """Flux-weighted thermal average ⟨P_{χ→B}⟩ and the factor F_k = ⟨P⟩/P(v_w).
+
+    Returns ``(P_avg, F_k)``.  ``T_GeV`` is the temperature of the incident
+    χ bath at the crossing epoch (typically T_p) and ``m_GeV`` the χ mass;
+    massless and deeply non-relativistic limits are both handled (the
+    Laguerre grid scales with T).
+
+    ``method="coherent"`` averages the full transfer-matrix probability —
+    note its Stückelberg phases oscillate rapidly in 1/v_n, so the average
+    converges to the phase-averaged value with O(oscillation/√nodes)
+    jitter (~1e-3 relative), which is the physically meaningful precision
+    of a coherent average.  ``method="local"`` averages the smooth analytic
+    composition P(v) = 1 − e^(−2πλ_eff/v) (λ ∝ 1/v per crossing, paper
+    Eq. 8) and is spectrally convergent (≪1e-6, tested) — the right choice
+    when the average feeds the 1e-6-contract pipeline.
+    """
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    import jax.numpy as jnp
+
+    if isinstance(profile, str):
+        profile = load_profile_csv(profile)
+    v_w = float(np.clip(v_w, 1e-6, 1.0 - 1e-12))
+    T = max(float(T_GeV), 1e-30)
+    m = max(float(m_GeV), 0.0)
+
+    # k-quadrature on the distribution's own support (E − m ≤ 45 T bounds
+    # the population to e^{-45} relative), built segment-wise so every
+    # piece is spectrally convergent:
+    #
+    # * breakpoints at k* (where v(k*) = v_w — the μ*-clip gives the
+    #   integrand a C¹ kink in k there, measured to cap any single Gauss
+    #   rule at ~1e-4) and at k(E = m + 6T) (end of the thermal bulk);
+    # * the first segment, which touches k = 0, integrates in k with plain
+    #   Gauss–Legendre (≤6 decay lengths; handles the non-relativistic
+    #   Gaussian √(mT) width a fixed-scale Laguerre grid cannot);
+    # * tail segments substitute t = e^{-(E - E_lo)/T} (k dk = E dE), which
+    #   turns the exponential weight into the linear factor t — the
+    #   t-integrand k·E·(μ-avg) is analytic because these segments stay
+    #   away from the k = 0 square-root point of k(E).
+    #
+    # The integrand remains only C² at k*, so n_k-convergence is ~cubic;
+    # the 128-node default puts the smooth (local) average at ~5e-7
+    # relative (tested across relativistic, NR and massless regimes).
+    n_k = int(n_k)
+    E_max = m + 45.0 * T
+    k_max = float(np.sqrt(E_max * E_max - m * m))
+    k_bulk = float(np.sqrt((m + 6.0 * T) ** 2 - m * m))
+    kstar = m * v_w / np.sqrt(1.0 - v_w * v_w) if m > 0.0 else 0.0
+    breaks = sorted({b for b in (k_bulk, kstar) if 0.0 < b < k_max})
+    edges = [0.0] + breaks + [k_max]
+    n_seg = max(n_k // (len(edges) - 1), 4)
+    x_leg, w_leg = np.polynomial.legendre.leggauss(n_seg)
+    s = 0.5 * (x_leg + 1.0)       # Legendre nodes on [0, 1]
+    ws = 0.5 * w_leg
+    k_parts, w_parts, res_parts = [], [], []
+    for i, (lo, hi) in enumerate(zip(edges[:-1], edges[1:])):
+        E_lo = np.sqrt(lo * lo + m * m)
+        E_hi = np.sqrt(hi * hi + m * m)
+        if i == 0:
+            # bulk segment in k (touches the k = 0 sqrt point of k(E))
+            kk = lo + (hi - lo) * s
+            ww = ws * (hi - lo)
+            res_parts.append(np.sqrt(kk * kk + m * m) / T)
+        else:
+            # tail segment via t = e^{-(E - E_lo)/T}:
+            # ∫ f k² e^{-E/T} dk = T e^{-E_lo/T} ∫ f k E dt on [t_hi, 1];
+            # k ≈ lo ↔ t ≈ 1 and k ≈ hi ↔ t ≈ t_hi.
+            t_hi = np.exp(-(E_hi - E_lo) / T)
+            tt = t_hi + (1.0 - t_hi) * s
+            EE = E_lo - T * np.log(tt)
+            kk = np.sqrt(np.maximum(EE * EE - m * m, 0.0))
+            ww = ws * (1.0 - t_hi) * (T * EE / np.maximum(kk, 1e-300))
+            res_parts.append(np.full(n_seg, E_lo / T))
+        k_parts.append(kk)
+        w_parts.append(ww)
+    k_np = np.concatenate(k_parts)
+    wk_np = np.concatenate(w_parts)
+    # Shift the suppression exponent by its minimum: a constant factor
+    # cancels exactly in the flux-weighted ratio but would underflow e.g.
+    # e^{-m/T} to zero in the cold limit before cancelling.
+    res_np = np.concatenate(res_parts)
+    res_np = res_np - res_np.min()
+
+    xmu, wmu = np.polynomial.legendre.leggauss(int(n_mu))
+
+    k = jnp.asarray(k_np)                         # (n_k,)
+    E = jnp.sqrt(k * k + m * m)
+    v = k / jnp.maximum(E, 1e-300)                # plasma-frame speed
+    fk = (k * k) * jnp.exp(-jnp.asarray(res_np))
+
+    # μ-integral over the incident hemisphere only: the flux factor
+    # max(v_n, 0) kinks at μ* = −v_w/v, which would wreck Gauss–Legendre
+    # convergence if left inside the domain — so the nodes are mapped per k
+    # onto [μ*(k), 1] (for v < v_w the whole sphere is incident and μ*
+    # clips to −1).  The map is quadratic at the lower endpoint,
+    # μ = μ* + (1−μ*)u², clustering nodes where v_n → 0: the probability
+    # rises steeply toward the adiabatic limit there, and the clustering
+    # restores spectral convergence (tested: doubling orders moves ⟨P⟩ by
+    # <1e-7).
+    mu_star = jnp.clip(-v_w / jnp.maximum(v, 1e-300), -1.0, 1.0)      # (n_k,)
+    u = 0.5 * (jnp.asarray(xmu) + 1.0)                                 # (n_mu,) in (0,1)
+    wu = jnp.asarray(wmu) * 0.5
+    span = (1.0 - mu_star)[:, None]                                    # (n_k, 1)
+    mu = mu_star[:, None] + span * u[None, :] ** 2
+    mu_jac = span * 2.0 * u[None, :] * wu[None, :]                     # dμ weights
+    v_n = _wall_frame_normal_speed(v[:, None], mu, v_w)                # (n_k, n_mu)
+    flux = jnp.maximum(v_n, 0.0)                  # incident-only flux weight
+
+    if method == "coherent":
+        a, b, dxi = _segment_hamiltonians(profile, jnp)
+
+        def P_of_speed(speed):
+            q = propagate_quaternion(a, b, dxi, speed, jnp)
+            return q[1] ** 2 + q[2] ** 2
+
+    elif method == "local":
+        from bdlz_tpu.lz.kernel import local_lambdas
+        from bdlz_tpu.lz.profile import find_crossings
+
+        # λ_i ∝ 1/v, so the v-dependence factors out of the composition
+        lam1 = float(np.sum(local_lambdas(find_crossings(profile), v_w=1.0)))
+
+        def P_of_speed(speed):
+            return 1.0 - jnp.exp(-2.0 * jnp.pi * lam1 / speed)
+
+    else:
+        raise ValueError(f"method must be 'coherent' or 'local', got {method!r}")
+
+    P_nodes = jax.vmap(jax.vmap(P_of_speed))(jnp.maximum(v_n, 1e-6))
+
+    w2d = jnp.asarray(wk_np)[:, None] * mu_jac * fk[:, None] * flux
+    norm = jnp.sum(w2d)
+    P_avg = float(jnp.sum(w2d * P_nodes) / jnp.maximum(norm, 1e-300))
+
+    P_wall = float(P_of_speed(jnp.asarray(v_w)))
+    F_k = P_avg / P_wall if P_wall > 0.0 else float("nan")
+    return float(np.clip(P_avg, 0.0, 1.0)), F_k

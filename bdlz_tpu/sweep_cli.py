@@ -61,6 +61,8 @@ def main(argv=None) -> None:
                     help="With --impl pallas: evaluate the merged exponential "
                          "inside the kernel (accurate f32 Cody-Waite exp)")
     args = ap.parse_args(argv)
+    if args.fuse_exp and args.impl != "pallas":
+        ap.error("--fuse-exp requires --impl pallas")
 
     import jax
 
