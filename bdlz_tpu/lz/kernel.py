@@ -136,14 +136,32 @@ def propagate_quaternion(a, b, dxi, v, xp):
     ops over per-segment (a, b, dxi) with traversal speed ``v`` (may be a
     traced scalar — the momentum-averaging layer vmaps over it).  Returns
     the (4,) quaternion of U_N···U_1; P_{χ→B} = q_x² + q_y².
-    """
-    from jax import lax
 
+    The ordered product is taken by a pairwise tree reduction (log-depth,
+    like ``associative_scan``, but O(N) peak memory instead of storing all
+    N prefix products — which matters when the momentum-averaging layer
+    vmaps thousands of nodes over a long profile).
+    """
     tau = dxi / xp.maximum(v, 1e-12)
     qs = _su2_quaternions(a, b, tau, xp)
-    compose = lambda qa, qb: _quat_compose(qa, qb, xp)  # noqa: E731
-    prods = lax.associative_scan(compose, qs[::-1])
-    return prods[-1]
+    # Pad to a power of two with identity quaternions, then halve
+    # repeatedly, composing adjacent pairs with the LATER segment on the
+    # left (U_total = U_N ··· U_1).
+    n = qs.shape[0]
+    size = 1 << max(n - 1, 1).bit_length()
+    if size != n:
+        ident = xp.concatenate(
+            [
+                xp.ones((size - n, 1), dtype=qs.dtype),
+                xp.zeros((size - n, 3), dtype=qs.dtype),
+            ],
+            axis=1,
+        )
+        qs = xp.concatenate([qs, ident], axis=0)
+    while qs.shape[0] > 1:
+        pairs = qs.reshape(-1, 2, 4)
+        qs = _quat_compose(pairs[:, 1, :], pairs[:, 0, :], xp)
+    return qs[0]
 
 
 def transfer_matrix_propagation(

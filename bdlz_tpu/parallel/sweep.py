@@ -93,14 +93,23 @@ def build_grid(
     return PointParams(**fields)
 
 
-def grid_hash(base: Config, axes: Mapping[str, Sequence[float]], n_y: int) -> str:
-    """Identity of a sweep for resume validation: base config + axes + grid."""
+def grid_hash(
+    base: Config, axes: Mapping[str, Sequence[float]], n_y: int, impl: str = "tabulated"
+) -> str:
+    """Identity of a sweep for resume validation: config + axes + grid + engine.
+
+    The engine is part of the identity: resuming a directory with a
+    different impl must invalidate the manifest, or chunks computed by
+    different engines (which agree only to ~1e-4 across the
+    quadrature/ODE boundary) would be silently concatenated.
+    """
     import dataclasses
 
     payload = {
         "base": dataclasses.asdict(base),
         "axes": {k: list(map(float, v)) for k, v in axes.items()},
         "n_y": n_y,
+        "impl": impl,
     }
     return hashlib.sha256(json.dumps(payload, sort_keys=True).encode()).hexdigest()[:16]
 
@@ -132,7 +141,7 @@ def make_sweep_step(
 
     from bdlz_tpu.models.yields_pipeline import point_yields, point_yields_fast
 
-    if not use_table and impl != "direct":
+    if not use_table and impl in ("tabulated", "pallas"):
         impl = "direct"
 
     if impl == "pallas":
@@ -172,6 +181,35 @@ def make_sweep_step(
     elif impl == "direct":
         def one(pp, grid):
             return point_yields(pp, static, grid, jnp)
+    elif impl == "esdirk":
+        # General (stiff) regime: σv > 0, washout, or DM depletion make the
+        # fast quadrature invalid — evolve the coupled Boltzmann system
+        # with the vmappable ESDIRK integrator instead (lanes carry their
+        # own adaptive steps in lockstep; failures surface as NaN so the
+        # sweep's mask-and-report path handles them).
+        from bdlz_tpu.models.yields_pipeline import YieldsResult, present_day
+        from bdlz_tpu.physics.thermo import entropy_density, n_chi_equilibrium
+        from bdlz_tpu.solvers.sdirk import solve_boltzmann_esdirk
+
+        thermal = static.regime.lower().startswith("therm")
+
+        def one(pp, grid):
+            T_hi = pp.T_max_over_Tp * pp.T_p_GeV
+            T_lo = pp.T_min_over_Tp * pp.T_p_GeV
+            if thermal:
+                Ychi0 = n_chi_equilibrium(
+                    T_hi, pp.m_chi_GeV, pp.g_chi, static.chi_stats, jnp
+                ) / entropy_density(T_hi, pp.g_star_s, jnp)
+            else:
+                Ychi0 = pp.Y_chi_init
+            sol = solve_boltzmann_esdirk(
+                pp, static, grid, (Ychi0, 0.0), T_lo, T_hi
+            )
+            res = present_day(sol.y[1], sol.y[0], pp.m_chi_GeV, pp.m_B_kg, jnp)
+            nan = jnp.float64(jnp.nan)
+            return YieldsResult(
+                *(jnp.where(sol.success, f, nan) for f in res)
+            )
     else:
         raise ValueError(f"unknown sweep impl {impl!r}")
 
@@ -259,10 +297,41 @@ def run_sweep(
         # are padded to chunk_size, so just round chunk_size itself up.
         n_dev = int(mesh.devices.size)
         chunk_size = ((max(chunk_size, n_dev) + n_dev - 1) // n_dev) * n_dev
+    # The fast quadrature impls are only valid without annihilation,
+    # washout, or source depletion (the reference's can_quad guard, :372);
+    # a sweep touching those knobs is routed to the stiff ESDIRK path.
+    needs_ode = (
+        base.deplete_DM_from_source
+        or base.sigma_v_chi_GeV_m2 != 0.0
+        or base.Gamma_wash_over_H != 0.0
+        or any(
+            np.any(np.asarray(axes[k], dtype=np.float64) != 0.0)
+            for k in ("sigma_v_chi_GeV_m2", "Gamma_wash_over_H")
+            if k in axes
+        )
+    )
+    requested_impl = impl
+    if needs_ode:
+        impl = "esdirk"
     use_table = "I_p" not in axes
-    if not use_table:
+    if not use_table and impl in ("tabulated", "pallas"):
         impl = "direct"
-    if impl == "direct":
+    if impl != requested_impl:
+        import sys
+
+        print(
+            f"[sweep] impl {requested_impl!r} is invalid for this configuration; "
+            f"using {impl!r} "
+            + ("(stiff regime: sigma_v/washout/depletion active)" if needs_ode
+               else "(I_p swept: per-I_p table unavailable)"),
+            file=sys.stderr,
+        )
+        if fuse_exp:
+            raise ValueError(
+                "fuse_exp requires the pallas engine, but this configuration "
+                f"forces impl={impl!r}"
+            )
+    if impl in ("direct", "esdirk"):
         aux = make_kjma_grid(jnp)
     else:
         table = make_f_table(float(base.I_p), jnp, n=table_nodes)
@@ -279,7 +348,7 @@ def run_sweep(
 
     manifest_path = None
     manifest: Dict[str, Any] = {}
-    h = grid_hash(base, axes, n_y)
+    h = grid_hash(base, axes, n_y, impl)
     if out_dir is not None:
         import os
 
@@ -291,6 +360,7 @@ def run_sweep(
             if manifest.get("hash") != h:
                 manifest = {}
         manifest.setdefault("hash", h)
+        manifest.setdefault("impl", impl)
         manifest.setdefault("n_total", n_total)
         manifest.setdefault("chunk_size", chunk_size)
         manifest.setdefault("chunks", {})
@@ -307,7 +377,7 @@ def run_sweep(
     if event_log is not None:
         event_log.emit(
             "sweep_start", n_points=n_total, chunks=n_chunks,
-            chunk_size=chunk_size, hash=h, use_table=use_table,
+            chunk_size=chunk_size, hash=h, use_table=use_table, impl=impl,
         )
 
     for ci in range(n_chunks):

@@ -53,10 +53,12 @@ def main(argv=None) -> None:
     ap.add_argument("--debug-nans", action="store_true",
                     help="Raise on any NaN produced under jit (sanitizer mode)")
     ap.add_argument("--impl", default="tabulated",
-                    choices=("tabulated", "pallas", "direct"),
+                    choices=("tabulated", "pallas", "direct", "esdirk"),
                     help="Per-point engine: tabulated (XLA fast path), pallas "
                          "(MXU interpolation kernel — fastest on real TPU), "
-                         "direct (raw (n_y x n_z) kernel; forced when I_p is swept)")
+                         "direct (raw (n_y x n_z) kernel; forced when I_p is swept), "
+                         "esdirk (stiff Boltzmann integrator; forced when sigma_v, "
+                         "washout, or depletion are active)")
     ap.add_argument("--fuse-exp", action="store_true", dest="fuse_exp",
                     help="With --impl pallas: evaluate the merged exponential "
                          "inside the kernel (accurate f32 Cody-Waite exp)")
