@@ -18,6 +18,11 @@ Multi-host growth is the standard JAX recipe: ``jax.distributed.initialize``
 + the same mesh spanning hosts, with XLA routing collectives over ICI/DCN.
 """
 from bdlz_tpu.parallel.mesh import batch_sharding, make_mesh, replicated_sharding
+from bdlz_tpu.parallel.multihost import (
+    init_multihost,
+    process_local_bounds,
+    shard_global_chunk,
+)
 from bdlz_tpu.parallel.sweep import (
     SweepResult,
     build_grid,
@@ -26,6 +31,9 @@ from bdlz_tpu.parallel.sweep import (
 )
 
 __all__ = [
+    "init_multihost",
+    "process_local_bounds",
+    "shard_global_chunk",
     "make_mesh",
     "batch_sharding",
     "replicated_sharding",

@@ -1,0 +1,109 @@
+"""Multi-host growth: jax.distributed over DCN, multi-process meshes.
+
+The reference is a single-process script (imports at
+`first_principles_yields.py:19-28` — no multiprocessing/MPI/sockets), so
+everything here is north-star capability (SURVEY §2.3/§5): within one
+slice the sweep scales over ICI via the mesh in :mod:`bdlz_tpu.parallel.mesh`;
+past one host, JAX's standard recipe applies — ``jax.distributed.initialize``
+brings every process into one global runtime, ``jax.devices()`` then spans
+all hosts, and the same ``Mesh``/``shard_map`` sweep code runs unchanged
+with XLA routing collectives over ICI within a slice and DCN across
+slices. No NCCL/MPI shim is needed or appropriate.
+
+What this module adds on top of raw JAX:
+
+* :func:`init_multihost` — env-driven initialization (coordinator address,
+  process id/count) with the no-op single-process fast path, so the same
+  CLI entry points work on a laptop, one TPU VM, or a pod;
+* :func:`shard_global_chunk` — host-local data placement: each process
+  feeds only its own shard of a globally-sharded sweep chunk
+  (``jax.make_array_from_process_local_data``), which is the piece
+  single-host ``device_put`` code gets wrong in multi-process runs;
+* :func:`process_local_bounds` — the contiguous [lo, hi) slice of a batch
+  this process owns under a batch-sharded mesh.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+
+def init_multihost(
+    coordinator: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Initialize jax.distributed if a multi-process context is configured.
+
+    Resolution order: explicit arguments ▸ the standard env vars
+    (``JAX_COORDINATOR_ADDRESS`` / ``JAX_NUM_PROCESSES`` / ``JAX_PROCESS_ID``,
+    or the cloud-TPU autodetection built into ``jax.distributed``).  Returns
+    True when a multi-process runtime was initialized, False for the
+    single-process fast path.  Idempotent: a second call is a no-op.
+    """
+    import jax
+
+    coordinator = coordinator or os.environ.get("JAX_COORDINATOR_ADDRESS")
+    num_processes = num_processes or _env_int("JAX_NUM_PROCESSES")
+    process_id = process_id if process_id is not None else _env_int("JAX_PROCESS_ID")
+
+    if coordinator is None and num_processes is None:
+        return False  # single-process: nothing to initialize
+
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except RuntimeError as exc:  # already initialized → idempotent no-op
+        if "already initialized" not in str(exc).lower():
+            raise
+    return True
+
+
+def _env_int(name: str) -> Optional[int]:
+    v = os.environ.get(name)
+    return int(v) if v else None
+
+
+def process_local_bounds(n_global: int) -> Tuple[int, int]:
+    """[lo, hi) of a length-``n_global`` batch owned by this process.
+
+    Assumes the batch axis is sharded uniformly across processes in
+    process order (the layout ``batch_sharding`` produces on a mesh built
+    from ``jax.devices()``, whose device order is process-major).
+    ``n_global`` must divide evenly — sweep chunks are already padded to a
+    multiple of the device count, which is a multiple of the process count.
+    """
+    import jax
+
+    nproc = jax.process_count()
+    if n_global % nproc:
+        raise ValueError(f"batch {n_global} not divisible by {nproc} processes")
+    per = n_global // nproc
+    lo = jax.process_index() * per
+    return lo, lo + per
+
+
+def shard_global_chunk(chunk, sharding):
+    """Place a host-resident pytree of (n_global, …) arrays as global arrays.
+
+    Single-process: plain ``device_put`` (bitwise the old behavior).
+    Multi-process: each process contributes only its local slice via
+    ``jax.make_array_from_process_local_data`` — every process must pass
+    the same global shapes, and only the local shard's bytes are
+    transferred on each host.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    if jax.process_count() == 1:
+        return jax.tree.map(lambda a: jax.device_put(jnp.asarray(a), sharding), chunk)
+
+    def place(a):
+        a = jnp.asarray(a)
+        lo, hi = process_local_bounds(a.shape[0])
+        return jax.make_array_from_process_local_data(sharding, a[lo:hi], a.shape)
+
+    return jax.tree.map(place, chunk)

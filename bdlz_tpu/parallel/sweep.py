@@ -397,11 +397,11 @@ def run_sweep(
         pp_chunk = _pad_chunk(pp_all, lo, hi, chunk_size)
         if mesh is not None:
             from bdlz_tpu.parallel.mesh import batch_sharding
+            from bdlz_tpu.parallel.multihost import shard_global_chunk
 
-            sharding = batch_sharding(mesh)
-            pp_chunk = jax.tree.map(
-                lambda a: jax.device_put(jnp.asarray(a), sharding), pp_chunk
-            )
+            # single-process: plain device_put; multi-process: each host
+            # contributes only its local shard of the global chunk
+            pp_chunk = shard_global_chunk(pp_chunk, batch_sharding(mesh))
         t_chunk = time.time()
         with profiler_trace(trace_dir):
             res = step(pp_chunk, aux)

@@ -62,9 +62,18 @@ def main(argv=None) -> None:
     ap.add_argument("--fuse-exp", action="store_true", dest="fuse_exp",
                     help="With --impl pallas: evaluate the merged exponential "
                          "inside the kernel (accurate f32 Cody-Waite exp)")
+    ap.add_argument("--multihost", action="store_true",
+                    help="Initialize jax.distributed from JAX_COORDINATOR_ADDRESS/"
+                         "JAX_NUM_PROCESSES/JAX_PROCESS_ID before building the mesh "
+                         "(run one identical invocation per host)")
     args = ap.parse_args(argv)
     if args.fuse_exp and args.impl != "pallas":
         ap.error("--fuse-exp requires --impl pallas")
+
+    if args.multihost:
+        from bdlz_tpu.parallel import init_multihost
+
+        init_multihost()
 
     import jax
 

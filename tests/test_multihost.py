@@ -1,0 +1,43 @@
+"""Multi-host layer (SURVEY §2.3/§5): env-driven jax.distributed init and
+host-local chunk placement. Real multi-process runs need a cluster; these
+tests pin the single-process degenerate behavior the multi-process path
+must reduce to, plus the layout assumptions."""
+import numpy as np
+import pytest
+
+from bdlz_tpu.parallel import (
+    batch_sharding,
+    init_multihost,
+    make_mesh,
+    process_local_bounds,
+    shard_global_chunk,
+)
+
+
+def test_init_multihost_single_process_noop(monkeypatch):
+    monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+    monkeypatch.delenv("JAX_NUM_PROCESSES", raising=False)
+    assert init_multihost() is False
+
+
+def test_process_local_bounds_single_process():
+    # one process owns the whole batch (any length divides 1)
+    assert process_local_bounds(16) == (0, 16)
+    assert process_local_bounds(17) == (0, 17)
+
+
+def test_shard_global_chunk_matches_device_put():
+    """Single-process path must be bitwise device_put; the sharding must
+    actually distribute the batch across the mesh."""
+    import jax
+    import jax.numpy as jnp
+
+    mesh = make_mesh()
+    sharding = batch_sharding(mesh)
+    chunk = {"a": np.arange(16, dtype=np.float64), "b": np.ones(16)}
+    placed = shard_global_chunk(chunk, sharding)
+    np.testing.assert_array_equal(np.asarray(placed["a"]), chunk["a"])
+    assert placed["a"].sharding == sharding
+    # device 0 holds exactly its 1/8 shard
+    shard0 = placed["a"].addressable_shards[0]
+    assert shard0.data.shape == (2,)
