@@ -46,7 +46,16 @@ def get_namespace(backend: str) -> Any:
 
 
 def jax_numpy() -> Any:
-    """Import and return ``jax.numpy`` with float64 enabled."""
+    """Import and return ``jax.numpy`` with float64 enabled.
+
+    Probes the accelerator relay first: with the axon plugin registered
+    and its relay dead, the first backend touch hangs forever — pin host
+    CPU instead (the f64 CPU path satisfies the same accuracy contract).
+    """
+    from bdlz_tpu.utils.platform import ensure_live_backend
+
+    ensure_live_backend("backend")
+
     import jax
 
     jax.config.update("jax_enable_x64", True)

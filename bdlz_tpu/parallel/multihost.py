@@ -20,7 +20,18 @@ What this module adds on top of raw JAX:
   (``jax.make_array_from_process_local_data``), which is the piece
   single-host ``device_put`` code gets wrong in multi-process runs;
 * :func:`process_local_bounds` — the contiguous [lo, hi) slice of a batch
-  this process owns under a batch-sharded mesh.
+  this process owns under a batch-sharded mesh;
+* :func:`gather_to_host` — the inverse of :func:`shard_global_chunk`: bring
+  a (possibly globally-sharded) result pytree back as host numpy arrays on
+  *every* process.  ``np.asarray`` on a multi-process global array raises
+  (non-addressable shards), so the multi-process branch rides
+  ``multihost_utils.process_allgather``;
+* :func:`broadcast_from_coordinator` — ship a small host array (e.g. the
+  resume plan) from process 0 to all processes, so control-flow decisions
+  that depend on process-0-only state (manifest files on non-shared
+  storage) stay identical everywhere.  Multi-controller JAX requires every
+  process to launch the same computations in the same order; a divergent
+  skip-this-chunk decision would deadlock the run.
 """
 from __future__ import annotations
 
@@ -50,6 +61,9 @@ def init_multihost(
     if coordinator is None and num_processes is None:
         return False  # single-process: nothing to initialize
 
+    if _already_initialized():
+        return True
+
     try:
         jax.distributed.initialize(
             coordinator_address=coordinator,
@@ -57,9 +71,22 @@ def init_multihost(
             process_id=process_id,
         )
     except RuntimeError as exc:  # already initialized → idempotent no-op
-        if "already initialized" not in str(exc).lower():
+        # jax 0.9 raises "distributed.initialize should only be called
+        # once."; older versions said "already initialized" — accept both.
+        msg = str(exc).lower()
+        if "already initialized" not in msg and "only be called once" not in msg:
             raise
     return True
+
+
+def _already_initialized() -> bool:
+    """True when jax.distributed has a live client in this process."""
+    try:
+        from jax._src.distributed import global_state
+
+        return global_state.client is not None
+    except Exception:  # private API moved — fall back to the error match
+        return False
 
 
 def _env_int(name: str) -> Optional[int]:
@@ -107,3 +134,53 @@ def shard_global_chunk(chunk, sharding):
         return jax.make_array_from_process_local_data(sharding, a[lo:hi], a.shape)
 
     return jax.tree.map(place, chunk)
+
+
+def gather_to_host(tree):
+    """Bring a result pytree to host numpy on every process.
+
+    Single-process: plain ``np.asarray`` (zero-copy where possible) —
+    bitwise the old sweep behavior.  Multi-process: the step output is a
+    *global* array whose shards live on other hosts' devices, so
+    ``np.asarray`` raises RuntimeError; ``process_allgather(tiled=True)``
+    replicates it and hands back the full array on each host.
+    """
+    import numpy as np
+
+    import jax
+
+    if jax.process_count() == 1:
+        return jax.tree.map(np.asarray, tree)
+
+    from jax.experimental import multihost_utils
+
+    return jax.tree.map(
+        lambda a: np.asarray(multihost_utils.process_allgather(a, tiled=True)),
+        tree,
+    )
+
+
+def is_coordinator() -> bool:
+    """True on the process that owns filesystem side effects (index 0)."""
+    import jax
+
+    return jax.process_index() == 0
+
+
+def broadcast_from_coordinator(arr):
+    """Replicate a small host array from process 0 to all processes.
+
+    No-op (identity) in single-process runs.  Shapes/dtypes must match on
+    every caller — callers pass fixed-size plan arrays (e.g. one row per
+    sweep chunk), never variable-length data.
+    """
+    import numpy as np
+
+    import jax
+
+    if jax.process_count() == 1:
+        return np.asarray(arr)
+
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.broadcast_one_to_all(np.asarray(arr)))

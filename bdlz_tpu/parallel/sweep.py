@@ -246,6 +246,11 @@ class SweepResult:
     chunks: int
     resumed_chunks: int = 0
     outputs: Optional[Dict[str, np.ndarray]] = field(default=None, repr=False)
+    #: Per-point failure mask (True = non-finite output, masked out), full
+    #: grid order — not just the count, so callers can locate *which*
+    #: parameter corners failed (SURVEY §5 mask-and-report).  None only
+    #: when resumed chunks' files were unavailable for mask recovery.
+    failed_mask: Optional[np.ndarray] = field(default=None, repr=False)
 
 
 def _pad_chunk(pp: PointParams, lo: int, hi: int, chunk: int) -> PointParams:
@@ -346,15 +351,25 @@ def run_sweep(
         interpret=interpret, fuse_exp=fuse_exp,
     )
 
+    from bdlz_tpu.parallel.multihost import (
+        broadcast_from_coordinator,
+        gather_to_host,
+        is_coordinator,
+    )
+
+    coordinator = is_coordinator()
+    n_chunks = (n_total + chunk_size - 1) // chunk_size
+
     manifest_path = None
     manifest: Dict[str, Any] = {}
     h = grid_hash(base, axes, n_y, impl)
     if out_dir is not None:
         import os
 
-        os.makedirs(out_dir, exist_ok=True)
+        if coordinator:
+            os.makedirs(out_dir, exist_ok=True)
         manifest_path = f"{out_dir}/manifest.json"
-        if os.path.exists(manifest_path):
+        if coordinator and os.path.exists(manifest_path):
             with open(manifest_path) as f:
                 manifest = json.load(f)
             if manifest.get("hash") != h:
@@ -365,9 +380,44 @@ def run_sweep(
         manifest.setdefault("chunk_size", chunk_size)
         manifest.setdefault("chunks", {})
 
-    n_chunks = (n_total + chunk_size - 1) // chunk_size
+    # Resume plan: decided once on the coordinator (it owns the manifest
+    # and chunk files), then broadcast so every process makes identical
+    # skip/compute decisions — multi-controller JAX deadlocks if processes
+    # diverge on which jitted steps they launch.  A chunk only counts as
+    # done if its .npz is present AND loadable; otherwise it is recomputed
+    # with a warning instead of crashing the sweep (mask-and-report
+    # extends to our own storage failures).
+    plan = np.zeros((n_chunks, 2), dtype=np.int64)  # [done, prior_n_failed]
+    mask_cache: Dict[int, np.ndarray] = {}  # validated masks, avoids re-reads
+    if coordinator and manifest.get("chunks"):
+        import sys
+
+        for ci in range(n_chunks):
+            rec = manifest["chunks"].get(str(ci))
+            if rec is None:
+                continue
+            chunk_file = f"{out_dir}/chunk_{ci:05d}.npz"
+            try:
+                with np.load(chunk_file) as data:
+                    mask = (
+                        data["failed"] if "failed" in data.files
+                        else ~np.isfinite(data["DM_over_B"])
+                    )
+            except Exception as exc:
+                print(
+                    f"[sweep] resume: chunk {ci} listed in manifest but "
+                    f"{chunk_file} is missing/unreadable ({exc!r}); recomputing",
+                    file=sys.stderr,
+                )
+                del manifest["chunks"][str(ci)]
+                continue
+            mask_cache[ci] = np.asarray(mask, dtype=bool)
+            plan[ci] = (1, int(rec["n_failed"]))
+    plan = broadcast_from_coordinator(plan)
+
     fields = YieldsResult._fields
     collected = {f: [] for f in fields} if keep_outputs else None
+    masks: Optional[list] = []
     n_failed = 0
     resumed = 0
     t0 = time.time()
@@ -385,13 +435,36 @@ def run_sweep(
         n_valid = hi - lo
         chunk_file = f"{out_dir}/chunk_{ci:05d}.npz" if out_dir else None
 
-        if manifest and str(ci) in manifest["chunks"]:
+        if plan[ci, 0]:
             resumed += 1
-            n_failed += int(manifest["chunks"][str(ci)]["n_failed"])
-            if keep_outputs and chunk_file:
-                data = np.load(chunk_file)
-                for f in fields:
-                    collected[f].append(data[f])
+            n_failed += int(plan[ci, 1])
+            if masks is not None and ci in mask_cache:
+                masks.append(mask_cache[ci])
+            need_mask = masks is not None and ci not in mask_cache
+            if chunk_file and (keep_outputs or need_mask):
+                try:
+                    with np.load(chunk_file) as data:
+                        if keep_outputs:
+                            for f in fields:
+                                collected[f].append(data[f])
+                        if need_mask:
+                            mask = (
+                                data["failed"] if "failed" in data.files
+                                else ~np.isfinite(data["DM_over_B"])
+                            )
+                            masks.append(np.asarray(mask, dtype=bool))
+                except Exception as exc:
+                    # The coordinator verified readability when building
+                    # the plan; landing here means *this* process cannot
+                    # see the file (non-shared storage in a multi-process
+                    # run) or it vanished mid-sweep.
+                    if keep_outputs:
+                        raise RuntimeError(
+                            f"resumed chunk file {chunk_file} unreadable on "
+                            f"this process ({exc!r}); multi-process resume "
+                            "with keep_outputs=True requires shared storage"
+                        ) from exc
+                    masks = None
             continue
 
         pp_chunk = _pad_chunk(pp_all, lo, hi, chunk_size)
@@ -405,7 +478,11 @@ def run_sweep(
         t_chunk = time.time()
         with profiler_trace(trace_dir):
             res = step(pp_chunk, aux)
-            host = {f: np.asarray(getattr(res, f))[:n_valid] for f in fields}
+            # np.asarray on a multi-process global array raises (shards on
+            # other hosts are non-addressable); gather_to_host allgathers
+            # in that case and is a plain asarray single-process.
+            full = gather_to_host({f: getattr(res, f) for f in fields})
+            host = {f: full[f][:n_valid] for f in fields}
         bad = ~np.isfinite(host["DM_over_B"])
         n_failed += int(bad.sum())
         if event_log is not None:
@@ -414,7 +491,7 @@ def run_sweep(
                 n_failed=int(bad.sum()), seconds=round(time.time() - t_chunk, 4),
             )
 
-        if chunk_file:
+        if chunk_file and coordinator:
             np.savez(chunk_file, **host, failed=bad)
             manifest["chunks"][str(ci)] = {
                 "file": chunk_file,
@@ -426,11 +503,14 @@ def run_sweep(
         if keep_outputs:
             for f in fields:
                 collected[f].append(host[f])
+        if masks is not None:
+            masks.append(bad)
 
     seconds = time.time() - t0
     outputs = (
         {f: np.concatenate(collected[f]) for f in fields} if keep_outputs else None
     )
+    failed_mask = np.concatenate(masks) if masks else None
     return SweepResult(
         n_points=n_total,
         n_failed=n_failed,
@@ -440,4 +520,5 @@ def run_sweep(
         chunks=n_chunks,
         resumed_chunks=resumed,
         outputs=outputs,
+        failed_mask=failed_mask,
     )

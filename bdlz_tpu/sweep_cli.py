@@ -74,6 +74,13 @@ def main(argv=None) -> None:
         from bdlz_tpu.parallel import init_multihost
 
         init_multihost()
+    else:
+        # A dead accelerator relay would hang the first backend touch
+        # forever; probe and pin CPU instead (never in multihost runs,
+        # where the distributed runtime owns platform selection).
+        from bdlz_tpu.utils.platform import ensure_live_backend
+
+        ensure_live_backend("sweep")
 
     import jax
 

@@ -1,0 +1,81 @@
+"""Accelerator-liveness guard for entry points.
+
+This container reaches its TPU through a relay whose compile endpoint can
+die independently of the chip; when it is down, *any* JAX backend touch
+with the axon plugin registered hangs forever rather than erroring.  Every
+CLI that is about to touch JAX therefore probes the socket first and pins
+the host-CPU platform when the accelerator is unreachable — turning an
+infinite hang into a loud, working fallback.  (The reference has no
+accelerator at all, `first_principles_yields.py:19-28`; this is framework
+plumbing for the failure-detection bullet of SURVEY §5.)
+"""
+from __future__ import annotations
+
+import os
+import socket
+import sys
+
+#: The axon relay's compile endpoint (host, port).
+RELAY_ADDR = ("127.0.0.1", 8083)
+
+
+def axon_relay_alive(timeout: float = 2.0) -> bool:
+    """True if the TPU relay's compile endpoint accepts connections."""
+    s = socket.socket()
+    s.settimeout(timeout)
+    try:
+        s.connect(RELAY_ADDR)
+        return True
+    except OSError:
+        return False
+    finally:
+        s.close()
+
+
+def axon_registered() -> bool:
+    """True when the axon plugin will register in this process.
+
+    ``PALLAS_AXON_POOL_IPS`` is what gates the sitecustomize plugin
+    registration (it force-registers in every process and overrides
+    ``JAX_PLATFORMS``), so it — not ``JAX_PLATFORMS`` — tells us whether a
+    dead relay can hang the backend.
+    """
+    return bool(os.environ.get("PALLAS_AXON_POOL_IPS"))
+
+
+def ensure_live_backend(label: str = "bdlz", force_cpu: bool = False) -> bool:
+    """Pin host CPU if the accelerator path would hang; return True if CPU.
+
+    Must run before the first JAX backend touch (``jax.config.update`` is
+    the only reliable override in this environment; env vars are read too
+    early).  Returns whether the process ended up pinned to CPU.
+    """
+    if not force_cpu and axon_registered() and not axon_relay_alive():
+        print(
+            f"[{label}] accelerator relay unreachable; falling back to host CPU",
+            file=sys.stderr,
+        )
+        force_cpu = True
+    if force_cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    return force_cpu
+
+
+def wait_for_relay(max_wait_s: float = 0.0, poll_s: float = 10.0) -> bool:
+    """Poll the relay for up to ``max_wait_s`` seconds; True when alive.
+
+    The relay is an environment state that can recover (observed: it has
+    come back after dying); benches that *want* the TPU number can spend a
+    bounded wait on it instead of silently downgrading the metric.
+    """
+    import time
+
+    deadline = time.time() + max_wait_s
+    while True:
+        if axon_relay_alive():
+            return True
+        if time.time() >= deadline:
+            return False
+        time.sleep(min(poll_s, max(0.1, deadline - time.time())))

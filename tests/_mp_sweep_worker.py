@@ -1,0 +1,76 @@
+"""Worker for the real 2-process jax.distributed sweep test.
+
+Launched twice by ``tests/test_multihost.py::test_two_process_sweep`` as
+``python _mp_sweep_worker.py <port> <process_id> <out_dir>``.  Each process
+joins the distributed runtime (2 processes × 2 local CPU devices = 4
+global devices), runs the mesh-sharded sweep over the *global* mesh —
+exercising the multi-process branches of ``shard_global_chunk``,
+``process_local_bounds``, ``gather_to_host``, and the broadcast resume
+plan — and dumps the gathered outputs so the parent can assert both
+processes produced the single-process answer.
+"""
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+
+def main() -> None:
+    port, pid, out_dir = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+
+    import jax
+
+    # In-process config (not env vars) is the reliable way to pin the CPU
+    # platform in this container; must happen before any backend touch.
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 2)
+    jax.config.update("jax_enable_x64", True)
+
+    from bdlz_tpu.parallel.multihost import init_multihost
+
+    assert init_multihost(f"localhost:{port}", 2, pid) is True
+    # idempotency: second call must be a no-op, not a RuntimeError
+    assert init_multihost(f"localhost:{port}", 2, pid) is True
+
+    assert jax.process_count() == 2, jax.process_count()
+    assert len(jax.devices()) == 4, jax.devices()
+
+    import numpy as np
+
+    from bdlz_tpu.config import config_from_dict, static_choices_from_config
+    from bdlz_tpu.parallel import make_mesh, run_sweep
+
+    cfg = config_from_dict({
+        "regime": "nonthermal",
+        "P_chi_to_B": 0.14925839040304145,
+        "source_shape_sigma_y": 9.0,
+        "incident_flux_scale": 1.07e-9,
+        "Y_chi_init": 4.90e-10,
+    })
+    static = static_choices_from_config(cfg)
+    axes = {"m_chi_GeV": np.geomspace(0.3, 3.0, 8).tolist()}
+    mesh = make_mesh(shape=(4, 1))  # all 4 global devices on dp
+
+    res = run_sweep(
+        cfg, axes, static, mesh=mesh, chunk_size=4, n_y=2000,
+        out_dir=f"{out_dir}/sweep",
+    )
+    assert res.n_failed == 0
+    assert res.failed_mask is not None and not res.failed_mask.any()
+
+    # resume pass: the broadcast plan must skip every chunk on both
+    # processes identically (divergence would deadlock, which the parent's
+    # timeout converts into a failure)
+    res2 = run_sweep(
+        cfg, axes, static, mesh=mesh, chunk_size=4, n_y=2000,
+        out_dir=f"{out_dir}/sweep",
+    )
+    assert res2.resumed_chunks == res.chunks, (res2.resumed_chunks, res.chunks)
+    np.testing.assert_array_equal(res.outputs["DM_over_B"], res2.outputs["DM_over_B"])
+
+    np.savez(f"{out_dir}/result_p{pid}.npz", **res.outputs)
+    print(f"worker {pid} OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,7 +1,13 @@
 """Multi-host layer (SURVEY §2.3/§5): env-driven jax.distributed init and
-host-local chunk placement. Real multi-process runs need a cluster; these
-tests pin the single-process degenerate behavior the multi-process path
-must reduce to, plus the layout assumptions."""
+host-local chunk placement. Single-process tests pin the degenerate
+behavior the multi-process path must reduce to; the 2-process test at the
+bottom executes the real thing — ``jax.distributed.initialize`` over
+localhost with two CPU processes sharing one global mesh."""
+import os
+import socket
+import subprocess
+import sys
+
 import numpy as np
 import pytest
 
@@ -26,6 +32,29 @@ def test_process_local_bounds_single_process():
     assert process_local_bounds(17) == (0, 17)
 
 
+def test_gather_to_host_single_process_roundtrip():
+    """gather_to_host must be a plain asarray single-process, including on
+    mesh-sharded global arrays (the exact shape run_sweep feeds it)."""
+    import jax
+
+    from bdlz_tpu.parallel.multihost import gather_to_host
+
+    mesh = make_mesh()
+    chunk = {"a": np.arange(16, dtype=np.float64)}
+    placed = shard_global_chunk(chunk, batch_sharding(mesh))
+    back = gather_to_host(placed)
+    np.testing.assert_array_equal(back["a"], chunk["a"])
+    assert isinstance(back["a"], np.ndarray)
+
+
+def test_broadcast_from_coordinator_single_process_identity():
+    from bdlz_tpu.parallel.multihost import broadcast_from_coordinator, is_coordinator
+
+    assert is_coordinator() is True
+    plan = np.array([[1, 3], [0, 0]], dtype=np.int64)
+    np.testing.assert_array_equal(broadcast_from_coordinator(plan), plan)
+
+
 def test_shard_global_chunk_matches_device_put():
     """Single-process path must be bitwise device_put; the sharding must
     actually distribute the batch across the mesh."""
@@ -41,3 +70,70 @@ def test_shard_global_chunk_matches_device_put():
     # device 0 holds exactly its 1/8 shard
     shard0 = placed["a"].addressable_shards[0]
     assert shard0.data.shape == (2,)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_sweep(tmp_path):
+    """Launch 2 real processes via jax.distributed.initialize on localhost
+    CPU (2 local devices each -> 4 global) and run the mesh-sharded sweep
+    through the multi-process branches of shard_global_chunk /
+    process_local_bounds / gather_to_host, plus a resume pass over the
+    broadcast plan. Both processes must produce the single-process answer."""
+    port = _free_port()
+    worker = os.path.join(os.path.dirname(__file__), "_mp_sweep_worker.py")
+
+    env = dict(os.environ)
+    # Children must not inherit the axon TPU plugin (empty pool-IPs gates
+    # registration off) nor the parent's 8-device XLA flag — the worker
+    # pins 2 CPU devices per process itself.
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_COORDINATOR_ADDRESS", None)
+    env.pop("JAX_NUM_PROCESSES", None)
+    env.pop("JAX_PROCESS_ID", None)
+
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, str(port), str(pid), str(tmp_path)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
+        for pid in (0, 1)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=540)
+            outs.append((p.returncode, out, err))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for rc, out, err in outs:
+        assert rc == 0, f"worker failed (rc={rc}):\n{out}\n{err}"
+        assert "OK" in out
+
+    # Both processes saw the identical gathered result, and it matches a
+    # single-process run of the same grid on this (8-device) runtime.
+    r0 = np.load(tmp_path / "result_p0.npz")
+    r1 = np.load(tmp_path / "result_p1.npz")
+    np.testing.assert_array_equal(r0["DM_over_B"], r1["DM_over_B"])
+
+    from bdlz_tpu.config import config_from_dict, static_choices_from_config
+    from bdlz_tpu.parallel import run_sweep
+
+    cfg = config_from_dict({
+        "regime": "nonthermal",
+        "P_chi_to_B": 0.14925839040304145,
+        "source_shape_sigma_y": 9.0,
+        "incident_flux_scale": 1.07e-9,
+        "Y_chi_init": 4.90e-10,
+    })
+    static = static_choices_from_config(cfg)
+    axes = {"m_chi_GeV": np.geomspace(0.3, 3.0, 8).tolist()}
+    ref = run_sweep(cfg, axes, static, mesh=make_mesh(), chunk_size=4, n_y=2000)
+    np.testing.assert_allclose(r0["DM_over_B"], ref.outputs["DM_over_B"], rtol=1e-12)
