@@ -38,11 +38,21 @@ def main(argv=None) -> None:
     ap.add_argument("--burn", type=int, default=100)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--out", default=None, help="Write the chain to this .npz")
+    ap.add_argument("--checkpoint-dir", default=None,
+                    help="Flush chain segments here incrementally; an "
+                         "interrupted run resumes from the last completed "
+                         "segment (bitwise-identical to uninterrupted)")
+    ap.add_argument("--checkpoint-every", type=int, default=100,
+                    help="Steps per checkpoint segment (with --checkpoint-dir)")
     args = ap.parse_args(argv)
     if not 0 <= args.burn < args.steps:
         raise SystemExit(
             f"--burn {args.burn} must satisfy 0 <= burn < --steps {args.steps}"
         )
+
+    from bdlz_tpu.utils.platform import ensure_live_backend
+
+    ensure_live_backend("mcmc")
 
     import jax
 
@@ -77,25 +87,69 @@ def main(argv=None) -> None:
         ],
         axis=1,
     )
-    run = run_ensemble(jax.random.PRNGKey(args.seed + 1), logp, init,
-                       n_steps=args.steps, mesh=mesh)
+    resumed_segments = 0
+    if args.checkpoint_dir:
+        from bdlz_tpu.sampling.checkpoint import run_ensemble_checkpointed
 
-    chain = np.asarray(run.chain[args.burn:]).reshape(-1, len(params))
-    logps = np.asarray(run.logp_chain[args.burn:]).reshape(-1)
+        import dataclasses
+
+        run = run_ensemble_checkpointed(
+            args.seed + 1, logp, init, n_steps=args.steps,
+            out_dir=args.checkpoint_dir,
+            checkpoint_every=args.checkpoint_every, mesh=mesh,
+            # fingerprint of the posterior: full physics config + the
+            # sampled-parameter spec (changing either invalidates resume)
+            identity={
+                "config": dataclasses.asdict(cfg),
+                "params": {k: list(v) for k, v in params.items()},
+            },
+        )
+        full_chain, full_logp = run.chain, run.logp_chain
+        acceptance = run.acceptance
+        resumed_segments = run.resumed_segments
+    else:
+        run = run_ensemble(jax.random.PRNGKey(args.seed + 1), logp, init,
+                           n_steps=args.steps, mesh=mesh)
+        full_chain = np.asarray(run.chain)
+        full_logp = np.asarray(run.logp_chain)
+        acceptance = float(run.acceptance)
+
+    from bdlz_tpu.sampling.diagnostics import integrated_autocorr_time, split_rhat
+
+    post = full_chain[args.burn:]                       # (n, W, D)
+    tau = integrated_autocorr_time(post)
+    # split-R-hat needs >= 4 post-burn steps; shorter runs still get a
+    # summary, just with null R-hat values
+    rhat = split_rhat(post) if post.shape[0] >= 4 else np.full(len(params), np.nan)
+    n_eff = post.shape[0] * post.shape[1] / tau
+
+    chain = post.reshape(-1, len(params))
+    logps = full_logp[args.burn:].reshape(-1)
     best = int(np.argmax(logps))
     summary = {
         "walkers": W,
         "steps": args.steps,
         "burn": args.burn,
-        "acceptance": round(float(run.acceptance), 4),
+        "acceptance": round(acceptance, 4),
         "map_logp": float(logps[best]),
         "map_params": {k: float(chain[best, i]) for i, k in enumerate(params)},
         "posterior_mean": {k: float(chain[:, i].mean()) for i, k in enumerate(params)},
         "posterior_std": {k: float(chain[:, i].std()) for i, k in enumerate(params)},
+        "tau_int": {k: round(float(tau[i]), 3) for i, k in enumerate(params)},
+        "split_rhat": {
+            k: (round(float(rhat[i]), 5) if np.isfinite(rhat[i]) else None)
+            for i, k in enumerate(params)
+        },
+        "n_eff": {k: round(float(n_eff[i]), 1) for i, k in enumerate(params)},
+        # τ estimates need n ≳ 50·τ to be trustworthy (Sokal's criterion)
+        "tau_reliable": bool(post.shape[0] >= 50 * float(tau.max())),
     }
+    if args.checkpoint_dir:
+        summary["checkpoint_dir"] = args.checkpoint_dir
+        summary["resumed_segments"] = resumed_segments
     if args.out:
-        np.savez(args.out, chain=np.asarray(run.chain),
-                 logp=np.asarray(run.logp_chain), param_names=list(params))
+        np.savez(args.out, chain=full_chain, logp=full_logp,
+                 param_names=list(params))
         summary["out"] = args.out
     print(json.dumps(summary))
 

@@ -7,6 +7,12 @@ chains run under `lax.scan`, and the walker axis shards across the mesh
 like any other batch axis. The physics likelihood is the vmapped yields
 pipeline mapped to (Ω_b h², Ω_DM h²) against the Planck 2018 measurements.
 """
+from bdlz_tpu.sampling.checkpoint import CheckpointedRun, run_ensemble_checkpointed
+from bdlz_tpu.sampling.diagnostics import (
+    effective_sample_size,
+    integrated_autocorr_time,
+    split_rhat,
+)
 from bdlz_tpu.sampling.ensemble import EnsembleState, run_ensemble, stretch_step
 from bdlz_tpu.sampling.likelihoods import (
     make_pipeline_logprob,
@@ -16,9 +22,14 @@ from bdlz_tpu.sampling.likelihoods import (
 
 __all__ = [
     "run_ensemble",
+    "run_ensemble_checkpointed",
+    "CheckpointedRun",
     "stretch_step",
     "EnsembleState",
     "planck_gaussian_logp",
     "make_pipeline_logprob",
     "omegas_from_result",
+    "integrated_autocorr_time",
+    "split_rhat",
+    "effective_sample_size",
 ]

@@ -84,13 +84,16 @@ def run_ensemble(
     a: float = 2.0,
     thin: int = 1,
     mesh=None,
+    init_logp=None,
 ) -> EnsembleRun:
     """Run the ensemble for ``n_steps``, keeping every ``thin``-th state.
 
     ``logp_fn`` maps a single (D,) θ to a scalar log-probability (it is
     vmapped internally — make it the full physics pipeline). ``W`` must be
     even and ≥ 2D+2 for a healthy ensemble. With ``mesh`` the walker axis
-    is sharded across devices (dp × sp flattened).
+    is sharded across devices (dp × sp flattened).  ``init_logp`` lets a
+    resuming caller (the checkpointed runner) pass the carried-over (W,)
+    log-probabilities instead of re-evaluating them.
     """
     init_walkers = jnp.asarray(init_walkers, dtype=jnp.float64)
     W, D = init_walkers.shape
@@ -110,7 +113,8 @@ def run_ensemble(
 
     state0 = EnsembleState(
         walkers=init_walkers,
-        logp=logp_vmapped(init_walkers),
+        logp=(logp_vmapped(init_walkers) if init_logp is None
+              else jnp.asarray(init_logp, dtype=jnp.float64)),
         n_accept=jnp.zeros((), dtype=jnp.int64),
     )
 

@@ -189,3 +189,230 @@ class TestLikelihoodRegressions:
                 "--config", str(cfg), "--param", "m_chi_GeV=0.5:2",
                 "--steps", "10", "--burn", "10",
             ])
+
+
+class TestDiagnostics:
+    def test_tau_iid_near_one(self):
+        from bdlz_tpu.sampling import integrated_autocorr_time
+
+        rng = np.random.default_rng(0)
+        chain = rng.normal(size=(2000, 8, 2))
+        tau = integrated_autocorr_time(chain)
+        assert tau.shape == (2,)
+        assert np.all(tau < 1.5)
+
+    def test_tau_detects_correlation(self):
+        """An AR(1) chain with rho=0.9 has tau ~ (1+rho)/(1-rho) = 19."""
+        from bdlz_tpu.sampling import integrated_autocorr_time
+
+        rng = np.random.default_rng(1)
+        n, W = 20000, 4
+        x = np.zeros((n, W, 1))
+        eps = rng.normal(size=(n, W, 1))
+        for t in range(1, n):
+            x[t] = 0.9 * x[t - 1] + eps[t]
+        tau = integrated_autocorr_time(x)
+        assert tau[0] == pytest.approx(19.0, rel=0.25)
+
+    def test_split_rhat_converged_vs_diverged(self):
+        from bdlz_tpu.sampling import split_rhat
+
+        rng = np.random.default_rng(2)
+        good = rng.normal(size=(1000, 8, 2))
+        assert np.all(split_rhat(good) < 1.01)
+        # walkers stuck at different means -> large R-hat
+        bad = rng.normal(size=(1000, 8, 1)) + np.arange(8)[None, :, None] * 5.0
+        assert split_rhat(bad)[0] > 1.5
+        # within-chain drift (first half vs second half) is what SPLIT
+        # R-hat exists to catch
+        drift = rng.normal(size=(1000, 8, 1))
+        drift[500:] += 5.0
+        assert split_rhat(drift)[0] > 1.5
+
+    def test_constant_chain_safe(self):
+        from bdlz_tpu.sampling import integrated_autocorr_time, split_rhat
+
+        chain = np.ones((100, 4, 1))
+        assert np.isfinite(integrated_autocorr_time(chain)).all()
+        assert split_rhat(chain)[0] == 1.0
+
+
+class TestCheckpointResume:
+    """Incremental chains (SURVEY §5): interrupt/resume must be bitwise
+    identical to the uninterrupted run."""
+
+    def _logp(self):
+        import jax.numpy as jnp
+
+        def logp(theta):
+            r = (theta - jnp.array([1.0, -2.0])) / jnp.array([0.7, 1.3])
+            return -0.5 * jnp.sum(r * r)
+
+        return logp
+
+    def _init(self, W=16):
+        import jax
+
+        return 0.1 * np.asarray(jax.random.normal(jax.random.PRNGKey(3), (W, 2)))
+
+    def test_fresh_run_writes_segments(self, tmp_path):
+        from bdlz_tpu.sampling import run_ensemble_checkpointed
+
+        out = str(tmp_path / "chain")
+        run = run_ensemble_checkpointed(
+            5, self._logp(), self._init(), n_steps=60, out_dir=out,
+            checkpoint_every=20,
+        )
+        assert run.segments == 3 and run.resumed_segments == 0
+        assert run.chain.shape == (60, 16, 2)
+        import os
+
+        assert sorted(os.listdir(out)) == [
+            "manifest.json", "seg_00000.npz", "seg_00001.npz", "seg_00002.npz",
+        ]
+
+    def test_resume_after_kill_is_bitwise_identical(self, tmp_path):
+        """Simulate a mid-run kill: keep only the first segment's file and
+        manifest entry, rerun, and require the exact uninterrupted chain."""
+        import json as _json
+        import os
+
+        from bdlz_tpu.sampling import run_ensemble_checkpointed
+
+        out_full = str(tmp_path / "full")
+        full = run_ensemble_checkpointed(
+            5, self._logp(), self._init(), n_steps=60, out_dir=out_full,
+            checkpoint_every=20,
+        )
+
+        out_kill = str(tmp_path / "killed")
+        run_ensemble_checkpointed(
+            5, self._logp(), self._init(), n_steps=60, out_dir=out_kill,
+            checkpoint_every=20,
+        )
+        # "kill" after segment 0: drop later segments as if never written
+        os.remove(f"{out_kill}/seg_00001.npz")
+        os.remove(f"{out_kill}/seg_00002.npz")
+        mpath = f"{out_kill}/manifest.json"
+        m = _json.load(open(mpath))
+        m["done"] = [0]
+        _json.dump(m, open(mpath, "w"))
+
+        resumed = run_ensemble_checkpointed(
+            5, self._logp(), self._init(), n_steps=60, out_dir=out_kill,
+            checkpoint_every=20,
+        )
+        assert resumed.resumed_segments == 1
+        np.testing.assert_array_equal(resumed.chain, full.chain)
+        np.testing.assert_array_equal(resumed.logp_chain, full.logp_chain)
+        assert resumed.acceptance == full.acceptance
+
+    def test_missing_middle_segment_recomputed(self, tmp_path, capsys):
+        import os
+
+        from bdlz_tpu.sampling import run_ensemble_checkpointed
+
+        out = str(tmp_path / "chain")
+        full = run_ensemble_checkpointed(
+            5, self._logp(), self._init(), n_steps=60, out_dir=out,
+            checkpoint_every=20,
+        )
+        os.remove(f"{out}/seg_00001.npz")
+        resumed = run_ensemble_checkpointed(
+            5, self._logp(), self._init(), n_steps=60, out_dir=out,
+            checkpoint_every=20,
+        )
+        assert resumed.resumed_segments == 1  # prefix truncated at the hole
+        assert "recomputing" in capsys.readouterr().err
+        np.testing.assert_array_equal(resumed.chain, full.chain)
+
+    def test_changed_run_invalidates_manifest(self, tmp_path):
+        from bdlz_tpu.sampling import run_ensemble_checkpointed
+
+        out = str(tmp_path / "chain")
+        run_ensemble_checkpointed(
+            5, self._logp(), self._init(), n_steps=40, out_dir=out,
+            checkpoint_every=20,
+        )
+        r = run_ensemble_checkpointed(
+            6, self._logp(), self._init(), n_steps=40, out_dir=out,
+            checkpoint_every=20,
+        )
+        assert r.resumed_segments == 0
+
+
+def test_mcmc_cli_checkpoint_and_diagnostics(tmp_path, capsys):
+    """End-to-end CLI: checkpointed run emits tau/R-hat/n_eff in the summary
+    and a rerun resumes every segment."""
+    import json as _json
+
+    from bdlz_tpu.mcmc_cli import main as mcmc_main
+
+    cfg = tmp_path / "cfg.json"
+    cfg.write_text(_json.dumps(BENCH_OVER))
+    argv = [
+        "--config", str(cfg),
+        "--param", "m_chi_GeV=0.5:2", "--param", "P_chi_to_B=0.01:0.9",
+        "--walkers", "16", "--steps", "20", "--burn", "4",
+        "--checkpoint-dir", str(tmp_path / "ckpt"), "--checkpoint-every", "10",
+    ]
+    mcmc_main(argv)
+    s1 = _json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert s1["resumed_segments"] == 0
+    assert set(s1["tau_int"]) == {"m_chi_GeV", "P_chi_to_B"}
+    assert set(s1["split_rhat"]) == {"m_chi_GeV", "P_chi_to_B"}
+    assert "tau_reliable" in s1
+
+    mcmc_main(argv)
+    s2 = _json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert s2["resumed_segments"] == 2
+    assert s2["posterior_mean"] == s1["posterior_mean"]
+
+
+class TestCheckpointIdentity:
+    def test_changed_likelihood_identity_invalidates_manifest(self, tmp_path):
+        """Segments are samples of a specific posterior: a changed logp
+        fingerprint must force a fresh chain, never splice (review
+        regression)."""
+        import jax.numpy as jnp
+
+        from bdlz_tpu.sampling import run_ensemble_checkpointed
+
+        import jax
+
+        init = 0.1 * np.asarray(jax.random.normal(jax.random.PRNGKey(3), (16, 2)))
+        out = str(tmp_path / "chain")
+
+        def logp_a(theta):
+            return -0.5 * jnp.sum(theta * theta)
+
+        def logp_b(theta):
+            return -0.5 * jnp.sum((theta - 3.0) ** 2)
+
+        run_ensemble_checkpointed(
+            5, logp_a, init, n_steps=40, out_dir=out, checkpoint_every=20,
+            identity={"config": "A"},
+        )
+        r = run_ensemble_checkpointed(
+            5, logp_b, init, n_steps=40, out_dir=out, checkpoint_every=20,
+            identity={"config": "B"},
+        )
+        assert r.resumed_segments == 0
+
+
+def test_mcmc_cli_short_chain_still_summarizes(tmp_path, capsys):
+    """steps - burn < 4 must yield a summary with null split_rhat, not a
+    traceback after the sampling already ran (review regression)."""
+    import json as _json
+
+    from bdlz_tpu.mcmc_cli import main as mcmc_main
+
+    cfg = tmp_path / "cfg.json"
+    cfg.write_text(_json.dumps(BENCH_OVER))
+    mcmc_main([
+        "--config", str(cfg), "--param", "m_chi_GeV=0.5:2",
+        "--walkers", "16", "--steps", "5", "--burn", "3",
+    ])
+    s = _json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert s["split_rhat"]["m_chi_GeV"] is None
+    assert np.isfinite(s["map_logp"])
