@@ -70,12 +70,10 @@ def resolve_P(cfg: Config, profile_csv: Optional[str], momentum_average: bool = 
 
 
 def can_use_quadrature(cfg: Config) -> bool:
-    """Fast-path guard (reference :372)."""
-    return (
-        not cfg.deplete_DM_from_source
-        and cfg.sigma_v_chi_GeV_m2 == 0.0
-        and cfg.Gamma_wash_over_H == 0.0
-    )
+    """Fast-path guard (reference :372) — shared predicate in config.py."""
+    from bdlz_tpu.config import needs_ode_path
+
+    return not needs_ode_path(cfg)
 
 
 def run_point(cfg: Config, P_used: float, backend: str) -> YieldsResult:
@@ -96,13 +94,16 @@ def run_point(cfg: Config, P_used: float, backend: str) -> YieldsResult:
     # General (stiff ODE) path.
     T_hi = cfg.T_max_over_Tp * cfg.T_p_GeV
     T_lo = cfg.T_min_over_Tp * cfg.T_p_GeV
-    if cfg.regime.lower().startswith("therm"):
+    if cfg.regime.lower().startswith("non"):
+        Ychi0 = pp.Y_chi_init
+    else:
+        # thermal — including the reference ODE path's else-branch thermal
+        # default for unknown regimes like "auto" (:399-400), which
+        # validate() admits only on the reference backend
         Ychi0 = float(
             n_chi_equilibrium(T_hi, cfg.m_chi_GeV, cfg.g_chi, cfg.chi_stats, np)
             / entropy_density(T_hi, cfg.g_star_s, np)
         )
-    else:
-        Ychi0 = pp.Y_chi_init
 
     if backend_mod.is_jax_backend(backend):
         from bdlz_tpu.solvers.sdirk import solve_boltzmann_esdirk
@@ -195,9 +196,10 @@ def main(argv: Optional[list] = None) -> None:
         print("ERROR: --config is required (or use --write-template).")
         return
 
-    cfg = validate(load_config(args.config))
-    P_used = resolve_P(cfg, args.profile_csv, momentum_average=args.lz_momentum_average)
+    cfg = load_config(args.config)
     backend = args.backend or cfg.backend
+    cfg = validate(cfg, backend=backend)
+    P_used = resolve_P(cfg, args.profile_csv, momentum_average=args.lz_momentum_average)
 
     result = run_point(cfg, P_used, backend)
 

@@ -17,8 +17,10 @@ reference path byte-identical when absent:
 
 Known reference quirk, resolved here: ``regime: "auto"`` is documented in
 the reference (:50) but crashes its quadrature path with
-``UnboundLocalError`` (:376-384 has no else). This framework
-validates-and-errors on both backends instead; see ``validate()``.
+``UnboundLocalError`` (:376-384 has no else), while its ODE path accepts
+it via an else-branch thermal default (:399-400). This framework
+reproduces the working ODE-path behavior on the reference backend and
+rejects the crashing/strict cases at validation; see ``validate()``.
 """
 from __future__ import annotations
 
@@ -130,21 +132,57 @@ def write_template(path: str) -> None:
     print(f"Wrote template config to {path}")
 
 
-def validate(cfg: Config) -> Config:
+def needs_ode_path(cfg: Config) -> bool:
+    """True when the fast quadrature is invalid and the ODE path runs.
+
+    THE single definition of the reference's ``can_quad`` guard (:372),
+    negated — ``cli.can_use_quadrature``, ``validate``'s regime admission,
+    and the sweep engine's ESDIRK routing must all agree on this predicate
+    or an admitted ``regime:"auto"`` config could route to the quadrature
+    path, which has no unknown-regime fallback.
+    """
+    return (
+        cfg.deplete_DM_from_source
+        or cfg.sigma_v_chi_GeV_m2 != 0.0
+        or cfg.Gamma_wash_over_H != 0.0
+    )
+
+
+def validate(cfg: Config, backend: Optional[str] = None) -> Config:
     """Check field values that the reference either trusts or crashes on.
 
-    In particular ``regime`` must be "thermal" or "nonthermal" (by the
-    reference's prefix convention): the reference documents "auto" (:50) but
-    its quadrature path dies with ``UnboundLocalError`` (:376-384). This
-    framework rejects it up-front on every backend.
+    ``regime`` handling follows the reference exactly where the reference
+    *works*, and rejects-at-validation where it *crashes* (SURVEY §2.1
+    quirks list):
+
+    * the reference's quadrature path dies with ``UnboundLocalError`` on
+      any regime that is neither thermal nor nonthermal (:376-384 has no
+      else branch) — we reject those configs up-front instead of crashing
+      mid-run;
+    * its ODE path has an else branch that silently falls back to the
+      thermal initial condition (:399-400) — on the reference (numpy)
+      backend with the ODE path active, an unknown regime such as
+      ``"auto"`` is therefore *accepted* and reproduces that thermal
+      default (see ``cli.run_point``);
+    * the TPU backend is strict on every path: unknown regimes are
+      rejected, documented here.
+
+    ``backend`` is the *effective* backend (CLI override included);
+    defaults to the config's own key.
     """
+    from bdlz_tpu.backend import is_jax_backend
+
     r = cfg.regime.lower()
     if not (r.startswith("therm") or r.startswith("non")):
-        raise ConfigError(
-            f"regime={cfg.regime!r} is not supported: use 'thermal' or "
-            "'nonthermal'. (The reference pipeline documents 'auto' but "
-            "crashes on it; this framework rejects it explicitly.)"
-        )
+        backend = cfg.backend if backend is None else backend
+        if is_jax_backend(backend) or not needs_ode_path(cfg):
+            raise ConfigError(
+                f"regime={cfg.regime!r} is not supported here: use 'thermal' "
+                "or 'nonthermal'. (The reference's quadrature path crashes on "
+                "it — rejected up-front; its ODE path treats it as the "
+                "thermal default, which this framework reproduces only on "
+                "the reference backend.)"
+            )
     # chi_stats follows the reference convention deliberately: any string
     # not starting with "ferm" is treated as a boson (reference :96).
     if cfg.n_y < 2:

@@ -124,19 +124,44 @@ def solve_scipy_radau(
     rtol: float = 1e-8,
     atol: float = 1e-12,
     reference_step_cap: bool = True,
-    table_n: int = 800,
+    table_n: Optional[int] = 800,
+    pulse_step_cap: bool = False,
 ) -> ODESolution:
-    """Reference-parity ODE integration in x = m/T over [m/T_hi, m/T_lo]."""
+    """Reference-parity ODE integration in x = m/T over [m/T_hi, m/T_lo].
+
+    ``table_n=None`` evaluates the KJMA kernel exactly instead of through
+    the reference's spline table — needed when this solver serves as the
+    ≤1e-6 cross-check reference for the ESDIRK path, which also evaluates
+    exactly (an 800-point spline carries ~1e-4 interpolation bias).
+
+    ``pulse_step_cap=True`` caps Radau's step at x_p·(σ_y/(β/H))/3 — a
+    third of the bounce pulse's width in x.  Without *any* cap, pure local
+    error control can coast through the quiet pre-percolation region with
+    steps larger than the pulse and skip the source entirely (measured:
+    with a smooth dense A/V table Radau returns Y_B ≈ 0).  The reference's
+    own cap (:405) prevents that by brute force at ≥1e6 steps; this one is
+    the physics-aware equivalent of the ESDIRK log-x cap
+    (`sdirk._boltzmann_esdirk_jit`).
+    """
     from scipy.integrate import solve_ivp
 
-    table = SplineAovTable(pp, grid, T_lo, T_hi, n=table_n)
+    table = (
+        SplineAovTable(pp, grid, T_lo, T_hi, n=table_n)
+        if table_n is not None else None
+    )
     rhs = make_rhs(pp, chi_stats, deplete, grid, np, A_over_V_T=table)
 
     x0 = pp.m_chi_GeV / T_hi
     x1 = pp.m_chi_GeV / max(T_lo, 1e-30)
+    x_p = pp.m_chi_GeV / max(pp.T_p_GeV, 1e-30)
     kwargs = {}
-    if reference_step_cap:
-        x_p = pp.m_chi_GeV / max(pp.T_p_GeV, 1e-30)
+    if pulse_step_cap:
+        # explicit request wins over the default-True reference cap — a
+        # silent fallthrough to the reference's ~1e6-step cap would defeat
+        # the caller's stated intent
+        w_u = pp.sigma_y / max(pp.beta_over_H, 1e-30)  # pulse width in ln x
+        kwargs["max_step"] = x_p * w_u / 3.0
+    elif reference_step_cap:
         kwargs["max_step"] = reference_max_step(x0, x1, x_p)
 
     def fun(x, Y):

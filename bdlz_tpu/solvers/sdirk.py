@@ -132,7 +132,11 @@ def esdirk_solve(
             y_new = y_new + h * b[j] * ks[j]
             y_emb = y_emb + h * b_emb[j] * ks[j]
 
-        scale = atol + rtol * jnp.maximum(jnp.abs(y), jnp.abs(y_new))
+        # atol may be scalar or per-component (2,): the Boltzmann state's
+        # components live on scales ~7 decades apart once annihilation
+        # re-thermalizes Y_chi, and the stiff thermalization transient is
+        # unattainable for a 3rd-order method under Y_B's absolute floor
+        scale = jnp.asarray(atol) + rtol * jnp.maximum(jnp.abs(y), jnp.abs(y_new))
         err = jnp.sqrt(jnp.mean(((y_new - y_emb) / scale) ** 2))
         return y_new, err, ks[3]
 
@@ -179,7 +183,11 @@ def esdirk_solve(
 
 @partial(
     jax.jit,
-    static_argnames=("chi_stats", "deplete", "rtol", "atol", "max_steps"),
+    # rtol/atol are traced (atol may be a per-component array — the
+    # Boltzmann state spans ~7 decades between Y_chi and Y_B when
+    # annihilation re-thermalizes chi, and one scalar floor cannot serve
+    # both components); only genuinely structural choices stay static.
+    static_argnames=("chi_stats", "deplete", "max_steps"),
 )
 def _boltzmann_esdirk_jit(
     pp: PointParams,

@@ -54,12 +54,51 @@ def test_template_roundtrip(tmp_path):
     assert cfg == Config()
 
 
-def test_regime_auto_rejected():
-    """The reference documents regime:"auto" but crashes on it
-    (UnboundLocalError at :376-384); this framework errors up-front."""
+def test_regime_auto_rejected_on_quadrature_path():
+    """The reference documents regime:"auto" but crashes its quadrature
+    path on it (UnboundLocalError at :376-384); this framework errors
+    up-front there."""
     cfg = config_from_dict({"regime": "auto"})
     with pytest.raises(ConfigError, match="regime"):
         validate(cfg)
+
+
+def test_regime_auto_rejected_on_jax_backend():
+    """The TPU path is strict on every route: auto is always rejected."""
+    cfg = config_from_dict({"regime": "auto", "Gamma_wash_over_H": 0.01})
+    with pytest.raises(ConfigError, match="regime"):
+        validate(cfg, backend="tpu")
+
+
+def test_regime_auto_accepted_on_reference_ode_path():
+    """The reference's ODE path *works* with auto (else-branch thermal
+    default, :399-400) — the numpy backend must reproduce, not reject."""
+    cfg = config_from_dict({"regime": "auto", "Gamma_wash_over_H": 0.01})
+    assert validate(cfg, backend="numpy") is cfg
+
+
+def test_regime_auto_ode_path_uses_thermal_default():
+    """On the reference backend + ODE path, auto must produce exactly the
+    thermal run (the reference's else-branch default, :399-400)."""
+    from bdlz_tpu.cli import run_point
+
+    over = {
+        "Gamma_wash_over_H": 0.05,
+        "T_min_over_Tp": 0.05,
+        "ode_reference_step_cap": False,  # keep the Radau run fast
+        "P_chi_to_B": 0.14925839040304145,
+        "incident_flux_scale": 1.07e-9,
+    }
+    res_auto = run_point(
+        validate(config_from_dict({"regime": "auto", **over}), backend="numpy"),
+        0.14925839040304145, "numpy",
+    )
+    res_thermal = run_point(
+        config_from_dict({"regime": "thermal", **over}),
+        0.14925839040304145, "numpy",
+    )
+    assert float(res_auto.Y_B) == float(res_thermal.Y_B)
+    assert float(res_auto.Y_chi) == float(res_thermal.Y_chi)
 
 
 def test_backend_key_accepted():
