@@ -27,3 +27,7 @@ from bdlz_tpu.lz.profile import (  # noqa: F401
     find_crossings,
     load_profile_csv,
 )
+from bdlz_tpu.lz.sweep_bridge import (  # noqa: F401
+    probabilities_for_points,
+    profile_fingerprint,
+)

@@ -12,10 +12,15 @@ kernel (:mod:`bdlz_tpu.lz.kernel`):
   quantum ±1 in the denominator is a ≲8% effect at the relevant E/T and is
   absorbed into the same "microphysical matching" bucket the paper defers);
 * each (k, μ=cosθ) node is boosted to the wall frame, v_n = (v μ + v_w)/
-  (1 + v μ v_w), and contributes with the kinetic-theory flux weight
-  max(v_n, 0) — the same ¼ n v̄ bookkeeping as the source term
-  (`first_principles_yields.py:122-123`), resolved per momentum instead of
-  averaged;
+  (1 + v μ v_w), and contributes with the plasma-frame crossing-rate
+  weight max(v μ + v_w, 0): the number of χ per unit wall area per unit
+  time crossing the (moving) wall from a plasma-frame momentum cell is
+  ∝ (v μ + v_w) f(k) k² (the constant γ_w of the area transformation
+  cancels in the ratio) — the same ¼ n v̄ bookkeeping as the source term
+  (`first_principles_yields.py:122-123`), resolved per momentum instead
+  of averaged.  v_n remains the traversal speed that P is evaluated at;
+  weighting by the *composed* v_n instead would skew head-on
+  high-momentum nodes by 1/(1 + v μ v_w), an O(v·v_w) bias at large v_w;
 * the coherent two-channel propagation runs per node with traversal speed
   v_n (a vmap over `propagate_quaternion` — segments × nodes stay batched
   on the TPU), and the flux-weighted average gives
@@ -147,11 +152,11 @@ def momentum_averaged_probability(
     v = k / jnp.maximum(E, 1e-300)                # plasma-frame speed
     fk = (k * k) * jnp.exp(-jnp.asarray(res_np))
 
-    # μ-integral over the incident hemisphere only: the flux factor
-    # max(v_n, 0) kinks at μ* = −v_w/v, which would wreck Gauss–Legendre
-    # convergence if left inside the domain — so the nodes are mapped per k
-    # onto [μ*(k), 1] (for v < v_w the whole sphere is incident and μ*
-    # clips to −1).  The map is quadratic at the lower endpoint,
+    # μ-integral over the incident hemisphere only: the crossing-rate
+    # weight max(vμ + v_w, 0) kinks at μ* = −v_w/v (the same sign change
+    # as v_n), which would wreck Gauss–Legendre convergence if left inside
+    # the domain — so the nodes are mapped per k onto [μ*(k), 1] (for
+    # v < v_w the whole sphere is incident and μ* clips to −1).  The map is quadratic at the lower endpoint,
     # μ = μ* + (1−μ*)u², clustering nodes where v_n → 0: the probability
     # rises steeply toward the adiabatic limit there, and the clustering
     # restores spectral convergence (tested: doubling orders moves ⟨P⟩ by
@@ -163,7 +168,12 @@ def momentum_averaged_probability(
     mu = mu_star[:, None] + span * u[None, :] ** 2
     mu_jac = span * 2.0 * u[None, :] * wu[None, :]                     # dμ weights
     v_n = _wall_frame_normal_speed(v[:, None], mu, v_w)                # (n_k, n_mu)
-    flux = jnp.maximum(v_n, 0.0)                  # incident-only flux weight
+    # Plasma-frame crossing rate through the moving wall per momentum
+    # cell: ∝ (vμ + v_w), zero for non-incident nodes.  Same sign change
+    # (and therefore the same μ* kink) as v_n, but without the 1/(1+vμv_w)
+    # composition factor, which belongs to the traversal speed, not the
+    # flux measure (see module docstring).
+    flux = jnp.maximum(v[:, None] * mu + v_w, 0.0)
 
     if method == "coherent":
         a, b, dxi = _segment_hamiltonians(profile, jnp)

@@ -1,0 +1,112 @@
+"""Per-sweep-point LZ probabilities: the profile→P seam inside scans.
+
+The reference's seam (`first_principles_yields.py:317-328`) resolves the
+conversion probability once per process, so a v_w scan there can only
+sweep P as an independent number.  This bridge closes the loop for the
+framework's sweep/MCMC layers: given a bounce profile, every grid point's
+P is derived from *that point's* wall speed (and optionally its T_p and
+m_χ for the momentum average), so wall-speed scans exercise the
+distributed-LZ physics end to end.
+
+Methods (accuracy contract in mind):
+
+* ``"local"`` — P(v) = 1 − e^(−2πλ₁/v) with λ₁ = Σᵢ λᵢ(v=1) over all
+  crossings (λ ∝ 1/v per crossing, paper Eq. 8).  Analytic in v ⇒
+  spectrally exact; the right default for the ≤1e-6 pipeline contract
+  (`lz/momentum.py` method="local" notes).
+* ``"coherent"`` — full transfer-matrix propagation per unique wall speed
+  (batched vmap).  Carries physical Stückelberg oscillations in 1/v — use
+  when interference structure is the object of study.
+* ``"local-momentum"`` — flux-weighted thermal average of the local
+  composition per unique (v_w, T_p, m_χ) combination (the paper's F(k)
+  layer applied point-wise).
+"""
+from __future__ import annotations
+
+from typing import Union
+
+import numpy as np
+
+from bdlz_tpu.lz.kernel import local_lambdas
+from bdlz_tpu.lz.profile import BounceProfile, find_crossings, load_profile_csv
+
+VALID_METHODS = ("local", "coherent", "local-momentum")
+
+
+def profile_fingerprint(profile: Union[str, BounceProfile]) -> str:
+    """Stable identity of a profile for sweep-manifest hashing."""
+    import hashlib
+
+    if isinstance(profile, str):
+        profile = load_profile_csv(profile)
+    h = hashlib.sha256()
+    for arr in (profile.xi, profile.delta, profile.mix):
+        h.update(np.ascontiguousarray(np.asarray(arr, dtype=np.float64)).tobytes())
+    return h.hexdigest()[:16]
+
+
+def probabilities_for_points(
+    profile: Union[str, BounceProfile],
+    v_w,
+    method: str = "local",
+    T_p_GeV=None,
+    m_chi_GeV=None,
+) -> np.ndarray:
+    """P_{χ→B} for each sweep point's wall speed (host-side, pre-sweep).
+
+    ``v_w`` is the (n_points,) array of wall speeds; for
+    ``method="local-momentum"`` the per-point ``T_p_GeV``/``m_chi_GeV``
+    arrays are required too.  Work is done per *unique* parameter
+    combination (a v_w scan over a big product grid repeats each speed
+    many times), then scattered back — grid build stays O(n_unique), not
+    O(n_points).
+    """
+    if method not in VALID_METHODS:
+        raise ValueError(f"method must be one of {VALID_METHODS}, got {method!r}")
+    if isinstance(profile, str):
+        profile = load_profile_csv(profile)
+
+    v_w = np.asarray(v_w, dtype=np.float64)
+
+    if method == "local":
+        lam1 = float(np.sum(local_lambdas(find_crossings(profile), v_w=1.0)))
+        v = np.clip(v_w, 1e-6, 1.0 - 1e-12)
+        return 1.0 - np.exp(-2.0 * np.pi * lam1 / v)
+
+    if method == "coherent":
+        # jax_numpy() probes the accelerator relay before the first
+        # backend touch — a direct jax import here could hang forever on
+        # a dead relay (documented environment failure mode)
+        from bdlz_tpu.backend import jax_numpy
+
+        jnp = jax_numpy()
+        import jax
+
+        from bdlz_tpu.lz.kernel import _segment_hamiltonians, propagate_quaternion
+
+        a, b, dxi = _segment_hamiltonians(profile, jnp)
+        uniq, inverse = np.unique(v_w, return_inverse=True)
+        speeds = jnp.clip(jnp.asarray(uniq), 1e-6, 1.0 - 1e-12)
+
+        def P_of_speed(speed):
+            q = propagate_quaternion(a, b, dxi, speed, jnp)
+            return q[1] ** 2 + q[2] ** 2
+
+        P_uniq = np.asarray(jax.vmap(P_of_speed)(speeds))
+        return P_uniq[inverse]
+
+    # local-momentum: unique (v_w, T_p, m_chi) combinations
+    if T_p_GeV is None or m_chi_GeV is None:
+        raise ValueError("method='local-momentum' needs per-point T_p_GeV and m_chi_GeV")
+    from bdlz_tpu.lz.momentum import momentum_averaged_probability
+
+    T_p = np.broadcast_to(np.asarray(T_p_GeV, dtype=np.float64), v_w.shape)
+    m = np.broadcast_to(np.asarray(m_chi_GeV, dtype=np.float64), v_w.shape)
+    combos = np.stack([v_w, T_p, m], axis=1)
+    uniq, inverse = np.unique(combos, axis=0, return_inverse=True)
+    P_uniq = np.empty(len(uniq))
+    for i, (vw_i, T_i, m_i) in enumerate(uniq):
+        P_uniq[i], _ = momentum_averaged_probability(
+            profile, float(vw_i), float(T_i), float(m_i), method="local"
+        )
+    return P_uniq[inverse]

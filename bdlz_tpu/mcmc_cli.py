@@ -64,7 +64,8 @@ def main(argv=None) -> None:
     from bdlz_tpu.parallel import make_mesh
     from bdlz_tpu.sampling import make_pipeline_logprob, run_ensemble
 
-    cfg = validate(load_config(args.config))
+    # the MCMC likelihood always executes on the JAX path — strict validation
+    cfg = validate(load_config(args.config), backend="tpu")
     static = static_choices_from_config(cfg)
     params = dict(parse_param(s) for s in args.param)
 

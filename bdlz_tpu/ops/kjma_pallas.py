@@ -5,7 +5,7 @@ Why this kernel exists: the sweep engine's fast path is, per y-node, a
 (:mod:`bdlz_tpu.ops.kjma_table`).  Expressed as `values[idx]` that is an
 XLA gather, and measured on a v5e chip the gather alone is ~90% of the
 whole pipeline's runtime (XLA TPU lowers small-table gathers to a slow
-serial form; see `docs/` notes and the bench history).  TPUs have no
+serial form; measurements in `docs/perf_notes.md`).  TPUs have no
 hardware gather, but they have a 128x128 systolic array — so this kernel
 reformulates the lookup as dense MXU work:
 

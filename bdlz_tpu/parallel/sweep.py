@@ -94,14 +94,17 @@ def build_grid(
 
 
 def grid_hash(
-    base: Config, axes: Mapping[str, Sequence[float]], n_y: int, impl: str = "tabulated"
+    base: Config, axes: Mapping[str, Sequence[float]], n_y: int, impl: str = "tabulated",
+    extra: Optional[Mapping[str, Any]] = None,
 ) -> str:
     """Identity of a sweep for resume validation: config + axes + grid + engine.
 
     The engine is part of the identity: resuming a directory with a
     different impl must invalidate the manifest, or chunks computed by
     different engines (which agree only to ~1e-4 across the
-    quadrature/ODE boundary) would be silently concatenated.
+    quadrature/ODE boundary) would be silently concatenated.  ``extra``
+    folds in any further identity (e.g. the LZ-profile fingerprint when P
+    is derived per point — different profiles are different sweeps).
     """
     import dataclasses
 
@@ -111,6 +114,10 @@ def grid_hash(
         "n_y": n_y,
         "impl": impl,
     }
+    if extra:
+        # only present when used — an unconditional key (even None) would
+        # change every existing sweep's hash and invalidate old manifests
+        payload["extra"] = dict(extra)
     return hashlib.sha256(json.dumps(payload, sort_keys=True).encode()).hexdigest()[:16]
 
 
@@ -191,7 +198,10 @@ def make_sweep_step(
         from bdlz_tpu.physics.thermo import entropy_density, n_chi_equilibrium
         from bdlz_tpu.solvers.sdirk import solve_boltzmann_esdirk
 
-        thermal = static.regime.lower().startswith("therm")
+        # unknown regimes fall to THERMAL, matching the reference ODE
+        # path's else-branch default (:399-400) and cli.run_point — not to
+        # nonthermal, which a startswith("therm") test would silently pick
+        thermal = not static.regime.lower().startswith("non")
 
         def one(pp, grid):
             T_hi = pp.T_max_over_Tp * pp.T_p_GeV
@@ -278,6 +288,8 @@ def run_sweep(
     impl: str = "tabulated",
     interpret: bool = False,
     fuse_exp: bool = False,
+    lz_profile=None,
+    lz_method: str = "local",
 ) -> SweepResult:
     """Run a full sweep: grid build → per-chunk jitted sharded evaluation →
     (optional) chunk files + manifest with resume.
@@ -287,6 +299,13 @@ def run_sweep(
     TPU), or ``"direct"``.  If ``axes`` sweeps I_p the tabulated/pallas
     fast paths are invalid (the F-table is per-I_p); the engine falls back
     to the direct (n_y × n_z) kernel automatically.
+
+    ``lz_profile`` (path or BounceProfile) derives each point's P from its
+    own wall speed through the two-channel LZ kernel instead of the config
+    number — the reference seam (:317-328) resolved per sweep point, so
+    v_w scans exercise the distributed-LZ physics end to end.
+    ``lz_method`` picks the estimator (see ``lz.sweep_bridge``); the
+    profile fingerprint joins the manifest hash.
     """
     import jax
     import jax.numpy as jnp
@@ -295,8 +314,37 @@ def run_sweep(
     from bdlz_tpu.ops.kjma_table import make_f_table
     from bdlz_tpu.physics.percolation import make_kjma_grid
 
-    pp_all = build_grid(base, axes)
+    # With a profile the config's P is irrelevant (and may be None — the
+    # natural way to use --lz-profile); give build_grid a placeholder that
+    # the per-point probabilities then overwrite.
+    P_base = 0.0 if (lz_profile is not None and base.P_chi_to_B is None) else None
+    pp_all = build_grid(base, axes, P_base=P_base)
     n_total = len(np.asarray(pp_all.m_chi_GeV))
+    hash_extra = None
+    if lz_profile is not None:
+        if "P_chi_to_B" in axes:
+            raise ValueError(
+                "P_chi_to_B cannot be swept when lz_profile derives P per "
+                "point; sweep v_w instead"
+            )
+        from bdlz_tpu.lz.profile import load_profile_csv
+        from bdlz_tpu.lz.sweep_bridge import (
+            probabilities_for_points,
+            profile_fingerprint,
+        )
+
+        if isinstance(lz_profile, str):
+            lz_profile = load_profile_csv(lz_profile)  # parse the CSV once
+        P_pts = probabilities_for_points(
+            lz_profile, np.asarray(pp_all.v_w), method=lz_method,
+            T_p_GeV=np.asarray(pp_all.T_p_GeV),
+            m_chi_GeV=np.asarray(pp_all.m_chi_GeV),
+        )
+        pp_all = pp_all._replace(P=P_pts)
+        hash_extra = {
+            "lz_profile": profile_fingerprint(lz_profile),
+            "lz_method": lz_method,
+        }
     if mesh is not None:
         # The sharded batch axis must divide evenly across the mesh; chunks
         # are padded to chunk_size, so just round chunk_size itself up.
@@ -305,10 +353,10 @@ def run_sweep(
     # The fast quadrature impls are only valid without annihilation,
     # washout, or source depletion (the reference's can_quad guard, :372);
     # a sweep touching those knobs is routed to the stiff ESDIRK path.
+    from bdlz_tpu.config import needs_ode_path
+
     needs_ode = (
-        base.deplete_DM_from_source
-        or base.sigma_v_chi_GeV_m2 != 0.0
-        or base.Gamma_wash_over_H != 0.0
+        needs_ode_path(base)
         or any(
             np.any(np.asarray(axes[k], dtype=np.float64) != 0.0)
             for k in ("sigma_v_chi_GeV_m2", "Gamma_wash_over_H")
@@ -362,7 +410,7 @@ def run_sweep(
 
     manifest_path = None
     manifest: Dict[str, Any] = {}
-    h = grid_hash(base, axes, n_y, impl)
+    h = grid_hash(base, axes, n_y, impl, extra=hash_extra)
     if out_dir is not None:
         import os
 

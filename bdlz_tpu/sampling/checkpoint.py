@@ -138,7 +138,10 @@ def run_ensemble_checkpointed(
 
     if state is None:
         walkers = jnp.asarray(init_walkers)
-        logp0 = jax.vmap(logp_fn)(walkers)
+        # leave logp0 to run_ensemble: it evaluates after sharding the
+        # walkers across the mesh, so the W pipeline evaluations don't all
+        # land on one device
+        logp0 = None
         n_accept = 0
     else:
         walkers = jnp.asarray(state[0])

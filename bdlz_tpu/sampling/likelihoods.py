@@ -49,6 +49,7 @@ def make_pipeline_logprob(
     bounds: Mapping[str, Tuple[float, float]] | None = None,
     log_params: Sequence[str] = (),
     n_y: int = 2000,
+    lz_lambda1: float | None = None,
 ) -> Callable:
     """Build logp(θ) = Planck likelihood of the pipeline at θ.
 
@@ -58,10 +59,21 @@ def make_pipeline_logprob(
     in log10. The returned function maps a (D,) θ to a scalar and is meant
     to be handed to :func:`bdlz_tpu.sampling.run_ensemble`, which vmaps it
     across walkers — each logp evaluation is a full yields-pipeline point.
+
+    ``lz_lambda1`` ties P to the point's wall speed through a bounce
+    profile instead of treating it as a free number: pass
+    Σλᵢ(v_w=1) for the profile (``lz.sweep_bridge`` / ``local_lambdas``)
+    and every evaluation uses P(v_w) = 1 − e^(−2πλ₁/v_w) — analytic in
+    v_w, so sampling v_w exercises the distributed-LZ seam inside jit.
     """
     for k in param_keys:
         if k not in AXIS_MAP:
             raise ValueError(f"unknown parameter {k!r}; valid: {sorted(AXIS_MAP)}")
+    if lz_lambda1 is not None and "P_chi_to_B" in param_keys:
+        raise ValueError(
+            "P_chi_to_B cannot be sampled when lz_lambda1 ties P to the "
+            "profile; sample v_w instead"
+        )
     if "I_p" in param_keys:
         raise ValueError(
             "I_p cannot be a sampled parameter on the tabulated fast path: "
@@ -86,6 +98,9 @@ def make_pipeline_logprob(
                 v = v * GEV_TO_KG  # PointParams stores the baryon mass in kg
             values[AXIS_MAP[k]] = v
         pp = pp0._replace(**values)
+        if lz_lambda1 is not None:
+            v_w = jnp.clip(pp.v_w, 1e-6, 1.0 - 1e-12)
+            pp = pp._replace(P=1.0 - jnp.exp(-2.0 * jnp.pi * lz_lambda1 / v_w))
         pp = PointParams(*(jnp.asarray(f) for f in pp))
         res = point_yields_fast(pp, static, table, jnp, n_y=n_y)
         ob, od = omegas_from_result(res)

@@ -62,6 +62,18 @@ def main(argv=None) -> None:
     ap.add_argument("--fuse-exp", action="store_true", dest="fuse_exp",
                     help="With --impl pallas: evaluate the merged exponential "
                          "inside the kernel (accurate f32 Cody-Waite exp)")
+    ap.add_argument("--lz-profile", default=None, dest="lz_profile",
+                    help="Bounce-profile CSV: derive each point's P_chi_to_B "
+                         "from its own wall speed through the two-channel LZ "
+                         "kernel (v_w scans then exercise the distributed-LZ "
+                         "physics end to end)")
+    ap.add_argument("--lz-method", default="local", dest="lz_method",
+                    choices=("local", "coherent", "local-momentum"),
+                    help="Per-point LZ estimator with --lz-profile: local "
+                         "(analytic composition, spectrally exact — the "
+                         "1e-6-contract default), coherent (full transfer "
+                         "matrix, carries Stueckelberg oscillations), "
+                         "local-momentum (thermal flux-weighted average)")
     ap.add_argument("--multihost", action="store_true",
                     help="Initialize jax.distributed from JAX_COORDINATOR_ADDRESS/"
                          "JAX_NUM_PROCESSES/JAX_PROCESS_ID before building the mesh "
@@ -94,7 +106,8 @@ def main(argv=None) -> None:
     from bdlz_tpu.constants import PLANCK_DM_OVER_B
     from bdlz_tpu.parallel import make_mesh, run_sweep
 
-    cfg = validate(load_config(args.config))
+    # the sweep engine always executes on the JAX path — strict validation
+    cfg = validate(load_config(args.config), backend="tpu")
     axes: Dict[str, np.ndarray] = dict(parse_axis(s) for s in args.axis)
     if not axes:
         raise SystemExit("at least one --axis is required")
@@ -117,6 +130,7 @@ def main(argv=None) -> None:
         mesh=mesh, chunk_size=args.chunk, n_y=args.n_y, out_dir=args.out,
         event_log=event_log, trace_dir=args.profile_dir,
         impl=args.impl, interpret=interpret, fuse_exp=args.fuse_exp,
+        lz_profile=args.lz_profile, lz_method=args.lz_method,
     )
 
     ratios = res.outputs["DM_over_B"]

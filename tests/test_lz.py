@@ -288,3 +288,43 @@ def test_cli_momentum_average_flag(tmp_path, capsys, monkeypatch):
     assert "momentum-averaged LZ kernel: F_k =" in out
     assert "[info] Using P_chi_to_B from profile:" in out
     assert "DM/B ratio=" in out
+
+
+class TestSweepBridge:
+    """Per-sweep-point LZ probabilities (the seam resolved inside scans)."""
+
+    def test_local_matches_single_point_kernel(self):
+        from bdlz_tpu.lz import probabilities_for_points
+
+        prof = linear_profile(alpha=1.0, kappa=0.05)
+        v_ws = np.array([0.1, 0.3, 0.3, 0.7])
+        P = probabilities_for_points(prof, v_ws, method="local")
+        lam1 = float(np.sum(local_lambdas(find_crossings(prof), v_w=1.0)))
+        np.testing.assert_allclose(P, 1.0 - np.exp(-2 * np.pi * lam1 / v_ws), rtol=1e-14)
+        # repeated v_w values get identical P
+        assert P[1] == P[2]
+
+    def test_coherent_dedup_matches_per_point(self):
+        from bdlz_tpu.lz import probabilities_for_points
+
+        prof = linear_profile(alpha=1.0, kappa=0.05)
+        v_ws = np.array([0.2, 0.5, 0.2])
+        P = probabilities_for_points(prof, v_ws, method="coherent")
+        for i, vw in enumerate(v_ws):
+            _, P_ref = transfer_matrix_propagation(prof, float(vw))
+            assert P[i] == pytest.approx(float(P_ref), rel=1e-10)
+
+    def test_momentum_method_requires_thermo_inputs(self):
+        from bdlz_tpu.lz import probabilities_for_points
+
+        prof = linear_profile()
+        with pytest.raises(ValueError, match="local-momentum"):
+            probabilities_for_points(prof, [0.3], method="local-momentum")
+
+    def test_fingerprint_distinguishes_profiles(self):
+        from bdlz_tpu.lz import profile_fingerprint
+
+        a = profile_fingerprint(linear_profile(alpha=1.0))
+        b = profile_fingerprint(linear_profile(alpha=1.1))
+        assert a != b
+        assert a == profile_fingerprint(linear_profile(alpha=1.0))

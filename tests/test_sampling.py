@@ -416,3 +416,43 @@ def test_mcmc_cli_short_chain_still_summarizes(tmp_path, capsys):
     s = _json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert s["split_rhat"]["m_chi_GeV"] is None
     assert np.isfinite(s["map_logp"])
+
+
+class TestLZTiedLikelihood:
+    def test_lz_lambda1_ties_P_to_wall_speed(self):
+        """Sampling v_w with lz_lambda1 must equal sampling P explicitly at
+        P(v_w) = 1 - exp(-2 pi lam1 / v_w)."""
+        import jax.numpy as jnp
+
+        from bdlz_tpu.ops.kjma_table import make_f_table
+
+        base = config_from_dict(dict(BENCH_OVER))
+        static = static_choices_from_config(base)
+        table = make_f_table(base.I_p, jnp, n=4096)
+        lam1 = 0.004
+        logp_vw = make_pipeline_logprob(
+            base, static, table, param_keys=("v_w",), n_y=2000,
+            lz_lambda1=lam1,
+        )
+        logp_P = make_pipeline_logprob(
+            base, static, table, param_keys=("v_w", "P_chi_to_B"), n_y=2000,
+        )
+        for vw in (0.1, 0.3, 0.6):
+            P = 1.0 - np.exp(-2 * np.pi * lam1 / vw)
+            got = float(logp_vw(jnp.array([vw])))
+            want = float(logp_P(jnp.array([vw, P])))
+            assert got == pytest.approx(want, rel=1e-12), vw
+
+    def test_lz_lambda1_conflicts_with_sampled_P(self):
+        import jax.numpy as jnp
+
+        from bdlz_tpu.ops.kjma_table import make_f_table
+
+        base = config_from_dict(dict(BENCH_OVER))
+        static = static_choices_from_config(base)
+        table = make_f_table(base.I_p, jnp, n=4096)
+        with pytest.raises(ValueError, match="P_chi_to_B"):
+            make_pipeline_logprob(
+                base, static, table, param_keys=("P_chi_to_B",),
+                lz_lambda1=0.01,
+            )

@@ -320,3 +320,91 @@ class TestFailureMask:
         np.testing.assert_array_equal(
             r2.failed_mask, [False, True, False, True]
         )
+
+
+class TestLZProfileSweep:
+    """The LZ kernel connected to the sweep engine: P derived per point
+    from the profile at that point's wall speed (reference seam :317-328
+    resolved inside scans)."""
+
+    def _profile(self, tmp_path):
+        xi = np.linspace(-200.0, 200.0, 20000)
+        path = tmp_path / "prof.csv"
+        rows = "\n".join(
+            f"{x},{1.0 * x},{0.05}" for x in xi
+        )
+        path.write_text("xi,delta,m_mix\n" + rows + "\n")
+        return str(path)
+
+    def test_v_w_scan_uses_profile_P(self, base_cfg, mesh8, tmp_path):
+        from bdlz_tpu.lz import load_profile_csv, probabilities_for_points
+
+        prof_path = self._profile(tmp_path)
+        static = static_choices_from_config(base_cfg)
+        v_ws = [0.1, 0.3, 0.6]
+        res = run_sweep(
+            base_cfg, {"v_w": v_ws}, static, mesh=mesh8, chunk_size=8,
+            n_y=2000, lz_profile=prof_path,
+        )
+        assert res.n_failed == 0
+
+        # each point must equal a pointwise run with the profile-derived P
+        prof = load_profile_csv(prof_path)
+        P_pts = probabilities_for_points(prof, np.asarray(v_ws))
+        grid_np = make_kjma_grid(np)
+        pp_all = build_grid(base_cfg, {"v_w": v_ws})
+        for i in range(3):
+            pp_i = type(pp_all)(
+                *(np.asarray(f)[i] for f in pp_all)
+            )._replace(P=P_pts[i])
+            ref = point_yields(pp_i, static, grid_np, np)
+            assert res.outputs["DM_over_B"][i] == pytest.approx(
+                float(ref.DM_over_B), rel=1e-9
+            ), i
+
+    def test_P_axis_conflict_rejected(self, base_cfg, mesh8, tmp_path):
+        static = static_choices_from_config(base_cfg)
+        with pytest.raises(ValueError, match="P_chi_to_B"):
+            run_sweep(
+                base_cfg, {"P_chi_to_B": [0.1, 0.2]}, static, mesh=mesh8,
+                lz_profile=self._profile(tmp_path),
+            )
+
+    def test_changed_profile_invalidates_resume(self, base_cfg, mesh8, tmp_path):
+        static = static_choices_from_config(base_cfg)
+        out = str(tmp_path / "sweep")
+        prof_a = self._profile(tmp_path)
+        run_sweep(base_cfg, {"v_w": [0.2, 0.4]}, static, mesh=mesh8,
+                  chunk_size=2, n_y=2000, out_dir=out, lz_profile=prof_a)
+        # different mixing -> different probabilities -> fresh sweep
+        xi = np.linspace(-200.0, 200.0, 20000)
+        prof_b = tmp_path / "prof_b.csv"
+        prof_b.write_text(
+            "xi,delta,m_mix\n"
+            + "\n".join(f"{x},{1.0 * x},{0.08}" for x in xi) + "\n"
+        )
+        r = run_sweep(base_cfg, {"v_w": [0.2, 0.4]}, static, mesh=mesh8,
+                      chunk_size=2, n_y=2000, out_dir=out, lz_profile=str(prof_b))
+        assert r.resumed_chunks == 0
+
+
+def test_lz_profile_sweep_with_unset_P(base_cfg, mesh8, tmp_path):
+    """The natural --lz-profile usage leaves P_chi_to_B unset (None): the
+    profile supplies P, so grid build must not choke on the None
+    placeholder (review regression)."""
+    import dataclasses
+
+    xi = np.linspace(-200.0, 200.0, 20000)
+    prof = tmp_path / "prof.csv"
+    prof.write_text(
+        "xi,delta,m_mix\n"
+        + "\n".join(f"{x},{1.0 * x},{0.05}" for x in xi) + "\n"
+    )
+    cfg = dataclasses.replace(base_cfg, P_chi_to_B=None)
+    static = static_choices_from_config(cfg)
+    res = run_sweep(
+        cfg, {"v_w": [0.2, 0.5]}, static, mesh=mesh8, chunk_size=8,
+        n_y=2000, lz_profile=str(prof),
+    )
+    assert res.n_failed == 0
+    assert np.isfinite(res.outputs["DM_over_B"]).all()
