@@ -57,9 +57,12 @@ def probabilities_for_points(
     ``v_w`` is the (n_points,) array of wall speeds; for
     ``method="local-momentum"`` the per-point ``T_p_GeV``/``m_chi_GeV``
     arrays are required too.  Work is done per *unique* parameter
-    combination (a v_w scan over a big product grid repeats each speed
-    many times), then scattered back — grid build stays O(n_unique), not
-    O(n_points).
+    combination, then scattered back — so a pure v_w scan over a big
+    product grid costs O(n_unique_speeds).  Caveat for local-momentum:
+    its combination key is (v_w, T_p, m_χ), so sweeping any of those
+    axes multiplies the unique count, and each combination is a full
+    host-side thermal average (~ms each) — a warning is emitted when the
+    pre-sweep cost is likely to be noticeable.
     """
     if method not in VALID_METHODS:
         raise ValueError(f"method must be one of {VALID_METHODS}, got {method!r}")

@@ -19,9 +19,13 @@ Env knobs: BDLZ_BENCH_POINTS (default 262144), BDLZ_BENCH_CHUNK (default
 8192 per device — sized so the (chunk × n_y) integrand temporaries fit a
 single v5e chip's 16G HBM), BDLZ_BENCH_NY (default 8000),
 BDLZ_BENCH_IMPL=pallas|tabulated (default: pallas on TPU — the MXU
-interpolation kernel in ops/kjma_pallas.py, ~10x the tabulated XLA path,
-with automatic fallback if it fails the gate — tabulated on CPU),
-BDLZ_BENCH_PLATFORM=cpu to force the host platform (debug only).
+interpolation kernel in ops/kjma_pallas.py, with automatic fallback if it
+fails the gate — tabulated on CPU), BDLZ_BENCH_PLATFORM=cpu to force the
+host platform (debug only), BDLZ_BENCH_RELAY_WAIT_S (default 600 — how
+long to wait for a dead accelerator relay to recover before benching CPU;
+the JSON stamps platform/tpu_unavailable/relay_waited_s either way),
+BDLZ_BENCH_ODE_POINTS (default 1024 — grid size for the secondary stiff
+ESDIRK sweep metric, printed as its own line before the main one).
 """
 from __future__ import annotations
 
@@ -31,36 +35,32 @@ import sys
 import time
 
 
-def _axon_relay_alive() -> bool:
-    """True if the axon TPU relay's compile endpoint accepts connections.
-
-    When the relay is down, any jax backend touch with axon in the
-    platform list hangs forever (observed in this environment) — so the
-    bench probes the socket first and falls back to host CPU rather than
-    hanging the driver.
-    """
-    import socket
-
-    s = socket.socket()
-    s.settimeout(2)
-    try:
-        s.connect(("127.0.0.1", 8083))
-        return True
-    except OSError:
-        return False
-    finally:
-        s.close()
-
-
 def main() -> None:
+    from bdlz_tpu.utils.platform import axon_registered, wait_for_relay
+
     force_cpu = os.environ.get("BDLZ_BENCH_PLATFORM") == "cpu"
+    tpu_unavailable = False
+    relay_waited = 0.0
     # PALLAS_AXON_POOL_IPS is what gates the sitecustomize axon-plugin
     # registration (it force-registers in every process and overrides
     # JAX_PLATFORMS), so it — not JAX_PLATFORMS — tells us whether a dead
-    # relay can hang the backend.
-    if not force_cpu and os.environ.get("PALLAS_AXON_POOL_IPS") and not _axon_relay_alive():
-        print("[bench] axon relay unreachable; falling back to host CPU", file=sys.stderr)
-        force_cpu = True
+    # relay can hang the backend.  A dead relay is an environment state
+    # that can recover (observed), so the bench *waits* for it (bounded)
+    # instead of silently downgrading the round's metric to a CPU number.
+    if not force_cpu and axon_registered():
+        max_wait = float(os.environ.get("BDLZ_BENCH_RELAY_WAIT_S", 600))
+        t_wait = time.time()
+        alive = wait_for_relay(max_wait_s=max_wait, poll_s=15.0)
+        relay_waited = round(time.time() - t_wait, 1)
+        if not alive:
+            print(
+                f"[bench] accelerator relay unreachable after waiting "
+                f"{relay_waited}s; benching host CPU — this is NOT a TPU "
+                "number (tpu_unavailable=true in the JSON)",
+                file=sys.stderr,
+            )
+            force_cpu = True
+            tpu_unavailable = True
     if force_cpu:
         import jax
 
@@ -161,6 +161,19 @@ def main() -> None:
         """
         rng = np.random.default_rng(0)
         sample = rng.choice(n_total, size=8, replace=False)
+        # Deliberate corners beyond the random draw: the grid's flat-index
+        # extremes, the deepest Maxwell-Boltzmann point (max m/T_p), the
+        # most relativistic one (min m/T_p), and the point whose T = m/3
+        # branch seam sits closest to the percolation temperature — the
+        # hard n_eq/vbar discontinuity the 1e-6 contract must survive.
+        m = np.asarray(pp_all.m_chi_GeV)
+        Tp = np.asarray(pp_all.T_p_GeV)
+        corners = np.array([
+            0, n_total - 1,
+            int(np.argmax(m / Tp)), int(np.argmin(m / Tp)),
+            int(np.argmin(np.abs(3.0 * Tp - m))),
+        ])
+        sample = np.unique(np.concatenate([sample, corners]))
         grid_np = make_kjma_grid(np)
         max_rel = 0.0
         ratios0 = np.asarray(run_chunk(0, min(chunk, n_total)))
@@ -209,6 +222,65 @@ def main() -> None:
 
     pps = n_total / seconds
     per_chip = pps / n_dev
+
+    # --- secondary metric: the stiff (ESDIRK) sweep engine ---
+    # Sweeps touching sigma_v/washout/depletion auto-route to the vmapped
+    # ESDIRK integrator; its throughput is a different regime entirely and
+    # gets its own (non-final) metric line plus a field in the main JSON.
+    def esdirk_metric():
+        import dataclasses
+
+        from bdlz_tpu.parallel.sweep import make_sweep_step
+        from bdlz_tpu.physics.percolation import make_kjma_grid as _mkg
+
+        ode_n = int(os.environ.get("BDLZ_BENCH_ODE_POINTS", 1024))
+        base_ode = dataclasses.replace(
+            base, Gamma_wash_over_H=0.01, T_min_over_Tp=0.05
+        )
+        static_ode = static_choices_from_config(base_ode)
+        side_o = max(2, int(round(ode_n ** 0.5)))
+        pp_ode = build_grid(base_ode, {
+            "m_chi_GeV": np.geomspace(0.3, 3.0, side_o),
+            "Gamma_wash_over_H": np.linspace(0.005, 0.1, side_o),
+        })
+        n_ode = int(np.asarray(pp_ode.m_chi_GeV).shape[0])
+        step_ode = make_sweep_step(static_ode, mesh=mesh, impl="esdirk")
+        grid_j = _mkg(jnp)
+        # pad to a device multiple (side_o**2 need not divide n_dev)
+        pad_n = ((n_ode + n_dev - 1) // n_dev) * n_dev
+        ppc = _pad_chunk(pp_ode, 0, n_ode, pad_n)
+        ppc = jax.tree.map(lambda a: jax.device_put(jnp.asarray(a), sharding), ppc)
+        step_ode(ppc, grid_j).DM_over_B.block_until_ready()  # compile warm-up
+        t1 = time.time()
+        out_ode = step_ode(ppc, grid_j).DM_over_B
+        out_ode.block_until_ready()
+        esdirk_seconds = time.time() - t1
+        per_chip_ode = round(n_ode / esdirk_seconds / n_dev, 2)
+        print(
+            json.dumps({
+                "metric": "esdirk_sweep_points_per_sec_per_chip",
+                "value": per_chip_ode,
+                "unit": "stiff ODE param-points/sec/chip (Gamma_wash grid)",
+                "n_points": n_ode,
+                "n_failed": int(
+                    (~np.isfinite(np.asarray(out_ode)[:n_ode])).sum()
+                ),
+                "seconds": round(esdirk_seconds, 3),
+            })
+        )
+        return per_chip_ode
+
+    esdirk_per_chip = None
+    # Skip on the CPU-fallback path (the stiff metric is a TPU-regime
+    # number, and after a relay wait the driver is already waiting) unless
+    # the operator explicitly sized it via the env knob.
+    if jax.devices()[0].platform != "cpu" or os.environ.get("BDLZ_BENCH_ODE_POINTS"):
+        try:
+            esdirk_per_chip = esdirk_metric()
+        except Exception as exc:  # noqa: BLE001 — secondary metric is best-effort
+            print(f"[bench] esdirk metric unavailable: {exc}", file=sys.stderr)
+
+    # main metric LAST (the driver parses the final line)
     print(
         json.dumps(
             {
@@ -221,6 +293,10 @@ def main() -> None:
                 "seconds": round(seconds, 3),
                 "rel_err_vs_reference": float(f"{max_rel:.3e}"),
                 "impl": impl,
+                "platform": jax.devices()[0].platform,
+                "tpu_unavailable": tpu_unavailable,
+                "relay_waited_s": relay_waited,
+                "esdirk_points_per_sec_per_chip": esdirk_per_chip,
             }
         )
     )
