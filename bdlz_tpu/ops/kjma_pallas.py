@@ -12,16 +12,24 @@ reformulates the lookup as dense MXU work:
 * the table is laid out as a (128, 4*128) matrix of four flat-shifted
   copies, ``T4[m, k*128 + c] = F[m*128 + c + k - 1]`` — the shifts bake
   the cubic stencil's row-crossing into the layout;
-* nodes are streamed in column-major (128, ncol) tiles, so each lane
-  column holds 128 consecutive nodes down the sublanes;
+* nodes are streamed in (ncol, 128) tiles: 128 consecutive nodes run
+  along the *lane* axis of each sublane row (Mosaic's block tiling wants
+  lane-dim blocks of exactly 128, sublane blocks of 8);
 * per column, the table *row* per node is selected by a one-hot
   ``(128,128) @ (128,512)`` matmul (exact in f32 — each output is a copy
   of one table entry, no summation error), and the *column* taps by a
-  lane-wise ``take_along_axis`` (the one dynamic-indexing form Mosaic
-  supports natively);
+  one-hot lane mask + lane reduction (again exact — the mask keeps one
+  entry per row; plain VPU ops, no dynamic indexing for Mosaic to trip
+  on);
+* the Pallas grid is 2-D ``(P, ncol/COL_BLOCK)`` — the batch axis times
+  column *blocks* of COL_BLOCK=8 sublane rows, so the kernel jaxpr is
+  O(1) in n_y.  (A first version statically unrolled a Python loop over
+  all ~n_y/128 columns; the jaxpr grew linearly and blew Mosaic's
+  recursive lowering with a RecursionError at n_y=8000 — the grid is
+  the fix.)
 * the cubic Lagrange combine and the multiply by the precomputed
-  integrand prefactor happen in-register, and the (128, ncol) integrand
-  tile is written back once.
+  integrand prefactor happen in-register; each grid step writes its own
+  (COL_BLOCK, 128) slice of the (ncol, 128) integrand tile.
 
 Everything precision-critical (y-node generation, table index/fraction,
 the exp arguments, thermodynamic prefactors) is computed OUTSIDE the
@@ -38,7 +46,6 @@ remains the bit-parity reference path.
 """
 from __future__ import annotations
 
-import functools
 from typing import Any
 
 import jax
@@ -60,6 +67,11 @@ i32 = jnp.int32
 #: Table geometry: N entries as (ROWS x LANES), four stencil-shifted copies.
 ROWS = 128
 LANES = 128
+
+#: Lane columns (of 128 nodes each) handled per Pallas grid step.  Small
+#: static unroll: big enough to amortize per-step overhead, small enough
+#: that the kernel jaxpr stays tiny (the grid, not the unroll, walks n_y).
+COL_BLOCK = 8
 
 
 def build_shifted_table(table: KJMATable) -> jax.Array:
@@ -138,20 +150,27 @@ def split_f64(x):
     return hi, lo
 
 
-def _interp_column(t4, lanes, i1t, st, j):
-    """Cubic F-interpolation for lane column j of a (128, ncol) node tile.
+def _interp_column(t4, subl, i1t, st, j):
+    """Cubic F-interpolation for column j of a (COL_BLOCK, 128) node tile.
 
-    One-hot row selection on the MXU (exact — each output lane copies one
-    table entry, no summation error), lane-wise `take_along_axis` for the
-    column taps, Lagrange cubic combine.  Shared by both kernel variants.
+    Nodes live along the LANE axis (Mosaic requires lane-dim blocks of
+    128, so the column axis sits on sublanes).  The table *row* per node
+    is selected by a one-hot contraction on the MXU — exact in f32: each
+    output is a copy of one table entry, no summation error — and the
+    *column* taps by a one-hot sublane mask + sublane reduction (also
+    exact; plain VPU ops, no dynamic indexing for Mosaic to trip on),
+    then the Lagrange cubic combine.  Shared by both kernel variants.
     """
-    idx = i1t[:, j:j + 1]                       # (128, 1)
+    idx = i1t[j:j + 1, :]                       # (1, 128) node base indices
     r = idx // LANES
     c = idx - r * LANES
-    rsel = (lanes == r).astype(f32)             # one-hot rows
-    picked = jnp.dot(rsel, t4, preferred_element_type=f32)  # (128, 512)
-    cb = jnp.broadcast_to(c, (ROWS, LANES))
-    s = st[:, j:j + 1]
+    rsel = (subl == r).astype(f32)              # (128, 128): [m, n] = m == r[n]
+    # picked[k*128+cc, n] = t4[r[n], k*128+cc]  (contract over table rows)
+    picked = jax.lax.dot_general(
+        t4, rsel, (((0,), (0,)), ((), ())), preferred_element_type=f32
+    )                                           # (512, 128)
+    csel = (subl == c).astype(f32)              # (128, 128): [cc, n] = cc == c[n]
+    s = st[j:j + 1, :]
     sm1, s0, s1_, s2 = s + 1.0, s, s - 1.0, s - 2.0
     w = (
         -(s0 * s1_ * s2) * (1.0 / 6.0),
@@ -159,29 +178,31 @@ def _interp_column(t4, lanes, i1t, st, j):
         -(sm1 * s0 * s2) * 0.5,
         (sm1 * s0 * s1_) * (1.0 / 6.0),
     )
-    acc = jnp.zeros((ROWS, 1), f32)
+    acc = jnp.zeros((1, LANES), f32)
     for k in range(4):
-        fk = jnp.take_along_axis(picked[:, k * LANES:(k + 1) * LANES], cb, axis=1)
-        acc = acc + w[k] * fk[:, 0:1]
+        fk = jnp.sum(
+            picked[k * LANES:(k + 1) * LANES, :] * csel, axis=0, keepdims=True
+        )
+        acc = acc + w[k] * fk
     return acc
 
 
-def _kernel(ncol: int, ghat_ref, i1_ref, s_ref, t4_ref, out_ref):
-    """One parameter point: (128, ncol) node tile -> integrand tile."""
+def _kernel(ghat_ref, i1_ref, s_ref, t4_ref, out_ref):
+    """One (point, column-block) grid step: (COL_BLOCK, 128) nodes ->
+    integrand tile.  The batch axis and the column axis both live in the
+    Pallas grid, so this body (and its jaxpr) is O(1) in n_y."""
     t4 = t4_ref[:]          # (128, 512) f32, resident in VMEM
-    ghat = ghat_ref[0]      # (128, ncol) f32
-    i1t = i1_ref[0]         # (128, ncol) i32
-    st = s_ref[0]           # (128, ncol) f32
-    lanes = jax.lax.broadcasted_iota(i32, (ROWS, LANES), 1)
+    ghat = ghat_ref[0]      # (COL_BLOCK, 128) f32
+    i1t = i1_ref[0]         # (COL_BLOCK, 128) i32
+    st = s_ref[0]           # (COL_BLOCK, 128) f32
+    subl = jax.lax.broadcasted_iota(i32, (ROWS, LANES), 0)
 
-    # Static unroll over lane columns: each j handles 128 consecutive
-    # nodes (down the sublanes), so all slicing below is static.
-    for j in range(ncol):
-        acc = _interp_column(t4, lanes, i1t, st, j)
-        out_ref[0, :, j:j + 1] = ghat[:, j:j + 1] * acc
+    for j in range(COL_BLOCK):
+        acc = _interp_column(t4, subl, i1t, st, j)
+        out_ref[0, j:j + 1, :] = ghat[j:j + 1, :] * acc
 
 
-def _kernel_fused(ncol: int, g2_ref, ahi_ref, alo_ref, i1_ref, s_ref, t4_ref, out_ref):
+def _kernel_fused(g2_ref, ahi_ref, alo_ref, i1_ref, s_ref, t4_ref, out_ref):
     """Fused variant: the merged exponent is evaluated in-kernel.
 
     Same interpolation as `_kernel`, but the per-node integrand is
@@ -192,23 +213,27 @@ def _kernel_fused(ncol: int, g2_ref, ahi_ref, alo_ref, i1_ref, s_ref, t4_ref, ou
     g2 = g2_ref[0]
     i1t = i1_ref[0]
     st = s_ref[0]
-    lanes = jax.lax.broadcasted_iota(i32, (ROWS, LANES), 1)
+    subl = jax.lax.broadcasted_iota(i32, (ROWS, LANES), 0)
 
     e = exp_neg_f32(ahi_ref[0], alo_ref[0])  # whole tile at once
 
-    for j in range(ncol):
-        acc = _interp_column(t4, lanes, i1t, st, j)
-        out_ref[0, :, j:j + 1] = g2[:, j:j + 1] * e[:, j:j + 1] * acc
+    for j in range(COL_BLOCK):
+        acc = _interp_column(t4, subl, i1t, st, j)
+        out_ref[0, j:j + 1, :] = g2[j:j + 1, :] * e[j:j + 1, :] * acc
 
 
-def _tile_specs(n_streams: int, ncol: int):
+def _tile_specs(n_streams: int):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    stream = pl.BlockSpec((1, ROWS, ncol), lambda p: (p, 0, 0), memory_space=pltpu.VMEM)
-    table = pl.BlockSpec((ROWS, 4 * LANES), lambda p: (0, 0), memory_space=pltpu.VMEM)
+    stream = pl.BlockSpec(
+        (1, COL_BLOCK, ROWS), lambda p, jb: (p, jb, 0), memory_space=pltpu.VMEM
+    )
+    table = pl.BlockSpec(
+        (ROWS, 4 * LANES), lambda p, jb: (0, 0), memory_space=pltpu.VMEM
+    )
     return [stream] * n_streams + [table], pl.BlockSpec(
-        (1, ROWS, ncol), lambda p: (p, 0, 0), memory_space=pltpu.VMEM
+        (1, COL_BLOCK, ROWS), lambda p, jb: (p, jb, 0), memory_space=pltpu.VMEM
     )
 
 
@@ -220,18 +245,18 @@ def interp_multiply(
     *,
     interpret: bool = False,
 ) -> jax.Array:
-    """``ghat * cubic_interp(F, i1 + sfrac)`` for (P, 128, ncol) tiles."""
+    """``ghat * cubic_interp(F, i1 + sfrac)`` for (P, ncol, 128) tiles."""
     from jax.experimental import pallas as pl
 
-    P, rows, ncol = ghat.shape
-    assert rows == ROWS
-    in_specs, out_spec = _tile_specs(3, ncol)
+    P, ncol, rows = ghat.shape
+    assert rows == ROWS and ncol % COL_BLOCK == 0
+    in_specs, out_spec = _tile_specs(3)
     return pl.pallas_call(
-        functools.partial(_kernel, ncol),
-        grid=(P,),
+        _kernel,
+        grid=(P, ncol // COL_BLOCK),
         in_specs=in_specs,
         out_specs=out_spec,
-        out_shape=jax.ShapeDtypeStruct((P, ROWS, ncol), f32),
+        out_shape=jax.ShapeDtypeStruct((P, ncol, ROWS), f32),
         interpret=interpret,
     )(ghat, i1, sfrac, t4)
 
@@ -249,27 +274,29 @@ def interp_multiply_fused(
     """``g2 * e^(a_hi+a_lo) * cubic_interp(F, i1 + sfrac)`` on tiles."""
     from jax.experimental import pallas as pl
 
-    P, rows, ncol = g2.shape
-    assert rows == ROWS
-    in_specs, out_spec = _tile_specs(5, ncol)
+    P, ncol, rows = g2.shape
+    assert rows == ROWS and ncol % COL_BLOCK == 0
+    in_specs, out_spec = _tile_specs(5)
     return pl.pallas_call(
-        functools.partial(_kernel_fused, ncol),
-        grid=(P,),
+        _kernel_fused,
+        grid=(P, ncol // COL_BLOCK),
         in_specs=in_specs,
         out_specs=out_spec,
-        out_shape=jax.ShapeDtypeStruct((P, ROWS, ncol), f32),
+        out_shape=jax.ShapeDtypeStruct((P, ncol, ROWS), f32),
         interpret=interpret,
     )(g2, a_hi, a_lo, i1, sfrac, t4)
 
 
 def _to_tiles(a: jax.Array, n_y: int, ncol: int, fill) -> jax.Array:
-    """(P, n_y) node-major -> (P, 128, ncol) column-major tiles, padded."""
+    """(P, n_y) node-major -> (P, ncol, 128) tiles, padded.
+
+    Node n = col*128 + lane: 128 consecutive nodes run along the lane
+    axis of each column row — a plain reshape, no transpose."""
     P = a.shape[0]
     pad = ROWS * ncol - n_y
     if pad:
         a = jnp.concatenate([a, jnp.full((P, pad), fill, a.dtype)], axis=1)
-    # node n = col*128 + sublane  ->  [sublane, col]
-    return a.reshape(P, ncol, ROWS).transpose(0, 2, 1)
+    return a.reshape(P, ncol, ROWS)
 
 
 def integrate_YB_pallas(
@@ -307,7 +334,9 @@ def integrate_YB_pallas(
     """
     xp = jnp
     n_y = max(int(n_y), 2000)
-    ncol = -(-n_y // ROWS)
+    # Columns of 128 nodes, rounded up to whole COL_BLOCK grid steps; the
+    # pad nodes carry zero integrand weight (fill values below).
+    ncol = -(-n_y // (ROWS * COL_BLOCK)) * COL_BLOCK
 
     y_lo, y_hi = quadrature_bounds(pp, xp)
     ys = xp.linspace(y_lo, y_hi, n_y, axis=-1)          # (P, n_y) f64
