@@ -51,6 +51,71 @@ def _wall_frame_normal_speed(v, mu, v_w):
     return (vz + v_w) / (1.0 + vz * v_w)
 
 
+def _k_quadrature(v_w: float, T: float, m: float, n_k: int):
+    """Segmented k-quadrature (nodes, weights, shifted exponents), host-side.
+
+    Built on the distribution's own support (E − m ≤ 45 T bounds the
+    population to e^{-45} relative), segment-wise so every piece is
+    spectrally convergent:
+
+    * breakpoints at k* (where v(k*) = v_w — the μ*-clip gives the
+      integrand a C¹ kink in k there, measured to cap any single Gauss
+      rule at ~1e-4) and at k(E = m + 6T) (end of the thermal bulk);
+    * the first segment, which touches k = 0, integrates in k with plain
+      Gauss–Legendre (≤6 decay lengths; handles the non-relativistic
+      Gaussian √(mT) width a fixed-scale Laguerre grid cannot);
+    * tail segments substitute t = e^{-(E - E_lo)/T} (k dk = E dE), which
+      turns the exponential weight into the linear factor t — the
+      t-integrand k·E·(μ-avg) is analytic because these segments stay
+      away from the k = 0 square-root point of k(E).
+
+    The integrand remains only C² at k*, so n_k-convergence is ~cubic;
+    the 128-node default puts the smooth (local) average at ~5e-7
+    relative (tested across relativistic, NR and massless regimes).
+
+    Returns ``(k, w_k, res)`` with ``res`` the exponential-suppression
+    exponent E/T shifted by its minimum: a constant factor cancels
+    exactly in the flux-weighted ratio but would underflow e.g. e^{-m/T}
+    to zero in the cold limit before cancelling.
+    """
+    n_k = int(n_k)
+    E_max = m + 45.0 * T
+    k_max = float(np.sqrt(E_max * E_max - m * m))
+    k_bulk = float(np.sqrt((m + 6.0 * T) ** 2 - m * m))
+    kstar = m * v_w / np.sqrt(1.0 - v_w * v_w) if m > 0.0 else 0.0
+    breaks = sorted({b for b in (k_bulk, kstar) if 0.0 < b < k_max})
+    edges = [0.0] + breaks + [k_max]
+    n_seg = max(n_k // (len(edges) - 1), 4)
+    x_leg, w_leg = np.polynomial.legendre.leggauss(n_seg)
+    s = 0.5 * (x_leg + 1.0)       # Legendre nodes on [0, 1]
+    ws = 0.5 * w_leg
+    k_parts, w_parts, res_parts = [], [], []
+    for i, (lo, hi) in enumerate(zip(edges[:-1], edges[1:])):
+        E_lo = np.sqrt(lo * lo + m * m)
+        E_hi = np.sqrt(hi * hi + m * m)
+        if i == 0:
+            # bulk segment in k (touches the k = 0 sqrt point of k(E))
+            kk = lo + (hi - lo) * s
+            ww = ws * (hi - lo)
+            res_parts.append(np.sqrt(kk * kk + m * m) / T)
+        else:
+            # tail segment via t = e^{-(E - E_lo)/T}:
+            # ∫ f k² e^{-E/T} dk = T e^{-E_lo/T} ∫ f k E dt on [t_hi, 1];
+            # k ≈ lo ↔ t ≈ 1 and k ≈ hi ↔ t ≈ t_hi.
+            t_hi = np.exp(-(E_hi - E_lo) / T)
+            tt = t_hi + (1.0 - t_hi) * s
+            EE = E_lo - T * np.log(tt)
+            kk = np.sqrt(np.maximum(EE * EE - m * m, 0.0))
+            ww = ws * (1.0 - t_hi) * (T * EE / np.maximum(kk, 1e-300))
+            res_parts.append(np.full(n_seg, E_lo / T))
+        k_parts.append(kk)
+        w_parts.append(ww)
+    k_np = np.concatenate(k_parts)
+    wk_np = np.concatenate(w_parts)
+    res_np = np.concatenate(res_parts)
+    return k_np, wk_np, res_np - res_np.min()
+
+
 def momentum_averaged_probability(
     profile: Union[str, BounceProfile],
     v_w: float,
@@ -87,63 +152,7 @@ def momentum_averaged_probability(
     T = max(float(T_GeV), 1e-30)
     m = max(float(m_GeV), 0.0)
 
-    # k-quadrature on the distribution's own support (E − m ≤ 45 T bounds
-    # the population to e^{-45} relative), built segment-wise so every
-    # piece is spectrally convergent:
-    #
-    # * breakpoints at k* (where v(k*) = v_w — the μ*-clip gives the
-    #   integrand a C¹ kink in k there, measured to cap any single Gauss
-    #   rule at ~1e-4) and at k(E = m + 6T) (end of the thermal bulk);
-    # * the first segment, which touches k = 0, integrates in k with plain
-    #   Gauss–Legendre (≤6 decay lengths; handles the non-relativistic
-    #   Gaussian √(mT) width a fixed-scale Laguerre grid cannot);
-    # * tail segments substitute t = e^{-(E - E_lo)/T} (k dk = E dE), which
-    #   turns the exponential weight into the linear factor t — the
-    #   t-integrand k·E·(μ-avg) is analytic because these segments stay
-    #   away from the k = 0 square-root point of k(E).
-    #
-    # The integrand remains only C² at k*, so n_k-convergence is ~cubic;
-    # the 128-node default puts the smooth (local) average at ~5e-7
-    # relative (tested across relativistic, NR and massless regimes).
-    n_k = int(n_k)
-    E_max = m + 45.0 * T
-    k_max = float(np.sqrt(E_max * E_max - m * m))
-    k_bulk = float(np.sqrt((m + 6.0 * T) ** 2 - m * m))
-    kstar = m * v_w / np.sqrt(1.0 - v_w * v_w) if m > 0.0 else 0.0
-    breaks = sorted({b for b in (k_bulk, kstar) if 0.0 < b < k_max})
-    edges = [0.0] + breaks + [k_max]
-    n_seg = max(n_k // (len(edges) - 1), 4)
-    x_leg, w_leg = np.polynomial.legendre.leggauss(n_seg)
-    s = 0.5 * (x_leg + 1.0)       # Legendre nodes on [0, 1]
-    ws = 0.5 * w_leg
-    k_parts, w_parts, res_parts = [], [], []
-    for i, (lo, hi) in enumerate(zip(edges[:-1], edges[1:])):
-        E_lo = np.sqrt(lo * lo + m * m)
-        E_hi = np.sqrt(hi * hi + m * m)
-        if i == 0:
-            # bulk segment in k (touches the k = 0 sqrt point of k(E))
-            kk = lo + (hi - lo) * s
-            ww = ws * (hi - lo)
-            res_parts.append(np.sqrt(kk * kk + m * m) / T)
-        else:
-            # tail segment via t = e^{-(E - E_lo)/T}:
-            # ∫ f k² e^{-E/T} dk = T e^{-E_lo/T} ∫ f k E dt on [t_hi, 1];
-            # k ≈ lo ↔ t ≈ 1 and k ≈ hi ↔ t ≈ t_hi.
-            t_hi = np.exp(-(E_hi - E_lo) / T)
-            tt = t_hi + (1.0 - t_hi) * s
-            EE = E_lo - T * np.log(tt)
-            kk = np.sqrt(np.maximum(EE * EE - m * m, 0.0))
-            ww = ws * (1.0 - t_hi) * (T * EE / np.maximum(kk, 1e-300))
-            res_parts.append(np.full(n_seg, E_lo / T))
-        k_parts.append(kk)
-        w_parts.append(ww)
-    k_np = np.concatenate(k_parts)
-    wk_np = np.concatenate(w_parts)
-    # Shift the suppression exponent by its minimum: a constant factor
-    # cancels exactly in the flux-weighted ratio but would underflow e.g.
-    # e^{-m/T} to zero in the cold limit before cancelling.
-    res_np = np.concatenate(res_parts)
-    res_np = res_np - res_np.min()
+    k_np, wk_np, res_np = _k_quadrature(v_w, T, m, n_k)
 
     xmu, wmu = np.polynomial.legendre.leggauss(int(n_mu))
 
@@ -204,3 +213,76 @@ def momentum_averaged_probability(
     P_wall = float(P_of_speed(jnp.asarray(v_w)))
     F_k = P_avg / P_wall if P_wall > 0.0 else float("nan")
     return float(np.clip(P_avg, 0.0, 1.0)), F_k
+
+
+def local_momentum_average_batch(
+    profile: Union[str, BounceProfile],
+    v_ws,
+    T_GeV: float,
+    m_GeV: float,
+    n_k: int = 128,
+    n_mu: int = 24,
+) -> np.ndarray:
+    """⟨P⟩(v_w) for MANY wall speeds at one thermal state, method="local".
+
+    Identical math to ``momentum_averaged_probability(..., method="local")``
+    per speed (same segmented k-quadrature, μ*-clustered μ-map and flux
+    weights — tested for parity), but the per-speed jnp pipelines are
+    stacked and evaluated in ONE jitted program: the unbatched function
+    re-traces eagerly per call (~0.5 s each), which makes dense P(v_w)
+    tables (``lz.sweep_bridge.make_P_of_vw_table``) impractically slow.
+    Per-speed k-grids can differ in length by a few nodes (the k* break
+    drops out of the support for v_w past the relativistic edge), so
+    grids are padded with zero-weight nodes to a common length.
+    """
+    # jax_numpy() probes the accelerator relay before the first backend
+    # touch — a direct jit here could hang forever on a dead relay
+    # (documented environment failure mode)
+    from bdlz_tpu.backend import jax_numpy
+
+    jnp = jax_numpy()
+    import jax
+
+    from bdlz_tpu.lz.kernel import local_lambdas
+    from bdlz_tpu.lz.profile import find_crossings
+
+    if isinstance(profile, str):
+        profile = load_profile_csv(profile)
+    v_ws = np.clip(np.asarray(v_ws, dtype=np.float64), 1e-6, 1.0 - 1e-12)
+    T = max(float(T_GeV), 1e-30)
+    m = max(float(m_GeV), 0.0)
+    lam1 = float(np.sum(local_lambdas(find_crossings(profile), v_w=1.0)))
+
+    grids = [_k_quadrature(float(vw), T, m, n_k) for vw in v_ws]
+    width = max(g[0].shape[0] for g in grids)
+
+    def pad(a, fill):
+        return np.pad(a, (0, width - a.shape[0]), constant_values=fill)
+
+    k_b = jnp.asarray(np.stack([pad(g[0], 1.0) for g in grids]))
+    wk_b = jnp.asarray(np.stack([pad(g[1], 0.0) for g in grids]))
+    res_b = jnp.asarray(np.stack([pad(g[2], 0.0) for g in grids]))
+    xmu, wmu = np.polynomial.legendre.leggauss(int(n_mu))
+    u = jnp.asarray(0.5 * (xmu + 1.0))
+    wu = jnp.asarray(0.5 * wmu)
+
+    @jax.jit
+    def averages(v_w_b, k, wk, res):
+        E = jnp.sqrt(k * k + m * m)
+        v = k / jnp.maximum(E, 1e-300)
+        fk = (k * k) * jnp.exp(-res)
+        mu_star = jnp.clip(-v_w_b[:, None] / jnp.maximum(v, 1e-300), -1.0, 1.0)
+        span = (1.0 - mu_star)[..., None]
+        mu = mu_star[..., None] + span * u ** 2
+        mu_jac = span * 2.0 * u * wu
+        v_n = _wall_frame_normal_speed(
+            v[..., None], mu, v_w_b[:, None, None]
+        )
+        flux = jnp.maximum(v[..., None] * mu + v_w_b[:, None, None], 0.0)
+        P = 1.0 - jnp.exp(-2.0 * jnp.pi * lam1 / jnp.maximum(v_n, 1e-6))
+        w3d = wk[..., None] * mu_jac * fk[..., None] * flux
+        norm = jnp.sum(w3d, axis=(1, 2))
+        return jnp.sum(w3d * P, axis=(1, 2)) / jnp.maximum(norm, 1e-300)
+
+    out = np.asarray(averages(jnp.asarray(v_ws), k_b, wk_b, res_b))
+    return np.clip(out, 0.0, 1.0)

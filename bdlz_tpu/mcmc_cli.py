@@ -44,6 +44,20 @@ def main(argv=None) -> None:
                          "segment (bitwise-identical to uninterrupted)")
     ap.add_argument("--checkpoint-every", type=int, default=100,
                     help="Steps per checkpoint segment (with --checkpoint-dir)")
+    ap.add_argument("--lz-profile", default=None, dest="lz_profile",
+                    help="Bounce-profile CSV: tie P_chi_to_B to the sampled "
+                         "wall speed through the two-channel LZ kernel, so "
+                         "sampling v_w samples the distributed-LZ physics")
+    ap.add_argument("--lz-method", default="local", dest="lz_method",
+                    choices=("local", "coherent", "local-momentum"),
+                    help="LZ estimator with --lz-profile: local (analytic in "
+                         "v_w, evaluated exactly in-jit), coherent (full "
+                         "transfer matrix) and local-momentum (thermal "
+                         "flux-weighted average) via a dense P(v_w) "
+                         "interpolation table built once at startup")
+    ap.add_argument("--lz-table-n", type=int, default=0, dest="lz_table_n",
+                    help="Nodes of the P(v_w) table for coherent/"
+                         "local-momentum (0 = per-method default)")
     args = ap.parse_args(argv)
     if not 0 <= args.burn < args.steps:
         raise SystemExit(
@@ -69,10 +83,71 @@ def main(argv=None) -> None:
     static = static_choices_from_config(cfg)
     params = dict(parse_param(s) for s in args.param)
 
+    if not args.lz_profile and (args.lz_method != "local" or args.lz_table_n):
+        raise SystemExit(
+            "--lz-method/--lz-table-n have no effect without --lz-profile"
+        )
+    lz_kwargs = {}
+    _profile_fp = None
+    _table_n = None
+    if args.lz_profile:
+        if "P_chi_to_B" in params:
+            raise SystemExit(
+                "--lz-profile ties P_chi_to_B to the wall speed; sample v_w "
+                "instead of P_chi_to_B"
+            )
+        from bdlz_tpu.lz.profile import find_crossings, load_profile_csv
+        from bdlz_tpu.lz.sweep_bridge import profile_fingerprint
+
+        profile = load_profile_csv(args.lz_profile)
+        _profile_fp = profile_fingerprint(profile)
+        if args.lz_method == "local":
+            from bdlz_tpu.lz.kernel import local_lambdas
+
+            lz_kwargs["lz_lambda1"] = float(
+                np.sum(local_lambdas(find_crossings(profile), v_w=1.0))
+            )
+        elif "v_w" not in params:
+            # pinned wall speed: P is one number — resolve it host-side
+            # and pin it (no interpolation table to build or mistrust)
+            if args.lz_method == "local-momentum":
+                from bdlz_tpu.lz.momentum import local_momentum_average_batch
+
+                P_pin = float(local_momentum_average_batch(
+                    profile, [cfg.v_w], cfg.T_p_GeV, cfg.m_chi_GeV,
+                )[0])
+            else:
+                from bdlz_tpu.lz.sweep_bridge import probabilities_for_points
+
+                P_pin = float(probabilities_for_points(
+                    profile, [cfg.v_w], method="coherent",
+                )[0])
+            import dataclasses
+
+            cfg = dataclasses.replace(cfg, P_chi_to_B=P_pin)
+        else:
+            if args.lz_method == "local-momentum":
+                for k in ("T_p_GeV", "m_chi_GeV"):
+                    if k in params:
+                        raise SystemExit(
+                            f"--lz-method local-momentum builds a 1-D P(v_w) "
+                            f"table at the pinned thermal state; {k} cannot "
+                            "be sampled with it"
+                        )
+            from bdlz_tpu.lz.sweep_bridge import make_P_of_vw_table
+
+            v_lo, v_hi = params["v_w"]
+            ptab = make_P_of_vw_table(
+                profile, args.lz_method, v_lo, v_hi, n=args.lz_table_n,
+                T_p_GeV=cfg.T_p_GeV, m_chi_GeV=cfg.m_chi_GeV, xp=jnp,
+            )
+            lz_kwargs["lz_P_table"] = ptab
+            _table_n = int(ptab.values.shape[0])
+
     table = make_f_table(cfg.I_p, jnp)
     logp = make_pipeline_logprob(
         cfg, static, table,
-        param_keys=tuple(params), bounds=params,
+        param_keys=tuple(params), bounds=params, **lz_kwargs,
     )
 
     n_dev = len(jax.devices())
@@ -99,10 +174,25 @@ def main(argv=None) -> None:
             out_dir=args.checkpoint_dir,
             checkpoint_every=args.checkpoint_every, mesh=mesh,
             # fingerprint of the posterior: full physics config + the
-            # sampled-parameter spec (changing either invalidates resume)
+            # sampled-parameter spec + the LZ seam (changing any
+            # invalidates resume)
             identity={
                 "config": dataclasses.asdict(cfg),
                 "params": {k: list(v) for k, v in params.items()},
+                **(
+                    {
+                        "lz": {
+                            "profile": _profile_fp,
+                            "method": args.lz_method,
+                            # resolved node count, not the raw flag — a
+                            # change to the per-method default must also
+                            # invalidate resume
+                            "table_n": _table_n,
+                        }
+                    }
+                    if args.lz_profile
+                    else {}
+                ),
             },
         )
         full_chain, full_logp = run.chain, run.logp_chain
@@ -148,6 +238,8 @@ def main(argv=None) -> None:
     if args.checkpoint_dir:
         summary["checkpoint_dir"] = args.checkpoint_dir
         summary["resumed_segments"] = resumed_segments
+    if args.lz_profile:
+        summary["lz"] = {"profile": args.lz_profile, "method": args.lz_method}
     if args.out:
         np.savez(args.out, chain=full_chain, logp=full_logp,
                  param_names=list(params))

@@ -67,22 +67,24 @@ def make_f_table(
     return KJMATable(y0=-Y_CLAMP, inv_dy=1.0 / dy, values=F, I_p=I_p)
 
 
-def eval_f_table(y: Array, table: KJMATable, xp) -> Array:
-    """F(clamp(y)) by 4-point (cubic) Lagrange interpolation, batched.
+def cubic_lagrange_uniform(t: Array, values: Array, xp) -> Array:
+    """4-point Lagrange interpolation of uniform-grid ``values`` at
+    fractional index ``t``, batched and trace-safe (pure gathers + FMAs).
 
-    Trace-safe: pure gathers + FMAs, vmap/jit/shard-friendly. Queries are
-    clamped to the table domain, matching the kernel's e^y clamp — above
-    +50 the *caller* applies the hard A/V = 0 cut, as in the direct path.
+    The shared stencil core of every dense lookup table in the package
+    (the KJMA F(y) table here, the P(v_w) table in ``lz.sweep_bridge``):
+    base index clipped to [1, n-3] so the (−1, 0, 1, 2) offsets stay in
+    bounds — queries at the domain edges evaluate exactly to the boundary
+    nodes when ``t`` itself is clipped by the caller.
     """
-    t = (xp.clip(y, -Y_CLAMP, Y_CLAMP) - table.y0) * table.inv_dy
-    n = table.values.shape[0]
+    n = values.shape[0]
     i1 = xp.clip(xp.floor(t).astype("int32"), 1, n - 3)
     s = t - i1  # in [−?, 2]; nodes at offsets (−1, 0, 1, 2) around i1
 
-    f_m1 = table.values[i1 - 1]
-    f_0 = table.values[i1]
-    f_1 = table.values[i1 + 1]
-    f_2 = table.values[i1 + 2]
+    f_m1 = values[i1 - 1]
+    f_0 = values[i1]
+    f_1 = values[i1 + 1]
+    f_2 = values[i1 + 2]
 
     # Lagrange basis on equispaced offsets −1, 0, 1, 2.
     sm1 = s + 1.0
@@ -94,6 +96,17 @@ def eval_f_table(y: Array, table: KJMATable, xp) -> Array:
     w_1 = -(sm1 * s0 * s2) / 2.0
     w_2 = (sm1 * s0 * s1) / 6.0
     return w_m1 * f_m1 + w_0 * f_0 + w_1 * f_1 + w_2 * f_2
+
+
+def eval_f_table(y: Array, table: KJMATable, xp) -> Array:
+    """F(clamp(y)) by 4-point (cubic) Lagrange interpolation, batched.
+
+    Trace-safe: pure gathers + FMAs, vmap/jit/shard-friendly. Queries are
+    clamped to the table domain, matching the kernel's e^y clamp — above
+    +50 the *caller* applies the hard A/V = 0 cut, as in the direct path.
+    """
+    t = (xp.clip(y, -Y_CLAMP, Y_CLAMP) - table.y0) * table.inv_dy
+    return cubic_lagrange_uniform(t, table.values, xp)
 
 
 def area_over_volume_tabulated(

@@ -50,6 +50,7 @@ def make_pipeline_logprob(
     log_params: Sequence[str] = (),
     n_y: int = 2000,
     lz_lambda1: float | None = None,
+    lz_P_table=None,
 ) -> Callable:
     """Build logp(θ) = Planck likelihood of the pipeline at θ.
 
@@ -65,14 +66,23 @@ def make_pipeline_logprob(
     Σλᵢ(v_w=1) for the profile (``lz.sweep_bridge`` / ``local_lambdas``)
     and every evaluation uses P(v_w) = 1 − e^(−2πλ₁/v_w) — analytic in
     v_w, so sampling v_w exercises the distributed-LZ seam inside jit.
+
+    ``lz_P_table`` does the same for the *coherent* (transfer-matrix) and
+    *momentum-averaged* estimators, which have no closed form in v_w:
+    pass a :class:`bdlz_tpu.lz.sweep_bridge.PTable` (built once from the
+    profile by ``make_P_of_vw_table`` over the sampled v_w bounds) and
+    every evaluation interpolates P(v_w) inside jit to the table's
+    interpolation error.  Mutually exclusive with ``lz_lambda1``.
     """
     for k in param_keys:
         if k not in AXIS_MAP:
             raise ValueError(f"unknown parameter {k!r}; valid: {sorted(AXIS_MAP)}")
-    if lz_lambda1 is not None and "P_chi_to_B" in param_keys:
+    if lz_lambda1 is not None and lz_P_table is not None:
+        raise ValueError("pass at most one of lz_lambda1 / lz_P_table")
+    if (lz_lambda1 is not None or lz_P_table is not None) and "P_chi_to_B" in param_keys:
         raise ValueError(
-            "P_chi_to_B cannot be sampled when lz_lambda1 ties P to the "
-            "profile; sample v_w instead"
+            "P_chi_to_B cannot be sampled when the profile ties P to the "
+            "wall speed; sample v_w instead"
         )
     if "I_p" in param_keys:
         raise ValueError(
@@ -101,6 +111,10 @@ def make_pipeline_logprob(
         if lz_lambda1 is not None:
             v_w = jnp.clip(pp.v_w, 1e-6, 1.0 - 1e-12)
             pp = pp._replace(P=1.0 - jnp.exp(-2.0 * jnp.pi * lz_lambda1 / v_w))
+        elif lz_P_table is not None:
+            from bdlz_tpu.lz.sweep_bridge import eval_P_table
+
+            pp = pp._replace(P=eval_P_table(pp.v_w, lz_P_table, jnp))
         pp = PointParams(*(jnp.asarray(f) for f in pp))
         res = point_yields_fast(pp, static, table, jnp, n_y=n_y)
         ob, od = omegas_from_result(res)

@@ -456,3 +456,154 @@ class TestLZTiedLikelihood:
                 base, static, table, param_keys=("P_chi_to_B",),
                 lz_lambda1=0.01,
             )
+
+
+class TestLZTableLikelihood:
+    """The coherent/momentum estimators become samplable through a P(v_w)
+    interpolation table evaluated inside the jitted logp (they are
+    host-side per-point computations with no closed form in v_w)."""
+
+    def _profile(self):
+        from bdlz_tpu.lz.profile import BounceProfile
+
+        xi = np.linspace(-2.0, 2.0, 201)
+        return BounceProfile(xi=xi, delta=2.0 * xi, mix=np.full_like(xi, 0.3))
+
+    def test_coherent_table_ties_P_to_wall_speed(self):
+        """logp sampling v_w with the coherent table must equal logp with P
+        pinned explicitly at the host-side coherent kernel's value, up to
+        the table's interpolation error."""
+        import jax.numpy as jnp
+
+        from bdlz_tpu.lz.sweep_bridge import make_P_of_vw_table, probabilities_for_points
+        from bdlz_tpu.ops.kjma_table import make_f_table
+
+        base = config_from_dict(dict(BENCH_OVER))
+        static = static_choices_from_config(base)
+        table = make_f_table(base.I_p, jnp, n=4096)
+        prof = self._profile()
+        ptab = make_P_of_vw_table(prof, "coherent", 0.2, 0.9, n=1024, xp=jnp)
+        logp_vw = make_pipeline_logprob(
+            base, static, table, param_keys=("v_w",), n_y=2000,
+            lz_P_table=ptab,
+        )
+        logp_P = make_pipeline_logprob(
+            base, static, table, param_keys=("v_w", "P_chi_to_B"), n_y=2000,
+        )
+        for vw in (0.25, 0.5, 0.85):
+            P_host = float(probabilities_for_points(prof, np.array([vw]),
+                                                    method="coherent")[0])
+            got = float(logp_vw(jnp.array([vw])))
+            want = float(logp_P(jnp.array([vw, P_host])))
+            # logp is smooth in P; 1e-8 table error -> ~1e-7 logp shift
+            assert got == pytest.approx(want, rel=1e-6, abs=1e-6), vw
+
+    def test_table_conflicts(self):
+        import jax.numpy as jnp
+
+        from bdlz_tpu.lz.sweep_bridge import make_P_of_vw_table
+        from bdlz_tpu.ops.kjma_table import make_f_table
+
+        base = config_from_dict(dict(BENCH_OVER))
+        static = static_choices_from_config(base)
+        table = make_f_table(base.I_p, jnp, n=4096)
+        ptab = make_P_of_vw_table(self._profile(), "coherent", 0.2, 0.9, n=64,
+                                  xp=jnp)
+        with pytest.raises(ValueError, match="P_chi_to_B"):
+            make_pipeline_logprob(
+                base, static, table, param_keys=("P_chi_to_B",),
+                lz_P_table=ptab,
+            )
+        with pytest.raises(ValueError, match="at most one"):
+            make_pipeline_logprob(
+                base, static, table, param_keys=("v_w",),
+                lz_lambda1=0.01, lz_P_table=ptab,
+            )
+
+    def test_mcmc_cli_coherent_end_to_end(self, tmp_path, capsys):
+        """`mcmc_cli --lz-profile --lz-method coherent` runs end to end."""
+        import json as _json
+
+        from bdlz_tpu.mcmc_cli import main as mcmc_main
+
+        prof = self._profile()
+        csv = tmp_path / "profile.csv"
+        csv.write_text(
+            "xi,delta,m_mix\n"
+            + "\n".join(f"{x},{d},{m}" for x, d, m in
+                        zip(prof.xi, prof.delta, prof.mix))
+            + "\n"
+        )
+        cfg = tmp_path / "cfg.json"
+        cfg.write_text(_json.dumps(BENCH_OVER))
+        mcmc_main([
+            "--config", str(cfg), "--param", "v_w=0.2:0.9",
+            "--walkers", "16", "--steps", "6", "--burn", "2",
+            "--lz-profile", str(csv), "--lz-method", "coherent",
+            "--lz-table-n", "256",
+        ])
+        s = _json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert s["lz"]["method"] == "coherent"
+        assert np.isfinite(s["map_logp"])
+
+    def test_mcmc_cli_rejects_sampled_P_with_profile(self, tmp_path):
+        import json as _json
+
+        from bdlz_tpu.mcmc_cli import main as mcmc_main
+
+        prof = self._profile()
+        csv = tmp_path / "profile.csv"
+        csv.write_text(
+            "xi,delta,m_mix\n"
+            + "\n".join(f"{x},{d},{m}" for x, d, m in
+                        zip(prof.xi, prof.delta, prof.mix))
+            + "\n"
+        )
+        cfg = tmp_path / "cfg.json"
+        cfg.write_text(_json.dumps(BENCH_OVER))
+        with pytest.raises(SystemExit, match="v_w"):
+            mcmc_main([
+                "--config", str(cfg), "--param", "P_chi_to_B=0.01:0.9",
+                "--walkers", "16", "--steps", "6", "--burn", "2",
+                "--lz-profile", str(csv), "--lz-method", "coherent",
+            ])
+
+    def test_mcmc_cli_pinned_vw_resolves_P_without_table(self, tmp_path, capsys):
+        """Not sampling v_w with --lz-profile resolves P once host-side
+        (no table build); the chain then samples other parameters."""
+        import json as _json
+
+        from bdlz_tpu.mcmc_cli import main as mcmc_main
+
+        prof = self._profile()
+        csv = tmp_path / "profile.csv"
+        csv.write_text(
+            "xi,delta,m_mix\n"
+            + "\n".join(f"{x},{d},{m}" for x, d, m in
+                        zip(prof.xi, prof.delta, prof.mix))
+            + "\n"
+        )
+        cfg = tmp_path / "cfg.json"
+        cfg.write_text(_json.dumps(BENCH_OVER))
+        mcmc_main([
+            "--config", str(cfg), "--param", "m_chi_GeV=0.5:2",
+            "--walkers", "16", "--steps", "6", "--burn", "2",
+            "--lz-profile", str(csv), "--lz-method", "coherent",
+        ])
+        s = _json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert s["lz"]["method"] == "coherent"
+        assert np.isfinite(s["map_logp"])
+
+    def test_mcmc_cli_lz_flags_require_profile(self, tmp_path):
+        import json as _json
+
+        from bdlz_tpu.mcmc_cli import main as mcmc_main
+
+        cfg = tmp_path / "cfg.json"
+        cfg.write_text(_json.dumps(BENCH_OVER))
+        with pytest.raises(SystemExit, match="lz-profile"):
+            mcmc_main([
+                "--config", str(cfg), "--param", "v_w=0.2:0.9",
+                "--walkers", "16", "--steps", "6", "--burn", "2",
+                "--lz-method", "coherent",
+            ])
