@@ -243,15 +243,14 @@ def local_momentum_average_batch(
     jnp = jax_numpy()
     import jax
 
-    from bdlz_tpu.lz.kernel import local_lambdas
-    from bdlz_tpu.lz.profile import find_crossings
+    from bdlz_tpu.lz.kernel import lambda_eff_from_profile
 
     if isinstance(profile, str):
         profile = load_profile_csv(profile)
     v_ws = np.clip(np.asarray(v_ws, dtype=np.float64), 1e-6, 1.0 - 1e-12)
     T = max(float(T_GeV), 1e-30)
     m = max(float(m_GeV), 0.0)
-    lam1 = float(np.sum(local_lambdas(find_crossings(profile), v_w=1.0)))
+    lam1 = lambda_eff_from_profile(profile, v_w=1.0)
 
     grids = [_k_quadrature(float(vw), T, m, n_k) for vw in v_ws]
     width = max(g[0].shape[0] for g in grids)

@@ -96,20 +96,40 @@ def main(argv=None) -> None:
                 "--lz-profile ties P_chi_to_B to the wall speed; sample v_w "
                 "instead of P_chi_to_B"
             )
-        from bdlz_tpu.lz.profile import find_crossings, load_profile_csv
+        from bdlz_tpu.lz.profile import load_profile_csv
         from bdlz_tpu.lz.sweep_bridge import profile_fingerprint
 
         profile = load_profile_csv(args.lz_profile)
         _profile_fp = profile_fingerprint(profile)
+        if args.lz_method == "local-momentum":
+            # P then depends on the thermal state too — whether v_w is
+            # sampled (1-D table at pinned T_p/m_chi) or pinned (single
+            # host-side average), a sampled thermal state would silently
+            # decouple P from it
+            for k in ("T_p_GeV", "m_chi_GeV"):
+                if k in params:
+                    raise SystemExit(
+                        f"--lz-method local-momentum evaluates P at the "
+                        f"pinned thermal state; {k} cannot be sampled "
+                        "with it"
+                    )
         if args.lz_method == "local":
-            from bdlz_tpu.lz.kernel import local_lambdas
+            if args.lz_table_n:
+                raise SystemExit(
+                    "--lz-table-n has no effect with --lz-method local "
+                    "(P(v_w) is analytic — no table is built)"
+                )
+            from bdlz_tpu.lz.kernel import lambda_eff_from_profile
 
-            lz_kwargs["lz_lambda1"] = float(
-                np.sum(local_lambdas(find_crossings(profile), v_w=1.0))
-            )
+            lz_kwargs["lz_lambda1"] = lambda_eff_from_profile(profile, v_w=1.0)
         elif "v_w" not in params:
             # pinned wall speed: P is one number — resolve it host-side
             # and pin it (no interpolation table to build or mistrust)
+            if args.lz_table_n:
+                raise SystemExit(
+                    "--lz-table-n has no effect when v_w is not sampled "
+                    "(P is resolved once host-side — no table is built)"
+                )
             if args.lz_method == "local-momentum":
                 from bdlz_tpu.lz.momentum import local_momentum_average_batch
 
@@ -126,14 +146,6 @@ def main(argv=None) -> None:
 
             cfg = dataclasses.replace(cfg, P_chi_to_B=P_pin)
         else:
-            if args.lz_method == "local-momentum":
-                for k in ("T_p_GeV", "m_chi_GeV"):
-                    if k in params:
-                        raise SystemExit(
-                            f"--lz-method local-momentum builds a 1-D P(v_w) "
-                            f"table at the pinned thermal state; {k} cannot "
-                            "be sampled with it"
-                        )
             from bdlz_tpu.lz.sweep_bridge import make_P_of_vw_table
 
             v_lo, v_hi = params["v_w"]

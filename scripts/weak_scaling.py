@@ -1,0 +1,119 @@
+#!/usr/bin/env python3
+"""Weak-scaling probe of the sweep engine's host path on a virtual CPU mesh.
+
+Runs one sweep per device count (1, 2, 4, 8 virtual CPU devices) with a
+FIXED per-device chunk, through the full production path — `run_sweep`
+with chunked out_dir checkpointing, manifest hashing and host gather —
+and reports total points/sec.
+
+Interpretation on this container (ONE physical core): the n virtual
+devices timeshare the core, so ideal weak scaling is *constant total
+points/sec* as devices grow (same arithmetic per point, n× the work in
+n× the time).  Any systematic drop with device count is erosion from the
+sweep's host side: per-shard device_put, cross-device gather of chunk
+outputs, manifest/chunk-file IO.  (Real multi-chip compute scaling can't
+be measured here — this isolates exactly the part of the stack the chips
+don't accelerate.)
+
+One child process per device count (the backend's device count is fixed
+at first JAX touch).  Usage:
+
+    python scripts/weak_scaling.py            # full curve, prints a table
+    python scripts/weak_scaling.py --devices 4  # one point (child mode)
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+PER_DEVICE_POINTS = 2048
+PER_DEVICE_CHUNK = 512
+N_Y = 2000
+
+
+def run_one(n_dev: int) -> None:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", n_dev)
+    jax.config.update("jax_enable_x64", True)
+
+    import numpy as np
+
+    from bdlz_tpu.config import config_from_dict, static_choices_from_config
+    from bdlz_tpu.parallel import make_mesh, run_sweep
+
+    base = config_from_dict(
+        {
+            "regime": "nonthermal",
+            "P_chi_to_B": 0.14925839040304145,
+            "source_shape_sigma_y": 9.0,
+            "incident_flux_scale": 1.07e-9,
+            "Y_chi_init": 4.90e-10,
+        }
+    )
+    n_total = PER_DEVICE_POINTS * n_dev
+    side = int(round(n_total**0.5))
+    axes = {
+        "m_chi_GeV": np.geomspace(0.2, 5.0, side),
+        "v_w": np.linspace(0.05, 0.9, n_total // side),
+    }
+    static = static_choices_from_config(base)
+    mesh = make_mesh(shape=(n_dev, 1))
+
+    with tempfile.TemporaryDirectory() as out:
+        # warm-up sweep (compile) on a throwaway dir, then the timed one
+        run_sweep(base, axes, static, mesh=mesh,
+                  chunk_size=PER_DEVICE_CHUNK * n_dev,
+                  n_y=N_Y, out_dir=os.path.join(out, "warm"))
+        t0 = time.time()
+        res = run_sweep(base, axes, static, mesh=mesh,
+                        chunk_size=PER_DEVICE_CHUNK * n_dev, n_y=N_Y,
+                        out_dir=os.path.join(out, "timed"))
+        dt = time.time() - t0
+    n_pts = int(res.n_points)
+    assert res.n_failed == 0, f"{res.n_failed} failed points"
+    print(json.dumps({
+        "n_devices": n_dev,
+        "n_points": n_pts,
+        "seconds": round(dt, 3),
+        "points_per_sec_total": round(n_pts / dt, 2),
+    }))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--devices", type=int, default=0,
+                    help="child mode: run one device count and print JSON")
+    args = ap.parse_args()
+    if args.devices:
+        run_one(args.devices)
+        return
+
+    rows = []
+    for n in (1, 2, 4, 8):
+        env = dict(os.environ, PYTHONPATH=os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--devices", str(n)],
+            capture_output=True, text=True, env=env, check=True,
+        )
+        row = json.loads(out.stdout.strip().splitlines()[-1])
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+    base_thr = rows[0]["points_per_sec_total"]
+    print("\n| devices | points | seconds | total pts/s | vs 1-dev |")
+    print("|---|---|---|---|---|")
+    for r in rows:
+        print(f"| {r['n_devices']} | {r['n_points']} | {r['seconds']} "
+              f"| {r['points_per_sec_total']} "
+              f"| {r['points_per_sec_total'] / base_thr:.3f} |")
+
+
+if __name__ == "__main__":
+    main()
