@@ -436,6 +436,83 @@ def integrate_YB_pallas(
     return xp.where(y_hi > y_lo, YB, 0.0)
 
 
+def pallas_preflight(
+    chi_stats: str = "fermion",
+    n_points: int = 128,
+    n_y: int = 2000,
+    fuse_exp: bool = False,
+    tol: float = 1e-6,
+    table_n: int = 16384,
+):
+    """Compile-and-compare the kernel on a tiny chunk, on THIS platform.
+
+    Mosaic lowering failures are platform-specific: the interpret-mode
+    tests pass on CPU while the real TPU compile can still die (the r2
+    kernel's RecursionError did exactly that, silently downgrading the
+    round's benchmark to the fallback engine).  This preflight runs the
+    real ``pallas_call`` on a 128-point chunk and compares against the
+    pure-XLA tabulated path, so lowering regressions fail loudly and
+    cheaply before a long sweep.  Returns ``(ok, max_rel_err, detail)``
+    and never raises: a compile/runtime error comes back as
+    ``(False, inf, message)``.
+
+    Callers MUST pass the shapes they are about to run (``n_y``,
+    ``table_n``, ``chi_stats``, ``fuse_exp``): lowering failures are
+    shape-dependent — the r2 RecursionError fired at n_y = 8000 but not
+    at small column counts — so a preflight at a different shape proves
+    nothing about the sweep it gates.
+    """
+    import numpy as _np
+
+    try:
+        from bdlz_tpu.config import config_from_dict, static_choices_from_config
+        from bdlz_tpu.models.yields_pipeline import point_yields_fast
+        from bdlz_tpu.ops.kjma_table import make_f_table
+        from bdlz_tpu.parallel.sweep import build_grid
+
+        base = config_from_dict(
+            {
+                "regime": "nonthermal",
+                "P_chi_to_B": 0.14925839040304145,
+                "source_shape_sigma_y": 9.0,
+                "incident_flux_scale": 1.07e-9,
+                "Y_chi_init": 4.90e-10,
+            }
+        )
+        static = static_choices_from_config(base)._replace(chi_stats=chi_stats)
+        table = make_f_table(base.I_p, jnp, n=table_n)
+        t4 = build_shifted_table(table)
+        rng = _np.random.default_rng(0)
+        # span both n_eq branches (heavy-mass points push T_p below m/3)
+        grid = build_grid(
+            base,
+            {
+                "m_chi_GeV": _np.concatenate(
+                    [rng.uniform(0.1, 5.0, n_points - 2), [300.0, 900.0]]
+                ),
+                "T_p_GeV": rng.uniform(30.0, 300.0, n_points),
+                "v_w": rng.uniform(0.05, 0.95, n_points),
+            },
+            product=False,
+        )
+        grid = jax.tree.map(jnp.asarray, grid)
+        got = _np.asarray(
+            integrate_YB_pallas(
+                grid, chi_stats, table, t4, n_y=n_y, fuse_exp=fuse_exp
+            )
+        )
+        ref = _np.asarray(
+            jax.vmap(lambda p: point_yields_fast(p, static, table, jnp, n_y=n_y).Y_B)(
+                grid
+            )
+        )
+        rel = float(_np.max(_np.abs(got - ref) / _np.abs(ref)))
+        ok = bool(_np.all(_np.isfinite(got)) and rel <= tol)
+        return ok, rel, f"rel_err={rel:.3e} on {n_points} pts (tol {tol:g})"
+    except Exception as exc:  # noqa: BLE001 — preflight must report, not raise
+        return False, float("inf"), f"{type(exc).__name__}: {exc}"
+
+
 def point_yields_pallas(
     pp: PointParams,
     static,

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import hashlib
 import json
+import sys
 import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, Mapping, Optional, Sequence
@@ -370,8 +371,6 @@ def run_sweep(
     if not use_table and impl in ("tabulated", "pallas"):
         impl = "direct"
     if impl != requested_impl:
-        import sys
-
         print(
             f"[sweep] impl {requested_impl!r} is invalid for this configuration; "
             f"using {impl!r} "
@@ -391,6 +390,28 @@ def run_sweep(
         if impl == "pallas":
             from bdlz_tpu.ops.kjma_pallas import build_shifted_table
 
+            if not interpret and jax.devices()[0].platform != "cpu":
+                # Hardware preflight: compile-and-compare the real kernel
+                # on a tiny chunk before committing the whole sweep to it
+                # — Mosaic lowering regressions are platform-specific and
+                # invisible to the CPU interpret-mode tests, so they must
+                # fail loudly here, not silently corrupt a long run.
+                from bdlz_tpu.ops.kjma_pallas import pallas_preflight
+
+                # preflight at the sweep's OWN shapes — lowering failures
+                # are shape-dependent (the r2 RecursionError needed
+                # n_y=8000's column count to fire)
+                ok, _, detail = pallas_preflight(
+                    chi_stats=static.chi_stats, n_y=n_y,
+                    fuse_exp=fuse_exp, table_n=table_nodes,
+                )
+                print(f"[sweep] pallas preflight {'PASS' if ok else 'FAIL'}: "
+                      f"{detail}", file=sys.stderr)
+                if not ok:
+                    raise RuntimeError(
+                        f"pallas preflight failed on this platform: {detail}; "
+                        "rerun with impl='tabulated' or fix the kernel"
+                    )
             aux = (table, build_shifted_table(table))
         else:
             aux = table
@@ -438,8 +459,6 @@ def run_sweep(
     plan = np.zeros((n_chunks, 2), dtype=np.int64)  # [done, prior_n_failed]
     mask_cache: Dict[int, np.ndarray] = {}  # validated masks, avoids re-reads
     if coordinator and manifest.get("chunks"):
-        import sys
-
         for ci in range(n_chunks):
             rec = manifest["chunks"].get(str(ci))
             if rec is None:

@@ -123,6 +123,63 @@ def yb_integrand_tabulated(ys: Array, pp: PointParams, chi_stats: str, table, xp
     return SB / (ss * Hs * Ts) * xp.abs(dTdy)
 
 
+def integrand_stream_probe(pp: PointParams, static, table, xp, n_y: int = 8000):
+    """Per-stage intermediates of the tabulated fast path, for error
+    attribution (scripts/accuracy_audit.py).
+
+    Evaluates the same pieces as :func:`yb_integrand_tabulated` on the
+    same y-grid and returns them separately, so a platform-vs-NumPy
+    comparison can name the stage where f64-emulation error enters
+    (thermo transcendentals vs table interpolation vs the final
+    summation) instead of reporting only the end-to-end drift.
+    """
+    from bdlz_tpu.ops.kjma_table import area_over_volume_tabulated
+
+    n_y = max(int(n_y), 2000)
+    y_lo, y_hi = quadrature_bounds(pp, xp)
+    ys = xp.linspace(y_lo, y_hi, n_y)
+
+    B_safe = xp.maximum(pp.beta_over_H, 1e-30)
+    denom = xp.maximum(1.0 + 2.0 * ys / B_safe, 1e-12)
+    Ts = pp.T_p_GeV / xp.sqrt(denom)
+    dTdy = -(pp.T_p_GeV / B_safe) * denom ** (-1.5)
+    Hs = hubble_rate(Ts, pp.g_star, xp)
+    ss = entropy_density(Ts, pp.g_star_s, xp)
+    Js = (
+        pp.flux_scale
+        * 0.25
+        * n_chi_equilibrium(Ts, pp.m_chi_GeV, pp.g_chi, static.chi_stats, xp)
+        * mean_speed_chi(Ts, pp.m_chi_GeV, xp)
+    )
+    Av = area_over_volume_tabulated(
+        ys, pp.beta_over_H, pp.T_p_GeV, pp.v_w, pp.g_star, table, xp
+    )
+    W = source_window(ys, pp.sigma_y, xp)
+    # "integrand" comes from the REAL fast-path function, not this
+    # probe's re-derivation — and the consistency guard below fails
+    # loudly if a future edit diverges the two, so the audit can never
+    # attribute drift against a stale stage decomposition.
+    integrand = yb_integrand_tabulated(ys, pp, static.chi_stats, table, xp)
+    recombined = pp.P * Js * Av * W / (ss * Hs * Ts) * xp.abs(dTdy)
+    import numpy as _np
+
+    mismatch = _np.max(
+        _np.abs(_np.asarray(recombined) - _np.asarray(integrand))
+    ) / max(float(_np.max(_np.abs(_np.asarray(integrand)))), 1e-300)
+    if mismatch > 1e-12:
+        raise RuntimeError(
+            f"probe stages diverged from yb_integrand_tabulated by "
+            f"{mismatch:.3e} — update integrand_stream_probe to match"
+        )
+    return {
+        "thermo_prefactor": Js / (ss * Hs * Ts) * xp.abs(dTdy),
+        "source_window": W,
+        "area_over_volume": Av,
+        "integrand": integrand,
+        "trapezoid_YB": xp.trapezoid(integrand, ys),
+    }
+
+
 def integrate_YB_quadrature_tabulated(
     pp: PointParams,
     chi_stats: str,

@@ -197,8 +197,24 @@ def main() -> None:
     default_impl = "pallas" if jax.devices()[0].platform != "cpu" else "tabulated"
     impl = os.environ.get("BDLZ_BENCH_IMPL", default_impl)
     run_chunk = None
+    preflight = None
     if impl == "pallas":
         try:
+            if jax.devices()[0].platform != "cpu":
+                # Hardware preflight: compile-and-compare the real kernel
+                # on a tiny chunk FIRST, so a Mosaic lowering regression
+                # fails loudly here instead of surfacing as a silent
+                # engine downgrade after the full-bench warm-up.
+                from bdlz_tpu.ops.kjma_pallas import pallas_preflight
+
+                fuse = os.environ.get("BDLZ_BENCH_FUSE_EXP", "0") == "1"
+                # at the bench's own n_y — lowering failures are
+                # shape-dependent (the r2 RecursionError needed n_y=8000)
+                ok, _, detail = pallas_preflight(n_y=n_y, fuse_exp=fuse)
+                preflight = f"{'PASS' if ok else 'FAIL'}: {detail}"
+                print(f"[bench] pallas preflight {preflight}", file=sys.stderr)
+                if not ok:
+                    raise RuntimeError(f"preflight {preflight}")
             run_chunk = make_run_chunk("pallas")
             max_rel = accuracy_gate(run_chunk)
             if max_rel > 1e-6:
@@ -293,6 +309,7 @@ def main() -> None:
                 "seconds": round(seconds, 3),
                 "rel_err_vs_reference": float(f"{max_rel:.3e}"),
                 "impl": impl,
+                "pallas_preflight": preflight,
                 "platform": jax.devices()[0].platform,
                 "tpu_unavailable": tpu_unavailable,
                 "relay_waited_s": relay_waited,

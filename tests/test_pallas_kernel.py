@@ -153,3 +153,16 @@ def test_pallas_fused_exp_matches_tabulated(setup):
     )
     rel = np.abs(np.asarray(got) - np.asarray(ref)) / np.abs(np.asarray(ref))
     assert rel.max() < 5e-7, rel.max()
+
+
+def test_preflight_reports_failure_without_raising():
+    """On a platform where the real (non-interpret) kernel cannot run —
+    this CPU test env — the preflight must come back as a failure report,
+    never an exception: the bench/sweep gates branch on it."""
+    from bdlz_tpu.ops.kjma_pallas import pallas_preflight
+
+    ok, rel, detail = pallas_preflight(n_points=8)
+    assert isinstance(ok, bool)
+    assert isinstance(detail, str) and detail
+    if not ok:  # the expected outcome on CPU
+        assert rel == float("inf") or rel > 1e-6
