@@ -27,8 +27,13 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
+
+# runnable as `python scripts/accuracy_audit.py` from the repo root even
+# though bdlz_tpu is not pip-installed (sys.path[0] is scripts/)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def main() -> None:
@@ -186,7 +191,7 @@ def main() -> None:
             point_yields_pallas,
         )
 
-        ok, _, detail = pallas_preflight()
+        ok, _, detail = pallas_preflight(n_y=args.n_y)
         report["pallas_preflight"] = f"{'PASS' if ok else 'FAIL'}: {detail}"
         if ok:
             t4 = build_shifted_table(table)
