@@ -42,6 +42,9 @@ REFERENCE_KEYS = (
 
 VALID_REGIMES = ("thermal", "nonthermal")
 VALID_STATS = ("fermion", "boson")
+#: Stiff-integrator tableaus (must match solvers.sdirk._TABLEAUS; a test
+#: asserts the two stay in sync without a config→solver import cycle).
+VALID_ODE_METHODS = ("sdirk4", "kvaerno3")
 
 
 class ConfigError(ValueError):
@@ -99,6 +102,9 @@ class Config:
     # at defaults (documented hang, SURVEY §2.1). True keeps that behavior
     # for parity; False lets SciPy pick adaptive steps.
     ode_reference_step_cap: bool = True
+    # Stiff-integrator tableau on the JAX backend (solvers/sdirk.py):
+    # "sdirk4" (4th-order Hairer-Wanner pair, the default) or "kvaerno3".
+    ode_method: str = "sdirk4"
 
 
 def default_config() -> Dict[str, Any]:
@@ -130,6 +136,24 @@ def write_template(path: str) -> None:
     with open(path, "w", encoding="utf-8") as f:
         json.dump(default_config(), f, indent=2)
     print(f"Wrote template config to {path}")
+
+
+def config_identity_dict(cfg: Config) -> Dict[str, Any]:
+    """The config as a resume-identity payload: reference keys always,
+    framework-extension keys only when they differ from their defaults.
+
+    Used by the sweep-manifest hash and the MCMC checkpoint identity.
+    The filtering is what keeps checkpoints forward-compatible: adding a
+    new extension field (with a default) must NOT invalidate every
+    pre-existing sweep/chain directory — only actually *changing* a knob
+    that affects results should.
+    """
+    defaults = default_config()
+    out: Dict[str, Any] = {k: getattr(cfg, k) for k in REFERENCE_KEYS}
+    for k in defaults:
+        if k not in REFERENCE_KEYS and getattr(cfg, k) != defaults[k]:
+            out[k] = getattr(cfg, k)
+    return out
 
 
 def needs_ode_path(cfg: Config) -> bool:
@@ -187,6 +211,10 @@ def validate(cfg: Config, backend: Optional[str] = None) -> Config:
     # not starting with "ferm" is treated as a boson (reference :96).
     if cfg.n_y < 2:
         raise ConfigError("n_y must be >= 2")
+    if cfg.ode_method not in VALID_ODE_METHODS:
+        raise ConfigError(
+            f"ode_method={cfg.ode_method!r} is not one of {VALID_ODE_METHODS}"
+        )
     return cfg
 
 
@@ -224,6 +252,7 @@ class StaticChoices(NamedTuple):
     regime: str = "nonthermal"
     deplete_DM_from_source: bool = False
     n_y: int = 8000
+    ode_method: str = "sdirk4"
 
 
 def resolve_Y_chi_init(cfg: Config) -> float:
@@ -273,4 +302,5 @@ def static_choices_from_config(cfg: Config) -> StaticChoices:
         regime=cfg.regime,
         deplete_DM_from_source=bool(cfg.deplete_DM_from_source),
         n_y=int(cfg.n_y),
+        ode_method=cfg.ode_method,
     )

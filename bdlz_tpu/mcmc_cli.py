@@ -177,19 +177,19 @@ def main(argv=None) -> None:
     )
     resumed_segments = 0
     if args.checkpoint_dir:
+        from bdlz_tpu.config import config_identity_dict
         from bdlz_tpu.sampling.checkpoint import run_ensemble_checkpointed
-
-        import dataclasses
 
         run = run_ensemble_checkpointed(
             args.seed + 1, logp, init, n_steps=args.steps,
             out_dir=args.checkpoint_dir,
             checkpoint_every=args.checkpoint_every, mesh=mesh,
-            # fingerprint of the posterior: full physics config + the
-            # sampled-parameter spec + the LZ seam (changing any
-            # invalidates resume)
+            # fingerprint of the posterior: the physics config (extension
+            # keys only when non-default, so new framework fields don't
+            # invalidate old chains) + the sampled-parameter spec + the
+            # LZ seam (changing any invalidates resume)
             identity={
-                "config": dataclasses.asdict(cfg),
+                "config": config_identity_dict(cfg),
                 "params": {k: list(v) for k, v in params.items()},
                 **(
                     {

@@ -106,11 +106,15 @@ def grid_hash(
     quadrature/ODE boundary) would be silently concatenated.  ``extra``
     folds in any further identity (e.g. the LZ-profile fingerprint when P
     is derived per point — different profiles are different sweeps).
+
+    The config enters through ``config_identity_dict`` — extension keys
+    only when non-default — so ADDING a framework extension field does
+    not invalidate every pre-existing sweep directory.
     """
-    import dataclasses
+    from bdlz_tpu.config import config_identity_dict
 
     payload = {
-        "base": dataclasses.asdict(base),
+        "base": config_identity_dict(base),
         "axes": {k: list(map(float, v)) for k, v in axes.items()},
         "n_y": n_y,
         "impl": impl,

@@ -122,7 +122,7 @@ def esdirk_solve(
     newton_iters: int = 6,
     h_max=None,
     h_max_fn: Callable | None = None,
-    method: str = "kvaerno3",
+    method: str = "sdirk4",
 ) -> ESDIRKSolution:
     """Integrate dy/dx = rhs(x, y), y shape (2,), x0 < x1, adaptively.
 
@@ -249,7 +249,7 @@ def _boltzmann_esdirk_jit(
     rtol: float,
     atol: float,
     max_steps: int,
-    method: str = "kvaerno3",
+    method: str = "sdirk4",
 ):
     rhs = make_rhs(pp, chi_stats, deplete, grid, jnp)
     x0 = pp.m_chi_GeV / T_hi
@@ -330,9 +330,12 @@ def solve_boltzmann_esdirk(
     rtol: float = 1e-8,
     atol: float = 1e-17,
     max_steps: int = 10_000,
-    method: str = "sdirk4",
+    method: str | None = None,
 ):
     """Boltzmann evolution in x = m/T over [m/T_hi, m/T_lo], JAX path.
+
+    ``method=None`` takes the tableau from ``static.ode_method`` (the
+    config's ``ode_method`` key); an explicit argument overrides it.
 
     Same RHS semantics as the reference ODE path (`first_principles_yields.py
     :270-286`) but with the batched KJMA kernel evaluated exactly (no
@@ -353,6 +356,8 @@ def solve_boltzmann_esdirk(
     steps/point, fewer than the 3rd-order pair needs for 6e-7 at
     atol 1e-16 (perf_notes.md has the full tradeoff table).
     """
+    if method is None:
+        method = static.ode_method
     grid = KJMAGrid(*(jnp.asarray(a) for a in grid))
     return _boltzmann_esdirk_jit(
         pp, jnp.asarray(Y0, dtype=jnp.float64), T_lo, T_hi, grid,

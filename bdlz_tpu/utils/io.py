@@ -26,8 +26,10 @@ def yields_out_payload(cfg: Config, P_used: float, result: YieldsResult) -> Dict
     inputs: Dict[str, Any] = {k: getattr(cfg, k) for k in REFERENCE_KEYS}
     inputs["P_used"] = _scalar(P_used)
     defaults = default_config()
-    for key in ("backend", "m_B_GeV", "n_y", "ode_reference_step_cap"):
-        if getattr(cfg, key) != defaults[key]:
+    # every framework-extension field, in declaration order — derived from
+    # the dataclass so new extensions are covered automatically
+    for key in defaults:
+        if key not in REFERENCE_KEYS and getattr(cfg, key) != defaults[key]:
             inputs[key] = getattr(cfg, key)
     return {
         "inputs": inputs,

@@ -133,3 +133,96 @@ def test_config_json_rejects_unknown(tmp_path):
     path.write_text(json.dumps({"not_a_key": 1}))
     with pytest.raises(ConfigError):
         load_config(str(path))
+
+
+class TestOdeMethodKey:
+    def test_valid_methods_match_solver_tableaus(self):
+        """config.VALID_ODE_METHODS must stay in sync with the solver's
+        tableau registry (no import cycle allows a direct reference)."""
+        from bdlz_tpu.config import VALID_ODE_METHODS
+        from bdlz_tpu.solvers.sdirk import _TABLEAUS
+
+        assert set(VALID_ODE_METHODS) == set(_TABLEAUS)
+
+    def test_unknown_method_rejected(self):
+        from bdlz_tpu.config import ConfigError, config_from_dict, validate
+
+        with pytest.raises(ConfigError, match="ode_method"):
+            validate(config_from_dict({"ode_method": "radau99"}))
+
+    def test_config_key_selects_tableau(self):
+        """static.ode_method flows into solve_boltzmann_esdirk: the config
+        key must reproduce the explicitly-selected tableau bitwise."""
+        import numpy as np
+
+        from bdlz_tpu.config import (
+            config_from_dict,
+            point_params_from_config,
+            static_choices_from_config,
+        )
+        from bdlz_tpu.physics.percolation import make_kjma_grid
+        from bdlz_tpu.solvers.sdirk import solve_boltzmann_esdirk
+
+        raw = {
+            "regime": "nonthermal", "P_chi_to_B": 0.149,
+            "source_shape_sigma_y": 9.0, "incident_flux_scale": 1.07e-9,
+            "Y_chi_init": 4.9e-10, "Gamma_wash_over_H": 0.02,
+            "T_min_over_Tp": 0.2,
+        }
+        grid = make_kjma_grid(np)
+        results = {}
+        for m in ("kvaerno3", "sdirk4"):
+            cfg = config_from_dict(dict(raw, ode_method=m))
+            static = static_choices_from_config(cfg)
+            pp = point_params_from_config(cfg, cfg.P_chi_to_B)
+            sol = solve_boltzmann_esdirk(
+                pp, static, grid, (4.9e-10, 0.0),
+                0.2 * cfg.T_p_GeV, 5.0 * cfg.T_p_GeV,
+            )
+            explicit = solve_boltzmann_esdirk(
+                pp, static, grid, (4.9e-10, 0.0),
+                0.2 * cfg.T_p_GeV, 5.0 * cfg.T_p_GeV, method=m,
+            )
+            assert float(sol.y[1]) == float(explicit.y[1])
+            results[m] = (int(sol.n_steps), float(sol.y[1]))
+        # the tableaus genuinely differ (different step counts)
+        assert results["kvaerno3"][0] != results["sdirk4"][0]
+
+    def test_ode_method_absent_from_default_yields_out(self):
+        """A default config's yields_out inputs must not grow the new key
+        (byte-parity with the reference artifact)."""
+        from bdlz_tpu.config import config_from_dict
+        from bdlz_tpu.models.yields_pipeline import YieldsResult
+        from bdlz_tpu.utils.io import yields_out_payload
+
+        cfg = config_from_dict({"P_chi_to_B": 0.149})
+        res = YieldsResult(1e-11, 5e-10, 1e-28, 1e-27, 5.0)
+        payload = yields_out_payload(cfg, 0.149, res)
+        assert "ode_method" not in payload["inputs"]
+        payload2 = yields_out_payload(
+            config_from_dict({"P_chi_to_B": 0.149, "ode_method": "kvaerno3"}),
+            0.149, res,
+        )
+        assert payload2["inputs"]["ode_method"] == "kvaerno3"
+
+    def test_identity_dict_omits_default_extensions(self):
+        """Resume identities must not grow new extension keys at their
+        defaults — adding a framework field would otherwise invalidate
+        every pre-existing sweep/chain checkpoint."""
+        from bdlz_tpu.config import config_from_dict, config_identity_dict
+        from bdlz_tpu.parallel.sweep import grid_hash
+
+        base = {"P_chi_to_B": 0.149}
+        cfg = config_from_dict(base)
+        ident = config_identity_dict(cfg)
+        for k in ("backend", "m_B_GeV", "n_y", "ode_reference_step_cap",
+                  "ode_method"):
+            assert k not in ident
+        # explicitly writing the default produces the same identity/hash
+        cfg2 = config_from_dict(dict(base, ode_method="sdirk4"))
+        axes = {"m_chi_GeV": [0.5, 1.0]}
+        assert grid_hash(cfg, axes, 2000) == grid_hash(cfg2, axes, 2000)
+        # a NON-default engine knob is part of the identity
+        cfg3 = config_from_dict(dict(base, ode_method="kvaerno3"))
+        assert config_identity_dict(cfg3)["ode_method"] == "kvaerno3"
+        assert grid_hash(cfg, axes, 2000) != grid_hash(cfg3, axes, 2000)
