@@ -16,11 +16,12 @@ reformulates the lookup as dense MXU work:
   along the *lane* axis of each sublane row (Mosaic's block tiling wants
   lane-dim blocks of exactly 128, sublane blocks of 8);
 * per column, the table *row* per node is selected by a one-hot
-  ``(128,128) @ (128,512)`` matmul (exact in f32 — each output is a copy
-  of one table entry, no summation error), and the *column* taps by a
-  one-hot lane mask + lane reduction (again exact — the mask keeps one
-  entry per row; plain VPU ops, no dynamic indexing for Mosaic to trip
-  on);
+  ``(512,128) @ (128,128)`` matmul against the transposed table (exact
+  in f32 — each output is a copy of one table entry, no summation error;
+  the table is BUILT transposed so the in-kernel contraction is the
+  canonical (1,0) form), and the *column* taps by a one-hot sublane mask
+  + reduction (again exact; plain VPU ops, no dynamic indexing for
+  Mosaic to trip on);
 * the Pallas grid is 2-D ``(P, ncol/COL_BLOCK)`` — the batch axis times
   column *blocks* of COL_BLOCK=8 sublane rows, so the kernel jaxpr is
   O(1) in n_y.  (A first version statically unrolled a Python loop over
@@ -75,13 +76,14 @@ COL_BLOCK = 8
 
 
 def build_shifted_table(table: KJMATable) -> jax.Array:
-    """(128, 512) f32 stencil-shifted layout of a 16384-entry F table.
+    """(512, 128) f32 stencil-shifted TRANSPOSED layout of an F table.
 
-    ``T4[m, k*128 + c] = F[clip(m*128 + c + k - 1, 0, N-1)]`` for the four
+    ``T4[k*128 + c, m] = F[clip(m*128 + c + k - 1, 0, N-1)]`` for the four
     cubic taps k = 0..3 (offsets -1..+2 around the base index).  Built
-    once per sweep on the host; the edge clips are unreachable in use
-    because the base index is clipped to [1, N-3] (matching
-    `eval_f_table`).
+    once per sweep on the host, already transposed so the in-kernel
+    row-select is the canonical (1,0)-contraction matmul; the edge clips
+    are unreachable in use because the base index is clipped to [1, N-3]
+    (matching `eval_f_table`).
     """
     flat = np.asarray(table.values, dtype=np.float64)
     n = flat.shape[0]
@@ -97,7 +99,7 @@ def build_shifted_table(table: KJMATable) -> jax.Array:
         if rows < ROWS:  # pad to the fixed one-hot width
             block = np.pad(block, ((0, ROWS - rows), (0, 0)))
         cols.append(block)
-    return jnp.asarray(np.concatenate(cols, axis=1), dtype=f32)
+    return jnp.asarray(np.concatenate(cols, axis=1).T, dtype=f32)
 
 
 #: Cody–Waite constants for the in-kernel f32 exp: ln2 split so n*LN2_HI is
@@ -150,7 +152,7 @@ def split_f64(x):
     return hi, lo
 
 
-def _interp_column(t4, subl, i1t, st, j):
+def _interp_column(t4t, subl, i1t, st, j):
     """Cubic F-interpolation for column j of a (COL_BLOCK, 128) node tile.
 
     Nodes live along the LANE axis (Mosaic requires lane-dim blocks of
@@ -165,10 +167,10 @@ def _interp_column(t4, subl, i1t, st, j):
     r = idx // LANES
     c = idx - r * LANES
     rsel = (subl == r).astype(f32)              # (128, 128): [m, n] = m == r[n]
-    # picked[k*128+cc, n] = t4[r[n], k*128+cc]  (contract over table rows)
-    picked = jax.lax.dot_general(
-        t4, rsel, (((0,), (0,)), ((), ())), preferred_element_type=f32
-    )                                           # (512, 128)
+    # picked[k*128+cc, n] = t4t[k*128+cc, r[n]]: the table arrives
+    # transposed (512, 128), so this is the canonical (1,0)-contraction
+    # matmul — the best-trodden Mosaic lowering path.
+    picked = jnp.dot(t4t, rsel, preferred_element_type=f32)  # (512, 128)
     csel = (subl == c).astype(f32)              # (128, 128): [cc, n] = cc == c[n]
     s = st[j:j + 1, :]
     sm1, s0, s1_, s2 = s + 1.0, s, s - 1.0, s - 2.0
@@ -191,14 +193,14 @@ def _kernel(ghat_ref, i1_ref, s_ref, t4_ref, out_ref):
     """One (point, column-block) grid step: (COL_BLOCK, 128) nodes ->
     integrand tile.  The batch axis and the column axis both live in the
     Pallas grid, so this body (and its jaxpr) is O(1) in n_y."""
-    t4 = t4_ref[:]          # (128, 512) f32, resident in VMEM
+    t4t = t4_ref[:]         # (512, 128) f32 (transposed table), in VMEM
     ghat = ghat_ref[0]      # (COL_BLOCK, 128) f32
     i1t = i1_ref[0]         # (COL_BLOCK, 128) i32
     st = s_ref[0]           # (COL_BLOCK, 128) f32
     subl = jax.lax.broadcasted_iota(i32, (ROWS, LANES), 0)
 
     for j in range(COL_BLOCK):
-        acc = _interp_column(t4, subl, i1t, st, j)
+        acc = _interp_column(t4t, subl, i1t, st, j)
         out_ref[0, j:j + 1, :] = ghat[j:j + 1, :] * acc
 
 
@@ -209,7 +211,7 @@ def _kernel_fused(g2_ref, ahi_ref, alo_ref, i1_ref, s_ref, t4_ref, out_ref):
     ``g2 * exp_neg_f32(a_hi + a_lo) * F`` — the prep then does no
     per-node transcendental at all (the f64 exp was its largest remaining
     cost under TPU f64 emulation)."""
-    t4 = t4_ref[:]
+    t4t = t4_ref[:]
     g2 = g2_ref[0]
     i1t = i1_ref[0]
     st = s_ref[0]
@@ -218,7 +220,7 @@ def _kernel_fused(g2_ref, ahi_ref, alo_ref, i1_ref, s_ref, t4_ref, out_ref):
     e = exp_neg_f32(ahi_ref[0], alo_ref[0])  # whole tile at once
 
     for j in range(COL_BLOCK):
-        acc = _interp_column(t4, subl, i1t, st, j)
+        acc = _interp_column(t4t, subl, i1t, st, j)
         out_ref[0, j:j + 1, :] = g2[j:j + 1, :] * e[j:j + 1, :] * acc
 
 
@@ -230,7 +232,7 @@ def _tile_specs(n_streams: int):
         (1, COL_BLOCK, ROWS), lambda p, jb: (p, jb, 0), memory_space=pltpu.VMEM
     )
     table = pl.BlockSpec(
-        (ROWS, 4 * LANES), lambda p, jb: (0, 0), memory_space=pltpu.VMEM
+        (4 * LANES, ROWS), lambda p, jb: (0, 0), memory_space=pltpu.VMEM
     )
     return [stream] * n_streams + [table], pl.BlockSpec(
         (1, COL_BLOCK, ROWS), lambda p, jb: (p, jb, 0), memory_space=pltpu.VMEM

@@ -44,14 +44,15 @@ def test_shifted_table_layout(setup):
     _, _, table, t4 = setup
     vals = np.asarray(table.values)
     t4 = np.asarray(t4)
-    # spot-check the stencil shifts: T4[m, k*128+c] == F[m*128+c+k-1]
+    assert t4.shape == (512, 128)  # transposed for the canonical matmul
+    # spot-check the stencil shifts: T4[k*128+c, m] == F[m*128+c+k-1]
     rng = np.random.default_rng(0)
     for _ in range(50):
         m = int(rng.integers(0, 128))
         c = int(rng.integers(0, 128))
         for k in range(4):
             flat = np.clip(m * 128 + c + k - 1, 0, vals.size - 1)
-            assert t4[m, k * 128 + c] == np.float32(vals[flat])
+            assert t4[k * 128 + c, m] == np.float32(vals[flat])
 
 
 def test_pallas_matches_tabulated_path(setup):
