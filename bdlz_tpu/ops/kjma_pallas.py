@@ -9,9 +9,9 @@ serial form; measurements in `docs/perf_notes.md`).  TPUs have no
 hardware gather, but they have a 128x128 systolic array — so this kernel
 reformulates the lookup as dense MXU work:
 
-* the table is laid out as a (128, 4*128) matrix of four flat-shifted
-  copies, ``T4[m, k*128 + c] = F[m*128 + c + k - 1]`` — the shifts bake
-  the cubic stencil's row-crossing into the layout;
+* the table is laid out as a transposed (4*128, 128) matrix of four
+  flat-shifted copies, ``T4[k*128 + c, m] = F[m*128 + c + k - 1]`` — the
+  shifts bake the cubic stencil's row-crossing into the layout;
 * nodes are streamed in (ncol, 128) tiles: 128 consecutive nodes run
   along the *lane* axis of each sublane row (Mosaic's block tiling wants
   lane-dim blocks of exactly 128, sublane blocks of 8);

@@ -1,0 +1,149 @@
+#!/usr/bin/env python3
+"""Timed engine comparison on the current platform: tabulated vs pallas
+(vs pallas+fuse_exp), one JSON line per engine plus a markdown table row
+for docs/perf_notes.md.
+
+This is the evidence collector behind VERDICT r2 item #1/#2 ("a timed
+pallas-vs-tabulated comparison"): same grid, same chunking, per-engine
+accuracy vs the NumPy reference on a small sample, wall-clock timed after
+a warm-up chunk.  Run it on the real chip:
+
+    python scripts/impl_shootout.py [--points 65536] [--n-y 8000]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--points", type=int, default=65536)
+    ap.add_argument("--chunk", type=int, default=8192)
+    ap.add_argument("--n-y", type=int, default=8000, dest="n_y")
+    ap.add_argument("--engines", default="tabulated,pallas,pallas+fuse")
+    args = ap.parse_args()
+
+    from bdlz_tpu.utils.platform import ensure_live_backend
+
+    ensure_live_backend("shootout")
+
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    import jax.numpy as jnp
+    import numpy as np
+
+    from bdlz_tpu.config import config_from_dict, static_choices_from_config
+    from bdlz_tpu.models.yields_pipeline import point_yields
+    from bdlz_tpu.ops.kjma_table import make_f_table
+    from bdlz_tpu.parallel.mesh import batch_sharding, make_mesh
+    from bdlz_tpu.parallel.sweep import _pad_chunk, build_grid, make_sweep_step
+    from bdlz_tpu.physics.percolation import make_kjma_grid
+
+    platform = jax.devices()[0].platform
+    n_dev = len(jax.devices())
+    base = config_from_dict(
+        {
+            "regime": "nonthermal",
+            "P_chi_to_B": 0.14925839040304145,
+            "source_shape_sigma_y": 9.0,
+            "incident_flux_scale": 1.07e-9,
+            "Y_chi_init": 4.90e-10,
+        }
+    )
+    static = static_choices_from_config(base)
+    side = max(2, int(round(args.points ** 0.25)))
+    axes = {
+        "m_chi_GeV": np.geomspace(0.1, 10.0, side),
+        "T_p_GeV": np.geomspace(30.0, 300.0, side),
+        "P_chi_to_B": np.linspace(0.02, 0.9, side),
+        "v_w": np.linspace(0.05, 0.9, side),
+    }
+    pp_all = build_grid(base, axes)
+    n_total = int(np.asarray(pp_all.m_chi_GeV).shape[0])
+    chunk = ((args.chunk + n_dev - 1) // n_dev) * n_dev
+    mesh = make_mesh(shape=(n_dev, 1))
+    sharding = batch_sharding(mesh)
+    table = make_f_table(base.I_p, jnp)
+    grid_np = make_kjma_grid(np)
+
+    # accuracy sample (shared across engines)
+    rng = np.random.default_rng(0)
+    sample = np.unique(rng.choice(min(chunk, n_total), size=8, replace=False))
+    ref = {}
+    for i in sample:
+        pp_i = type(pp_all)(*(float(np.asarray(f)[i]) for f in pp_all))
+        ref[int(i)] = float(point_yields(pp_i, static, grid_np, np).DM_over_B)
+
+    rows = []
+    for engine in args.engines.split(","):
+        engine = engine.strip()
+        impl = "pallas" if engine.startswith("pallas") else engine
+        fuse = engine.endswith("+fuse")
+        try:
+            if impl == "pallas":
+                from bdlz_tpu.ops.kjma_pallas import build_shifted_table
+
+                step = make_sweep_step(
+                    static, mesh=mesh, n_y=args.n_y, impl="pallas",
+                    interpret=(platform == "cpu"), fuse_exp=fuse,
+                )
+                aux = (table, build_shifted_table(table))
+            else:
+                step = make_sweep_step(
+                    static, mesh=mesh, n_y=args.n_y, impl=impl,
+                )
+                aux = table
+
+            def run_chunk(lo, hi):
+                ppc = _pad_chunk(pp_all, lo, hi, chunk)
+                ppc = jax.tree.map(
+                    lambda a: jax.device_put(jnp.asarray(a), sharding), ppc
+                )
+                return step(ppc, aux).DM_over_B
+
+            first = np.asarray(run_chunk(0, min(chunk, n_total)))  # warm-up
+            max_rel = max(
+                abs(float(first[i]) / r - 1.0) for i, r in ref.items()
+            )
+            t0 = time.time()
+            done = 0
+            while done < n_total:
+                hi = min(done + chunk, n_total)
+                out = run_chunk(done, hi)
+                done = hi
+            out.block_until_ready()
+            dt = time.time() - t0
+            row = {
+                "engine": engine,
+                "platform": platform,
+                "points_per_sec_per_chip": round(n_total / dt / n_dev, 2),
+                "seconds": round(dt, 3),
+                "n_points": n_total,
+                "n_y": args.n_y,
+                "max_rel_err_vs_reference": float(f"{max_rel:.3e}"),
+            }
+        except Exception as exc:  # noqa: BLE001 — report per-engine failure
+            row = {"engine": engine, "platform": platform,
+                   "error": f"{type(exc).__name__}: {exc}"}
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+
+    print("\n| engine | pts/s/chip | rel err | seconds |")
+    print("|---|---|---|---|")
+    for r in rows:
+        if "error" in r:
+            print(f"| {r['engine']} | FAILED: {r['error'][:60]} | — | — |")
+        else:
+            print(f"| {r['engine']} | {r['points_per_sec_per_chip']} "
+                  f"| {r['max_rel_err_vs_reference']:.2e} | {r['seconds']} |")
+
+
+if __name__ == "__main__":
+    main()
