@@ -105,6 +105,11 @@ class Config:
     # Stiff-integrator tableau on the JAX backend (solvers/sdirk.py):
     # "sdirk4" (4th-order Hairer-Wanner pair, the default) or "kvaerno3".
     ode_method: str = "sdirk4"
+    # Stiff-integrator tolerances. Y_B accuracy is atol-bound (the final
+    # yield sits below rtol*Y_B for practical rtol) — see the measured
+    # accuracy/steps tradeoff table in docs/perf_notes.md.
+    ode_rtol: float = 1e-8
+    ode_atol: float = 1e-17
 
 
 def default_config() -> Dict[str, Any]:
@@ -215,6 +220,8 @@ def validate(cfg: Config, backend: Optional[str] = None) -> Config:
         raise ConfigError(
             f"ode_method={cfg.ode_method!r} is not one of {VALID_ODE_METHODS}"
         )
+    if not (cfg.ode_rtol > 0.0 and cfg.ode_atol > 0.0):
+        raise ConfigError("ode_rtol and ode_atol must be positive")
     return cfg
 
 
@@ -253,6 +260,8 @@ class StaticChoices(NamedTuple):
     deplete_DM_from_source: bool = False
     n_y: int = 8000
     ode_method: str = "sdirk4"
+    ode_rtol: float = 1e-8
+    ode_atol: float = 1e-17
 
 
 def resolve_Y_chi_init(cfg: Config) -> float:
@@ -303,4 +312,6 @@ def static_choices_from_config(cfg: Config) -> StaticChoices:
         deplete_DM_from_source=bool(cfg.deplete_DM_from_source),
         n_y=int(cfg.n_y),
         ode_method=cfg.ode_method,
+        ode_rtol=float(cfg.ode_rtol),
+        ode_atol=float(cfg.ode_atol),
     )

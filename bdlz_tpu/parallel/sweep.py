@@ -251,6 +251,50 @@ def sweep_step(pp_chunk: PointParams, static: StaticChoices, table, mesh=None, n
     return step(pp_chunk, table)
 
 
+def make_chunk_runner(
+    pp_all: PointParams,
+    chunk: int,
+    static: StaticChoices,
+    mesh,
+    sharding,
+    table,
+    impl: str = "tabulated",
+    n_y: int = 8000,
+    fuse_exp: bool = False,
+):
+    """``run_chunk(lo, hi) -> DM_over_B`` over padded, device-put chunks.
+
+    The shared engine-runner behind the measurement tools (``bench.py``,
+    ``scripts/impl_shootout.py``): engine construction (pallas aux
+    pairing, interpret-on-CPU selection) and the pad + shard + evaluate
+    chunk loop live HERE so the two tools cannot drift apart in what
+    they measure.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    if impl == "pallas":
+        from bdlz_tpu.ops.kjma_pallas import build_shifted_table
+
+        step = make_sweep_step(
+            static, mesh=mesh, n_y=n_y, impl="pallas",
+            interpret=jax.devices()[0].platform == "cpu", fuse_exp=fuse_exp,
+        )
+        aux = (table, build_shifted_table(table))
+    else:
+        from bdlz_tpu.physics.percolation import make_kjma_grid
+
+        step = make_sweep_step(static, mesh=mesh, n_y=n_y, impl=impl)
+        aux = table if impl == "tabulated" else make_kjma_grid(jnp)
+
+    def run_chunk(lo: int, hi: int):
+        ppc = _pad_chunk(pp_all, lo, hi, chunk)
+        ppc = jax.tree.map(lambda a: jax.device_put(jnp.asarray(a), sharding), ppc)
+        return step(ppc, aux).DM_over_B
+
+    return run_chunk
+
+
 @dataclass
 class SweepResult:
     n_points: int

@@ -327,15 +327,16 @@ def solve_boltzmann_esdirk(
     Y0: Tuple[float, float],
     T_lo: float,
     T_hi: float,
-    rtol: float = 1e-8,
-    atol: float = 1e-17,
+    rtol: float | None = None,
+    atol=None,
     max_steps: int = 10_000,
     method: str | None = None,
 ):
     """Boltzmann evolution in x = m/T over [m/T_hi, m/T_lo], JAX path.
 
-    ``method=None`` takes the tableau from ``static.ode_method`` (the
-    config's ``ode_method`` key); an explicit argument overrides it.
+    ``method``/``rtol``/``atol`` default to ``static``'s ``ode_method`` /
+    ``ode_rtol`` / ``ode_atol`` (the config's keys); explicit arguments
+    override (``atol`` may also be a per-component (2,) array).
 
     Same RHS semantics as the reference ODE path (`first_principles_yields.py
     :270-286`) but with the batched KJMA kernel evaluated exactly (no
@@ -358,6 +359,10 @@ def solve_boltzmann_esdirk(
     """
     if method is None:
         method = static.ode_method
+    if rtol is None:
+        rtol = static.ode_rtol
+    if atol is None:
+        atol = static.ode_atol
     grid = KJMAGrid(*(jnp.asarray(a) for a in grid))
     return _boltzmann_esdirk_jit(
         pp, jnp.asarray(Y0, dtype=jnp.float64), T_lo, T_hi, grid,

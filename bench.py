@@ -72,7 +72,7 @@ def main() -> None:
     import numpy as np
 
     from bdlz_tpu.config import config_from_dict, static_choices_from_config
-    from bdlz_tpu.models.yields_pipeline import point_yields, point_yields_fast
+    from bdlz_tpu.models.yields_pipeline import point_yields
     from bdlz_tpu.ops.kjma_table import make_f_table
     from bdlz_tpu.parallel.mesh import batch_sharding, make_mesh
     from bdlz_tpu.parallel.sweep import build_grid, _pad_chunk
@@ -123,35 +123,17 @@ def main() -> None:
     table = make_f_table(base.I_p, jnp)
 
     def make_run_chunk(impl: str):
-        if impl == "pallas":
-            from bdlz_tpu.ops.kjma_pallas import build_shifted_table
-            from bdlz_tpu.parallel.sweep import make_sweep_step
+        # shared engine-runner (pallas aux pairing, interpret-on-CPU,
+        # pad + shard + evaluate) — bdlz_tpu.parallel.sweep.make_chunk_runner,
+        # also used by scripts/impl_shootout.py so the two tools measure
+        # the same thing
+        from bdlz_tpu.parallel.sweep import make_chunk_runner
 
-            # make_sweep_step wraps the kernel in shard_map so each device
-            # runs it on its own batch shard (pallas_call has no SPMD
-            # partitioning rule of its own).
-            interpret = jax.devices()[0].platform == "cpu"
-            fuse = os.environ.get("BDLZ_BENCH_FUSE_EXP", "0") == "1"
-            step = make_sweep_step(
-                static, mesh=mesh, n_y=n_y, impl="pallas", interpret=interpret,
-                fuse_exp=fuse,
-            )
-            aux = (table, build_shifted_table(table))
-            batched = lambda ppc: step(ppc, aux).DM_over_B  # noqa: E731
-        else:
-            inner = jax.jit(
-                jax.vmap(
-                    lambda p: point_yields_fast(p, static, table, jnp, n_y=n_y).DM_over_B
-                )
-            )
-            batched = inner
-
-        def run_chunk(lo: int, hi: int):
-            ppc = _pad_chunk(pp_all, lo, hi, chunk)
-            ppc = jax.tree.map(lambda a: jax.device_put(jnp.asarray(a), sharding), ppc)
-            return batched(ppc)
-
-        return run_chunk
+        fuse = os.environ.get("BDLZ_BENCH_FUSE_EXP", "0") == "1"
+        return make_chunk_runner(
+            pp_all, chunk, static, mesh, sharding, table,
+            impl=impl, n_y=n_y, fuse_exp=fuse,
+        )
 
     def accuracy_gate(run_chunk):
         """Max rel err of a point sample vs the NumPy reference path.

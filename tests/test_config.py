@@ -226,3 +226,48 @@ class TestOdeMethodKey:
         cfg3 = config_from_dict(dict(base, ode_method="kvaerno3"))
         assert config_identity_dict(cfg3)["ode_method"] == "kvaerno3"
         assert grid_hash(cfg, axes, 2000) != grid_hash(cfg3, axes, 2000)
+
+    def test_ode_tolerances_from_config(self):
+        """ode_rtol/ode_atol config keys flow through StaticChoices into
+        the stiff engine; an invalid value is rejected at validation."""
+        import numpy as np
+
+        from bdlz_tpu.config import (
+            ConfigError,
+            config_from_dict,
+            point_params_from_config,
+            static_choices_from_config,
+            validate,
+        )
+        from bdlz_tpu.physics.percolation import make_kjma_grid
+        from bdlz_tpu.solvers.sdirk import solve_boltzmann_esdirk
+
+        with pytest.raises(ConfigError, match="positive"):
+            validate(config_from_dict({"ode_atol": 0.0}))
+
+        raw = {
+            "regime": "nonthermal", "P_chi_to_B": 0.149,
+            "source_shape_sigma_y": 9.0, "incident_flux_scale": 1.07e-9,
+            "Y_chi_init": 4.9e-10, "Gamma_wash_over_H": 0.02,
+            "T_min_over_Tp": 0.2,
+        }
+        grid = make_kjma_grid(np)
+        cfg = config_from_dict(dict(raw, ode_atol=1e-20))
+        static = static_choices_from_config(cfg)
+        pp = point_params_from_config(cfg, cfg.P_chi_to_B)
+        from_cfg = solve_boltzmann_esdirk(
+            pp, static, grid, (4.9e-10, 0.0),
+            0.2 * cfg.T_p_GeV, 5.0 * cfg.T_p_GeV,
+        )
+        explicit = solve_boltzmann_esdirk(
+            pp, static, grid, (4.9e-10, 0.0),
+            0.2 * cfg.T_p_GeV, 5.0 * cfg.T_p_GeV, atol=1e-20,
+        )
+        assert float(from_cfg.y[1]) == float(explicit.y[1])
+        assert int(from_cfg.n_steps) == int(explicit.n_steps)
+        # a tighter atol genuinely changes the run (more steps)
+        default_run = solve_boltzmann_esdirk(
+            pp, static_choices_from_config(config_from_dict(raw)), grid,
+            (4.9e-10, 0.0), 0.2 * cfg.T_p_GeV, 5.0 * cfg.T_p_GeV,
+        )
+        assert int(from_cfg.n_steps) > int(default_run.n_steps)
