@@ -251,6 +251,45 @@ def sweep_step(pp_chunk: PointParams, static: StaticChoices, table, mesh=None, n
     return step(pp_chunk, table)
 
 
+def _clamp_chunk_to_memory(chunk_size: int, n_y: int, mesh) -> int:
+    """Clamp the per-chunk batch so the fused integrand fits device HBM.
+
+    An OOM'd TPU compile doesn't just fail the sweep — it has been
+    observed to destabilize this environment's accelerator relay
+    (docs/perf_notes.md "Memory limits"), so oversized chunks are
+    reduced LOUDLY up front instead.  Budget model anchored to the
+    measured limit (8192 points x 8000 nodes fits a 16 GB v5e; 16384 x
+    8000 needs ~20 GB and OOMs, i.e. ~1.2 MB/point ≈ 20 live f64
+    (n_y,)-buffers per point), against 12 GB of the 16 GB card — so 8192
+    passes untouched and 16384 clamps.  Applies only on accelerator
+    platforms; host CPU runs (tests, reference parity) are never
+    clamped.  ``BDLZ_CHUNK_BYTES_BUDGET`` overrides the budget.
+    """
+    import os
+
+    import jax
+
+    if jax.devices()[0].platform == "cpu":
+        return chunk_size
+    budget = int(os.environ.get("BDLZ_CHUNK_BYTES_BUDGET", 12 * 1024**3))
+    n_dev = int(mesh.devices.size) if mesh is not None else 1
+    per_point_bytes = 20 * max(int(n_y), 1) * 8
+    max_per_dev = max(budget // per_point_bytes, 1)
+    max_chunk = max_per_dev * n_dev
+    if chunk_size > max_chunk:
+        import sys
+
+        print(
+            f"[sweep] chunk_size {chunk_size} would need "
+            f"~{chunk_size // n_dev * per_point_bytes / 1e9:.1f} GB/device "
+            f"at n_y={n_y}; clamping to {max_chunk} "
+            "(override with BDLZ_CHUNK_BYTES_BUDGET)",
+            file=sys.stderr,
+        )
+        return max_chunk
+    return chunk_size
+
+
 def make_chunk_runner(
     pp_all: PointParams,
     chunk: int,
@@ -399,6 +438,7 @@ def run_sweep(
         # are padded to chunk_size, so just round chunk_size itself up.
         n_dev = int(mesh.devices.size)
         chunk_size = ((max(chunk_size, n_dev) + n_dev - 1) // n_dev) * n_dev
+    chunk_size = _clamp_chunk_to_memory(chunk_size, n_y, mesh)
     # The fast quadrature impls are only valid without annihilation,
     # washout, or source depletion (the reference's can_quad guard, :372);
     # a sweep touching those knobs is routed to the stiff ESDIRK path.
@@ -490,6 +530,18 @@ def run_sweep(
             with open(manifest_path) as f:
                 manifest = json.load(f)
             if manifest.get("hash") != h:
+                manifest = {}
+            elif manifest.get("chunk_size") not in (None, chunk_size):
+                # chunk boundaries index the chunk files — a directory
+                # written at another chunk_size would be silently
+                # mis-sliced on resume (reachable e.g. via the memory
+                # clamp or a changed --chunk flag)
+                print(
+                    f"[sweep] resume: manifest chunk_size "
+                    f"{manifest.get('chunk_size')} != current {chunk_size}; "
+                    "recomputing from scratch",
+                    file=sys.stderr,
+                )
                 manifest = {}
         manifest.setdefault("hash", h)
         manifest.setdefault("impl", impl)

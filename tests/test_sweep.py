@@ -408,3 +408,22 @@ def test_lz_profile_sweep_with_unset_P(base_cfg, mesh8, tmp_path):
     )
     assert res.n_failed == 0
     assert np.isfinite(res.outputs["DM_over_B"]).all()
+
+
+def test_resume_invalidated_by_chunk_size_change(base_cfg, mesh8, tmp_path,
+                                                 capsys):
+    """Chunk boundaries index the chunk files: a directory written at one
+    chunk_size must be recomputed, not mis-sliced, when resumed at
+    another (reachable via --chunk or the device-memory clamp)."""
+    static = static_choices_from_config(base_cfg)
+    axes = {"m_chi_GeV": np.geomspace(0.1, 2.0, 24)}
+    out = str(tmp_path / "sweep")
+    r1 = run_sweep(base_cfg, axes, static, mesh=mesh8, chunk_size=8, out_dir=out)
+    r2 = run_sweep(base_cfg, axes, static, mesh=mesh8, chunk_size=16, out_dir=out)
+    assert r2.resumed_chunks == 0
+    assert "chunk_size" in capsys.readouterr().err
+    # values agree per point (bitwise identity is only promised for
+    # identical batch shapes — XLA vectorization differs per shape)
+    np.testing.assert_allclose(
+        r1.outputs["DM_over_B"], r2.outputs["DM_over_B"], rtol=1e-12
+    )
