@@ -56,7 +56,31 @@ def make_f_table(
     """Build the F(y) table with the exact reference z-trapezoid.
 
     Cost: one (n × 1200) tensor — paid once per sweep, not per point.
+
+    The table VALUES are always computed with host NumPy when possible
+    (concrete ``I_p``/``grid``) and only then shipped to the requested
+    namespace: the accuracy audit attributes the dominant platform drift
+    of the tabulated fast path to this build step (f64 ``exp`` differs
+    between NumPy, XLA-CPU, and TPU-emulated f64 — stage table in
+    ``scripts/accuracy_audit.py`` artifacts), and a once-per-sweep host
+    build is free.  A traced ``I_p`` (e.g. inside jit) falls back to the
+    in-namespace build.
     """
+    import numpy as _np
+
+    if xp is not _np:
+        try:
+            host = make_f_table(
+                float(I_p), _np, n=n,
+                grid=None if grid is None
+                else KJMAGrid(*(_np.asarray(a) for a in grid)),
+            )
+            return KJMATable(
+                y0=host.y0, inv_dy=host.inv_dy,
+                values=xp.asarray(host.values), I_p=I_p,
+            )
+        except _tracer_errors():
+            pass  # traced inputs: build in-namespace below
     if grid is None:
         grid = make_kjma_grid(xp)
     ys = xp.linspace(-Y_CLAMP, Y_CLAMP, n)
@@ -65,6 +89,15 @@ def make_f_table(
     F = xp.trapezoid(integrand, grid.z, axis=-1)
     dy = (2.0 * Y_CLAMP) / (n - 1)
     return KJMATable(y0=-Y_CLAMP, inv_dy=1.0 / dy, values=F, I_p=I_p)
+
+
+def _tracer_errors():
+    """ONLY the tracer-concretization error types: a genuine failure in
+    the host build (bad grid payload, None I_p) must propagate, not
+    silently fall back to the drift-prone in-namespace build."""
+    from jax.errors import ConcretizationTypeError, TracerArrayConversionError
+
+    return (ConcretizationTypeError, TracerArrayConversionError)
 
 
 def cubic_lagrange_uniform(t: Array, values: Array, xp) -> Array:

@@ -251,19 +251,29 @@ def sweep_step(pp_chunk: PointParams, static: StaticChoices, table, mesh=None, n
     return step(pp_chunk, table)
 
 
-def _clamp_chunk_to_memory(chunk_size: int, n_y: int, mesh) -> int:
-    """Clamp the per-chunk batch so the fused integrand fits device HBM.
+def _clamp_chunk_to_memory(chunk_size: int, n_y: int, mesh, impl: str) -> int:
+    """Clamp the per-chunk batch so the chunk's temporaries fit device HBM.
 
     An OOM'd TPU compile doesn't just fail the sweep — it has been
     observed to destabilize this environment's accelerator relay
     (docs/perf_notes.md "Memory limits"), so oversized chunks are
-    reduced LOUDLY up front instead.  Budget model anchored to the
-    measured limit (8192 points x 8000 nodes fits a 16 GB v5e; 16384 x
-    8000 needs ~20 GB and OOMs, i.e. ~1.2 MB/point ≈ 20 live f64
-    (n_y,)-buffers per point), against 12 GB of the 16 GB card — so 8192
-    passes untouched and 16384 clamps.  Applies only on accelerator
-    platforms; host CPU runs (tests, reference parity) are never
-    clamped.  ``BDLZ_CHUNK_BYTES_BUDGET`` overrides the budget.
+    reduced LOUDLY up front instead.  Per-engine footprint models:
+
+    * tabulated / pallas — anchored to the measured limit (8192 points ×
+      8000 nodes fits a 16 GB v5e; 16384 × 8000 needs ~20 GB and OOMs,
+      i.e. ~1.2 MB/point ≈ 20 live f64 (n_y,)-buffers per point), so at
+      the bench shapes 8192 passes untouched and 16384 clamps;
+    * direct — the per-point (n_y × nz=1200) KJMA integrand dominates
+      (~3 live copies through the two trapezoid reductions), ~60× the
+      tabulated footprint;
+    * esdirk — no n_y grid at all; the RHS's (nz,) z-integral temporaries
+      per lane per Newton stage, ~a few hundred KB/point, modelled
+      generously.
+
+    Applies only on accelerator platforms; host CPU runs (tests,
+    reference parity) are never clamped.  ``BDLZ_CHUNK_BYTES_BUDGET``
+    overrides the budget; multi-controller runs broadcast the result
+    (see call site).
     """
     import os
 
@@ -273,7 +283,13 @@ def _clamp_chunk_to_memory(chunk_size: int, n_y: int, mesh) -> int:
         return chunk_size
     budget = int(os.environ.get("BDLZ_CHUNK_BYTES_BUDGET", 12 * 1024**3))
     n_dev = int(mesh.devices.size) if mesh is not None else 1
-    per_point_bytes = 20 * max(int(n_y), 1) * 8
+    nz = 1200  # the reference's fixed z-grid (scheme-as-spec)
+    if impl == "direct":
+        per_point_bytes = 3 * max(int(n_y), 1) * nz * 8
+    elif impl == "esdirk":
+        per_point_bytes = 32 * nz * 8
+    else:  # tabulated / pallas fast paths
+        per_point_bytes = 20 * max(int(n_y), 1) * 8
     max_per_dev = max(budget // per_point_bytes, 1)
     max_chunk = max_per_dev * n_dev
     if chunk_size > max_chunk:
@@ -282,7 +298,7 @@ def _clamp_chunk_to_memory(chunk_size: int, n_y: int, mesh) -> int:
         print(
             f"[sweep] chunk_size {chunk_size} would need "
             f"~{chunk_size // n_dev * per_point_bytes / 1e9:.1f} GB/device "
-            f"at n_y={n_y}; clamping to {max_chunk} "
+            f"for the {impl!r} engine at n_y={n_y}; clamping to {max_chunk} "
             "(override with BDLZ_CHUNK_BYTES_BUDGET)",
             file=sys.stderr,
         )
@@ -438,7 +454,6 @@ def run_sweep(
         # are padded to chunk_size, so just round chunk_size itself up.
         n_dev = int(mesh.devices.size)
         chunk_size = ((max(chunk_size, n_dev) + n_dev - 1) // n_dev) * n_dev
-    chunk_size = _clamp_chunk_to_memory(chunk_size, n_y, mesh)
     # The fast quadrature impls are only valid without annihilation,
     # washout, or source depletion (the reference's can_quad guard, :372);
     # a sweep touching those knobs is routed to the stiff ESDIRK path.
@@ -471,6 +486,14 @@ def run_sweep(
                 "fuse_exp requires the pallas engine, but this configuration "
                 f"forces impl={impl!r}"
             )
+    # Clamp AFTER engine resolution — footprints differ by ~60x between
+    # engines — and broadcast the decision so a per-host env divergence
+    # cannot make multi-controller processes disagree on chunk counts
+    # (which deadlocks the jitted-step launch pattern).
+    chunk_size = _clamp_chunk_to_memory(chunk_size, n_y, mesh, impl)
+    from bdlz_tpu.parallel.multihost import broadcast_from_coordinator as _bcast
+
+    chunk_size = int(np.asarray(_bcast(np.array([chunk_size])))[0])
     if impl in ("direct", "esdirk"):
         aux = make_kjma_grid(jnp)
     else:
