@@ -143,20 +143,31 @@ def write_template(path: str) -> None:
     print(f"Wrote template config to {path}")
 
 
-def config_identity_dict(cfg: Config) -> Dict[str, Any]:
-    """The config as a resume-identity payload: reference keys always,
-    framework-extension keys only when they differ from their defaults.
+#: Extension keys whose RESOLVED values are always part of a resume
+#: identity: they change numerical results, so a future change to their
+#: *defaults* must also invalidate old checkpoints (omit-at-default
+#: would silently splice results computed at two different settings).
+RESULT_AFFECTING_EXTENSIONS = ("ode_method", "ode_rtol", "ode_atol")
 
+
+def config_identity_dict(cfg: Config) -> Dict[str, Any]:
+    """The config as a resume-identity payload.
+
+    Reference keys always; result-affecting extension knobs always at
+    their RESOLVED values (see ``RESULT_AFFECTING_EXTENSIONS``); the
+    remaining extension keys only when they differ from their defaults.
     Used by the sweep-manifest hash and the MCMC checkpoint identity.
-    The filtering is what keeps checkpoints forward-compatible: adding a
-    new extension field (with a default) must NOT invalidate every
-    pre-existing sweep/chain directory — only actually *changing* a knob
-    that affects results should.
+    The omit-at-default filtering keeps checkpoints forward-compatible
+    — adding a new extension field must not invalidate every
+    pre-existing sweep/chain directory — while the resolved-value
+    pinning makes sure changing a default that alters results does.
     """
     defaults = default_config()
     out: Dict[str, Any] = {k: getattr(cfg, k) for k in REFERENCE_KEYS}
     for k in defaults:
-        if k not in REFERENCE_KEYS and getattr(cfg, k) != defaults[k]:
+        if k in REFERENCE_KEYS:
+            continue
+        if k in RESULT_AFFECTING_EXTENSIONS or getattr(cfg, k) != defaults[k]:
             out[k] = getattr(cfg, k)
     return out
 

@@ -293,8 +293,6 @@ def _clamp_chunk_to_memory(chunk_size: int, n_y: int, mesh, impl: str) -> int:
     max_per_dev = max(budget // per_point_bytes, 1)
     max_chunk = max_per_dev * n_dev
     if chunk_size > max_chunk:
-        import sys
-
         print(
             f"[sweep] chunk_size {chunk_size} would need "
             f"~{chunk_size // n_dev * per_point_bytes / 1e9:.1f} GB/device "
@@ -317,17 +315,21 @@ def make_chunk_runner(
     n_y: int = 8000,
     fuse_exp: bool = False,
 ):
-    """``run_chunk(lo, hi) -> DM_over_B`` over padded, device-put chunks.
+    """``(run_chunk, chunk)`` — padded, device-put chunk evaluation.
 
     The shared engine-runner behind the measurement tools (``bench.py``,
     ``scripts/impl_shootout.py``): engine construction (pallas aux
-    pairing, interpret-on-CPU selection) and the pad + shard + evaluate
-    chunk loop live HERE so the two tools cannot drift apart in what
-    they measure.
+    pairing, interpret-on-CPU selection), the device-memory chunk clamp,
+    and the pad + shard + evaluate chunk loop live HERE so the two tools
+    cannot drift apart in what they measure.  Callers MUST step their
+    loops by the returned ``chunk`` (it may be smaller than requested —
+    the clamp protects the relay from OOM'd compiles just like
+    ``run_sweep``).
     """
     import jax
     import jax.numpy as jnp
 
+    chunk = _clamp_chunk_to_memory(chunk, n_y, mesh, impl)
     if impl == "pallas":
         from bdlz_tpu.ops.kjma_pallas import build_shifted_table
 
@@ -347,7 +349,7 @@ def make_chunk_runner(
         ppc = jax.tree.map(lambda a: jax.device_put(jnp.asarray(a), sharding), ppc)
         return step(ppc, aux).DM_over_B
 
-    return run_chunk
+    return run_chunk, chunk
 
 
 @dataclass

@@ -124,16 +124,18 @@ def main() -> None:
 
     def make_run_chunk(impl: str):
         # shared engine-runner (pallas aux pairing, interpret-on-CPU,
-        # pad + shard + evaluate) — bdlz_tpu.parallel.sweep.make_chunk_runner,
-        # also used by scripts/impl_shootout.py so the two tools measure
-        # the same thing
+        # memory clamp, pad + shard + evaluate) —
+        # bdlz_tpu.parallel.sweep.make_chunk_runner, also used by
+        # scripts/impl_shootout.py so the two tools measure the same thing
+        nonlocal chunk
         from bdlz_tpu.parallel.sweep import make_chunk_runner
 
         fuse = os.environ.get("BDLZ_BENCH_FUSE_EXP", "0") == "1"
-        return make_chunk_runner(
+        run_chunk, chunk = make_chunk_runner(
             pp_all, chunk, static, mesh, sharding, table,
             impl=impl, n_y=n_y, fuse_exp=fuse,
         )
+        return run_chunk
 
     def accuracy_gate(run_chunk):
         """Max rel err of a point sample vs the NumPy reference path.

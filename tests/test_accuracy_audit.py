@@ -1,0 +1,69 @@
+"""Accuracy-audit harness tests: the stage probe must stay tied to the
+real fast path, and the audit script (the grid-wide 1e-6 proof artifact
+generator) must keep producing its schema."""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from bdlz_tpu.config import config_from_dict, point_params_from_config, \
+    static_choices_from_config
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _point():
+    cfg = config_from_dict({
+        "regime": "nonthermal", "P_chi_to_B": 0.149,
+        "source_shape_sigma_y": 9.0, "incident_flux_scale": 1.07e-9,
+        "Y_chi_init": 4.9e-10,
+    })
+    return cfg, static_choices_from_config(cfg), \
+        point_params_from_config(cfg, cfg.P_chi_to_B)
+
+
+def test_probe_matches_fast_path_both_namespaces():
+    import jax.numpy as jnp
+
+    from bdlz_tpu.ops.kjma_table import make_f_table
+    from bdlz_tpu.solvers.quadrature import (
+        integrand_stream_probe,
+        integrate_YB_quadrature_tabulated,
+    )
+
+    cfg, static, pp = _point()
+    for xp in (np, jnp):
+        table = make_f_table(cfg.I_p, xp, n=4096)
+        probe = integrand_stream_probe(pp, static, table, xp, n_y=2000)
+        assert set(probe) == {
+            "thermo_prefactor", "source_window", "area_over_volume",
+            "integrand", "trapezoid_YB",
+        }
+        YB = integrate_YB_quadrature_tabulated(
+            pp, static.chi_stats, table, xp, n_y=2000
+        )
+        # the probe's trapezoid_YB IS the fast path's Y_B
+        assert float(probe["trapezoid_YB"]) == pytest.approx(
+            float(YB), rel=1e-14
+        )
+
+
+def test_audit_script_smoke(tmp_path):
+    out = str(tmp_path / "audit.json")
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "accuracy_audit.py"),
+         "--points", "8", "--n-y", "2000", "--out", out],
+        capture_output=True, text=True, env=env, timeout=600,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    d = json.load(open(out))
+    assert d["n_points"] == 8
+    assert d["contract_1e-6_ok"] is True
+    stages = d["stage_attribution_worst_point"]
+    assert stages["f_table_values"] == 0.0  # host-built table is bitwise
+    assert all(np.isfinite(v) for v in stages.values())
+    assert len(d["worst_points"]) == 5

@@ -1,0 +1,38 @@
+"""bench.py smoke test: the driver-facing artifact generator must keep
+its contract (ONE final JSON line with the metric schema) — regressions
+here would silently void a round's benchmark evidence."""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_bench_cpu_smoke():
+    env = dict(
+        os.environ,
+        BDLZ_BENCH_PLATFORM="cpu",
+        BDLZ_BENCH_POINTS="256",
+        BDLZ_BENCH_CHUNK="256",
+        BDLZ_BENCH_NY="2000",
+        PYTHONPATH=REPO,
+    )
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        capture_output=True, text=True, env=env, timeout=600,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    # the driver parses the FINAL stdout line as the metric
+    last = out.stdout.strip().splitlines()[-1]
+    d = json.loads(last)
+    assert d["metric"] == "sweep_points_per_sec_per_chip"
+    assert d["value"] > 0
+    assert {"unit", "vs_baseline", "n_points", "impl", "platform",
+            "rel_err_vs_reference", "pallas_preflight"} <= set(d)
+    assert d["platform"] == "cpu"
+    assert d["impl"] == "tabulated"  # pallas is TPU-only by default
+    assert d["rel_err_vs_reference"] <= 1e-6
+    assert np.isfinite(d["value"])

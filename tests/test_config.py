@@ -205,24 +205,33 @@ class TestOdeMethodKey:
         )
         assert payload2["inputs"]["ode_method"] == "kvaerno3"
 
-    def test_identity_dict_omits_default_extensions(self):
-        """Resume identities must not grow new extension keys at their
-        defaults — adding a framework field would otherwise invalidate
-        every pre-existing sweep/chain checkpoint."""
-        from bdlz_tpu.config import config_from_dict, config_identity_dict
+    def test_identity_dict_contract(self):
+        """Resume identities: passive extension keys omitted at their
+        defaults (adding a framework field must not invalidate every
+        pre-existing checkpoint), but result-affecting knobs pinned at
+        their RESOLVED values (a future change to their defaults must
+        invalidate — otherwise chunks computed at two settings would be
+        silently spliced)."""
+        from bdlz_tpu.config import (
+            RESULT_AFFECTING_EXTENSIONS,
+            config_from_dict,
+            config_identity_dict,
+        )
         from bdlz_tpu.parallel.sweep import grid_hash
 
         base = {"P_chi_to_B": 0.149}
         cfg = config_from_dict(base)
         ident = config_identity_dict(cfg)
-        for k in ("backend", "m_B_GeV", "n_y", "ode_reference_step_cap",
-                  "ode_method"):
-            assert k not in ident
+        for k in ("backend", "m_B_GeV", "n_y", "ode_reference_step_cap"):
+            assert k not in ident  # passive keys: omitted at default
+        for k in RESULT_AFFECTING_EXTENSIONS:
+            assert k in ident      # engine knobs: pinned resolved
+        assert ident["ode_method"] == "sdirk4"
         # explicitly writing the default produces the same identity/hash
         cfg2 = config_from_dict(dict(base, ode_method="sdirk4"))
         axes = {"m_chi_GeV": [0.5, 1.0]}
         assert grid_hash(cfg, axes, 2000) == grid_hash(cfg2, axes, 2000)
-        # a NON-default engine knob is part of the identity
+        # a NON-default engine knob changes the identity
         cfg3 = config_from_dict(dict(base, ode_method="kvaerno3"))
         assert config_identity_dict(cfg3)["ode_method"] == "kvaerno3"
         assert grid_hash(cfg, axes, 2000) != grid_hash(cfg3, axes, 2000)
