@@ -58,11 +58,12 @@ def probabilities_for_points(
     ``method="local-momentum"`` the per-point ``T_p_GeV``/``m_chi_GeV``
     arrays are required too.  Work is done per *unique* parameter
     combination, then scattered back — so a pure v_w scan over a big
-    product grid costs O(n_unique_speeds).  Caveat for local-momentum:
-    its combination key is (v_w, T_p, m_χ), so sweeping any of those
-    axes multiplies the unique count, and each combination is a full
-    host-side thermal average (~ms each) — a warning is emitted when the
-    pre-sweep cost is likely to be noticeable.
+    product grid costs O(n_unique_speeds).  For local-momentum the
+    unique combinations are grouped by thermal state (T_p, m_χ) and each
+    group's speeds go through ONE jit-batched flux-weighted average
+    (``lz.momentum.local_momentum_average_batch``), so only the count of
+    distinct thermal states — not of (v, T, m) triples — carries a
+    per-group trace/compile cost.
     """
     if method not in VALID_METHODS:
         raise ValueError(f"method must be one of {VALID_METHODS}, got {method!r}")
@@ -98,19 +99,29 @@ def probabilities_for_points(
         P_uniq = np.asarray(jax.vmap(P_of_speed)(speeds))
         return P_uniq[inverse]
 
-    # local-momentum: unique (v_w, T_p, m_chi) combinations
+    # local-momentum: one jit-batched evaluation per unique thermal
+    # state (T_p, m_chi), covering all of that state's unique wall
+    # speeds at once — the per-(v,T,m)-combination host loop retraced
+    # ~0.5 s per combination and made v_w scans impractically slow
+    # (bitwise parity with the unbatched path is tested).
     if T_p_GeV is None or m_chi_GeV is None:
         raise ValueError("method='local-momentum' needs per-point T_p_GeV and m_chi_GeV")
-    from bdlz_tpu.lz.momentum import momentum_averaged_probability
+    from bdlz_tpu.lz.momentum import local_momentum_average_batch
 
     T_p = np.broadcast_to(np.asarray(T_p_GeV, dtype=np.float64), v_w.shape)
     m = np.broadcast_to(np.asarray(m_chi_GeV, dtype=np.float64), v_w.shape)
     combos = np.stack([v_w, T_p, m], axis=1)
     uniq, inverse = np.unique(combos, axis=0, return_inverse=True)
-    P_uniq = np.empty(len(uniq))
-    for i, (vw_i, T_i, m_i) in enumerate(uniq):
-        P_uniq[i], _ = momentum_averaged_probability(
-            profile, float(vw_i), float(T_i), float(m_i), method="local"
+    P_uniq = np.full(len(uniq), np.nan)
+    # non-finite parameter rows stay NaN (the sweep layer's
+    # mask-and-report machinery absorbs them per point, like the old
+    # per-combination loop's NaN propagation)
+    finite = np.all(np.isfinite(uniq), axis=1)
+    thermal = np.unique(uniq[finite][:, 1:], axis=0)
+    for T_i, m_i in thermal:
+        sel = finite & (uniq[:, 1] == T_i) & (uniq[:, 2] == m_i)
+        P_uniq[sel] = local_momentum_average_batch(
+            profile, uniq[sel, 0], float(T_i), float(m_i)
         )
     return P_uniq[inverse]
 

@@ -96,7 +96,7 @@ def main() -> None:
             max_rel = max(
                 (abs(float(first[i]) / r - 1.0)
                  for i, r in ref.items() if i < eff_chunk),
-                default=float("nan"),  # clamp shrank below every sample
+                default=None,  # clamp shrank below every sample -> null
             )
             t0 = time.time()
             done = 0
@@ -117,7 +117,9 @@ def main() -> None:
                 "seconds": round(dt, 3),
                 "n_points": n_total,
                 "n_y": args.n_y,
-                "max_rel_err_vs_reference": float(f"{max_rel:.3e}"),
+                "max_rel_err_vs_reference": (
+                    None if max_rel is None else float(f"{max_rel:.3e}")
+                ),
             }
         except Exception as exc:  # noqa: BLE001 — report per-engine failure
             row = {"engine": engine, "platform": platform,
@@ -131,8 +133,10 @@ def main() -> None:
         if "error" in r:
             print(f"| {r['engine']} | FAILED: {r['error'][:60]} | — | — |")
         else:
+            err = r["max_rel_err_vs_reference"]
             print(f"| {r['engine']} | {r['points_per_sec_per_chip']} "
-                  f"| {r['max_rel_err_vs_reference']:.2e} | {r['seconds']} |")
+                  f"| {'n/a' if err is None else format(err, '.2e')} "
+                  f"| {r['seconds']} |")
 
 
 if __name__ == "__main__":

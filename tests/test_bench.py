@@ -12,8 +12,11 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def test_bench_cpu_smoke():
-    env = dict(
-        os.environ,
+    # drop any inherited bench knobs so a developer's exported overrides
+    # (BDLZ_BENCH_IMPL etc.) cannot change what this test asserts
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith("BDLZ_BENCH_")}
+    env.update(
         BDLZ_BENCH_PLATFORM="cpu",
         BDLZ_BENCH_POINTS="256",
         BDLZ_BENCH_CHUNK="256",

@@ -72,3 +72,59 @@ def test_bit_parity_with_reference_script(benchmark_config_path, tmp_path):
     our_out = json.loads((ours_dir / "yields_out.json").read_text())
     assert our_out["final"] == ref_out["final"]
     assert our_out["inputs"] == ref_out["inputs"]
+
+
+#: Non-default parameter points for the broadened parity sweep: each
+#: exercises a different branch of the scalar pipeline (thermal regime,
+#: boson statistics, clip-edge windows, non-default shape/dof values).
+PARITY_VARIANTS = {
+    "thermal-light": {
+        "regime": "thermal", "m_chi_GeV": 0.4, "P_chi_to_B": 0.3,
+        "source_shape_sigma_y": 6.0, "incident_flux_scale": 2e-9,
+    },
+    "boson-heavy": {
+        "chi_stats": "boson", "g_chi": 1, "m_chi_GeV": 140.0,
+        "T_p_GeV": 40.0, "P_chi_to_B": 0.08, "Y_chi_init": 1.1e-9,
+        "incident_flux_scale": 5e-10,
+    },
+    "clip-edges": {
+        "P_chi_to_B": 0.5, "beta_over_H": 300.0, "v_w": 0.08,
+        "T_max_over_Tp": 8.0, "T_min_over_Tp": 1e-4,
+        "source_shape_sigma_y": 25.0, "Y_chi_init": 4.9e-10,
+    },
+    "nonstandard-dof": {
+        "g_star": 75.75, "g_star_s": 61.75, "I_p": 0.5,
+        "P_chi_to_B": 0.149, "Y_chi_init": 4.9e-10,
+    },
+}
+
+
+@pytest.mark.skipif(not REFERENCE_DIR.exists(), reason="reference snapshot not mounted")
+@pytest.mark.parametrize("name", sorted(PARITY_VARIANTS))
+def test_bit_parity_across_config_variants(name, tmp_path):
+    """Byte parity with the actual reference script must hold across the
+    pipeline's branches, not just at the archived benchmark point."""
+    env = {k: v for k, v in os.environ.items() if not k.startswith(("JAX_", "XLA_"))}
+    cfg_path = tmp_path / "cfg.json"
+    cfg_path.write_text(json.dumps({"regime": "nonthermal",
+                                    **PARITY_VARIANTS[name]}))
+
+    dirs = {}
+    for label, script in (
+        ("ref", REFERENCE_DIR / "first_principles_yields.py"),
+        ("ours", REPO_ROOT / "first_principles_yields.py"),
+    ):
+        d = tmp_path / label
+        d.mkdir()
+        r = subprocess.run(
+            [sys.executable, str(script), "--config", str(cfg_path),
+             "--diagnostics"],
+            cwd=d, capture_output=True, text=True, env=env, timeout=300,
+        )
+        assert r.returncode == 0, (label, r.stderr)
+        dirs[label] = (d, r.stdout)
+
+    assert dirs["ours"][1] == dirs["ref"][1]
+    ref_out = json.loads((dirs["ref"][0] / "yields_out.json").read_text())
+    our_out = json.loads((dirs["ours"][0] / "yields_out.json").read_text())
+    assert our_out == ref_out

@@ -414,3 +414,26 @@ class TestPTable:
             make_P_of_vw_table(prof, "coherent", 0.9, 0.1)
         with pytest.raises(ValueError, match="pinned"):
             make_P_of_vw_table(prof, "local-momentum", 0.1, 0.9)
+
+
+def test_local_momentum_points_match_unbatched_kernel():
+    """The grouped jit-batched local-momentum sweep path must agree with
+    the unbatched per-point average across mixed thermal states."""
+    from bdlz_tpu.lz.momentum import momentum_averaged_probability
+    from bdlz_tpu.lz.sweep_bridge import probabilities_for_points
+
+    xi = np.linspace(-2.0, 2.0, 201)
+    prof = BounceProfile(xi=xi, delta=2.0 * xi, mix=np.full_like(xi, 0.3))
+    v_w = np.array([0.1, 0.5, 0.1, 0.8, 0.5])
+    T_p = np.array([100.0, 100.0, 40.0, 40.0, 100.0])
+    m = np.array([0.95, 0.95, 2.0, 2.0, 0.95])
+    P = probabilities_for_points(
+        prof, v_w, method="local-momentum", T_p_GeV=T_p, m_chi_GeV=m
+    )
+    for i in range(len(v_w)):
+        ref, _ = momentum_averaged_probability(
+            prof, float(v_w[i]), float(T_p[i]), float(m[i]), method="local"
+        )
+        assert P[i] == pytest.approx(ref, rel=1e-12), i
+    # repeated (v, T, m) combinations get identical values
+    assert P[1] == P[4]
