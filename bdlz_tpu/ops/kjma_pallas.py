@@ -139,10 +139,12 @@ def exp_neg_f32(a_hi, a_lo):
     p = p * r + f32(0.5)
     p = p * r + f32(1.0)
     p = p * r + f32(1.0)
-    ni = jnp.clip(n.astype(i32), -126, 127)
-    scale = jax.lax.bitcast_convert_type((ni + 127) << 23, f32)
+    # 32-bit-pinned constants: weak 64-bit scalars break Mosaic lowering
+    # under x64 (see `_interp_column`).
+    ni = jnp.clip(n.astype(i32), np.int32(-126), np.int32(127))
+    scale = jax.lax.bitcast_convert_type((ni + np.int32(127)) << np.int32(23), f32)
     out = p * scale
-    return jnp.where(a_hi < -87.0, 0.0, out)
+    return jnp.where(a_hi < f32(-87.0), f32(0.0), out)
 
 
 def split_f64(x):
@@ -162,10 +164,17 @@ def _interp_column(t4t, subl, i1t, st, j):
     *column* taps by a one-hot sublane mask + sublane reduction (also
     exact; plain VPU ops, no dynamic indexing for Mosaic to trip on),
     then the Lagrange cubic combine.  Shared by both kernel variants.
+
+    Every scalar constant is pinned to a strong 32-bit dtype: under
+    jax_enable_x64 a bare Python int/float stages as a weak 64-bit
+    constant, and Mosaic's 64->32 convert lowering recurses infinitely
+    (`_convert_helper` re-emits the convert it is lowering) — the
+    RecursionError that killed this kernel on hardware in r2/r3.
     """
+    lanes = np.int32(LANES)
     idx = i1t[j:j + 1, :]                       # (1, 128) node base indices
-    r = idx // LANES
-    c = idx - r * LANES
+    r = idx // lanes
+    c = idx - r * lanes
     rsel = (subl == r).astype(f32)              # (128, 128): [m, n] = m == r[n]
     # picked[k*128+cc, n] = t4t[k*128+cc, r[n]]: the table arrives
     # transposed (512, 128), so this is the canonical (1,0)-contraction
@@ -173,12 +182,12 @@ def _interp_column(t4t, subl, i1t, st, j):
     picked = jnp.dot(t4t, rsel, preferred_element_type=f32)  # (512, 128)
     csel = (subl == c).astype(f32)              # (128, 128): [cc, n] = cc == c[n]
     s = st[j:j + 1, :]
-    sm1, s0, s1_, s2 = s + 1.0, s, s - 1.0, s - 2.0
+    sm1, s0, s1_, s2 = s + f32(1.0), s, s - f32(1.0), s - f32(2.0)
     w = (
-        -(s0 * s1_ * s2) * (1.0 / 6.0),
-        (sm1 * s1_ * s2) * 0.5,
-        -(sm1 * s0 * s2) * 0.5,
-        (sm1 * s0 * s1_) * (1.0 / 6.0),
+        -(s0 * s1_ * s2) * f32(1.0 / 6.0),
+        (sm1 * s1_ * s2) * f32(0.5),
+        -(sm1 * s0 * s2) * f32(0.5),
+        (sm1 * s0 * s1_) * f32(1.0 / 6.0),
     )
     acc = jnp.zeros((1, LANES), f32)
     for k in range(4):
@@ -228,14 +237,18 @@ def _tile_specs(n_streams: int):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    # Index-map constants are np.int32-pinned: under x64 a bare `0`
+    # stages as i64 and Mosaic fails to legalize the index function's
+    # `func.return` (i64 operand).
+    zero = np.int32(0)
     stream = pl.BlockSpec(
-        (1, COL_BLOCK, ROWS), lambda p, jb: (p, jb, 0), memory_space=pltpu.VMEM
+        (1, COL_BLOCK, ROWS), lambda p, jb: (p, jb, zero), memory_space=pltpu.VMEM
     )
     table = pl.BlockSpec(
-        (4 * LANES, ROWS), lambda p, jb: (0, 0), memory_space=pltpu.VMEM
+        (4 * LANES, ROWS), lambda p, jb: (zero, zero), memory_space=pltpu.VMEM
     )
     return [stream] * n_streams + [table], pl.BlockSpec(
-        (1, COL_BLOCK, ROWS), lambda p, jb: (p, jb, 0), memory_space=pltpu.VMEM
+        (1, COL_BLOCK, ROWS), lambda p, jb: (p, jb, zero), memory_space=pltpu.VMEM
     )
 
 
