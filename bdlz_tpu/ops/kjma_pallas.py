@@ -29,8 +29,14 @@ reformulates the lookup as dense MXU work:
   recursive lowering with a RecursionError at n_y=8000 — the grid is
   the fix.)
 * the cubic Lagrange combine and the multiply by the precomputed
-  integrand prefactor happen in-register; each grid step writes its own
-  (COL_BLOCK, 128) slice of the (ncol, 128) integrand tile.
+  integrand prefactor happen in-register; by default (``reduce=True``)
+  each grid step Kahan-accumulates its (COL_BLOCK, 128) tile into VMEM
+  scratch and only compensated (P, COL_BLOCK, 128) sum+compensation
+  pairs leave the kernel — n_y/2048 times less HBM writeback than
+  streaming the integrand back (4x at the production n_y=8000), and the
+  per-point emulated-f64 reduction outside the kernel shrinks from n_y
+  to 1024 elements.  ``reduce=False`` streams the full integrand (kept
+  for A/B timing).
 
 Everything precision-critical (y-node generation, table index/fraction,
 the exp arguments, thermodynamic prefactors) is computed OUTSIDE the
@@ -198,28 +204,29 @@ def _interp_column(t4t, subl, i1t, st, j):
     return acc
 
 
-def _kernel(ghat_ref, i1_ref, s_ref, t4_ref, out_ref):
-    """One (point, column-block) grid step: (COL_BLOCK, 128) nodes ->
-    integrand tile.  The batch axis and the column axis both live in the
-    Pallas grid, so this body (and its jaxpr) is O(1) in n_y."""
+def _build_tile(ghat_ref, i1_ref, s_ref, t4_ref):
+    """Integrand tile of one (point, column-block) grid step:
+    (COL_BLOCK, 128) nodes -> ``ghat * cubic_interp(F)``.  The batch axis
+    and the column axis both live in the Pallas grid, so this body (and
+    its jaxpr) is O(1) in n_y.  Shared by the streaming and reducing
+    kernels — one copy of the interpolation math per variant."""
     t4t = t4_ref[:]         # (512, 128) f32 (transposed table), in VMEM
     ghat = ghat_ref[0]      # (COL_BLOCK, 128) f32
     i1t = i1_ref[0]         # (COL_BLOCK, 128) i32
     st = s_ref[0]           # (COL_BLOCK, 128) f32
     subl = jax.lax.broadcasted_iota(i32, (ROWS, LANES), 0)
 
-    for j in range(COL_BLOCK):
-        acc = _interp_column(t4t, subl, i1t, st, j)
-        out_ref[0, j:j + 1, :] = ghat[j:j + 1, :] * acc
+    rows = [
+        ghat[j:j + 1, :] * _interp_column(t4t, subl, i1t, st, j)
+        for j in range(COL_BLOCK)
+    ]
+    return jnp.concatenate(rows, axis=0)
 
 
-def _kernel_fused(g2_ref, ahi_ref, alo_ref, i1_ref, s_ref, t4_ref, out_ref):
-    """Fused variant: the merged exponent is evaluated in-kernel.
-
-    Same interpolation as `_kernel`, but the per-node integrand is
-    ``g2 * exp_neg_f32(a_hi + a_lo) * F`` — the prep then does no
-    per-node transcendental at all (the f64 exp was its largest remaining
-    cost under TPU f64 emulation)."""
+def _build_tile_fused(g2_ref, ahi_ref, alo_ref, i1_ref, s_ref, t4_ref):
+    """Fused-exponent integrand tile: ``g2 * exp_neg_f32(a_hi + a_lo) * F``
+    — the prep then does no per-node transcendental at all (the f64 exp
+    was its largest remaining cost under TPU f64 emulation)."""
     t4t = t4_ref[:]
     g2 = g2_ref[0]
     i1t = i1_ref[0]
@@ -228,9 +235,80 @@ def _kernel_fused(g2_ref, ahi_ref, alo_ref, i1_ref, s_ref, t4_ref, out_ref):
 
     e = exp_neg_f32(ahi_ref[0], alo_ref[0])  # whole tile at once
 
-    for j in range(COL_BLOCK):
-        acc = _interp_column(t4t, subl, i1t, st, j)
-        out_ref[0, j:j + 1, :] = g2[j:j + 1, :] * e[j:j + 1, :] * acc
+    rows = [
+        g2[j:j + 1, :] * e[j:j + 1, :] * _interp_column(t4t, subl, i1t, st, j)
+        for j in range(COL_BLOCK)
+    ]
+    return jnp.concatenate(rows, axis=0)
+
+
+def _kernel(ghat_ref, i1_ref, s_ref, t4_ref, out_ref):
+    out_ref[0] = _build_tile(ghat_ref, i1_ref, s_ref, t4_ref)
+
+
+def _kernel_fused(g2_ref, ahi_ref, alo_ref, i1_ref, s_ref, t4_ref, out_ref):
+    out_ref[0] = _build_tile_fused(g2_ref, ahi_ref, alo_ref, i1_ref, s_ref, t4_ref)
+
+
+def _kahan_accumulate(tile, acc_ref, comp_ref, sum_ref, cmp_ref, jb, njb):
+    """Kahan-add one (COL_BLOCK, 128) integrand tile into VMEM scratch.
+
+    The column-block axis of the grid revisits the same point, so the
+    scratch accumulators (initialized at jb == 0) carry the partial sums
+    across grid steps; the final step writes both the compensated sum and
+    the running compensation to the outputs, letting the host reconstruct
+    the column sums to ~f64 quality from two f32 streams (the trapezoid
+    weights are pre-folded into the tile, so the host-side work left is a
+    1024-element f64 dot per point instead of n_y)."""
+    from jax.experimental import pallas as pl
+
+    @pl.when(jb == np.int32(0))
+    def _init():
+        acc_ref[...] = jnp.zeros((COL_BLOCK, LANES), f32)
+        comp_ref[...] = jnp.zeros((COL_BLOCK, LANES), f32)
+
+    acc = acc_ref[...]
+    comp = comp_ref[...]
+    y = tile - comp
+    t = acc + y
+    comp_ref[...] = (t - acc) - y
+    acc_ref[...] = t
+
+    @pl.when(jb == np.int32(njb - 1))
+    def _finish():
+        sum_ref[0] = acc_ref[...]
+        cmp_ref[0] = comp_ref[...]
+
+
+def _kernel_reduce(ghat_ref, i1_ref, s_ref, t4_ref, sum_ref, cmp_ref,
+                   acc_ref, comp_ref):
+    """`_kernel` with the trapezoid accumulation fused into the kernel.
+
+    Instead of writing the full (P, n_y) integrand back to HBM (and
+    summing it in emulated f64 on the host side of the pallas_call), each
+    grid step Kahan-accumulates its tile in VMEM and only (P, COL_BLOCK,
+    128) sum+compensation pairs leave the kernel: n_y/2048 times less HBM
+    writeback (4x at the production n_y=8000) and the per-point
+    emulated-f64 reduction outside shrinks from n_y to 1024 elements."""
+    from jax.experimental import pallas as pl
+
+    _kahan_accumulate(
+        _build_tile(ghat_ref, i1_ref, s_ref, t4_ref),
+        acc_ref, comp_ref, sum_ref, cmp_ref,
+        pl.program_id(1), pl.num_programs(1),
+    )
+
+
+def _kernel_fused_reduce(g2_ref, ahi_ref, alo_ref, i1_ref, s_ref, t4_ref,
+                         sum_ref, cmp_ref, acc_ref, comp_ref):
+    """`_kernel_fused` with the in-kernel Kahan accumulation."""
+    from jax.experimental import pallas as pl
+
+    _kahan_accumulate(
+        _build_tile_fused(g2_ref, ahi_ref, alo_ref, i1_ref, s_ref, t4_ref),
+        acc_ref, comp_ref, sum_ref, cmp_ref,
+        pl.program_id(1), pl.num_programs(1),
+    )
 
 
 def _tile_specs(n_streams: int):
@@ -252,6 +330,34 @@ def _tile_specs(n_streams: int):
     )
 
 
+def _reduced_call(kernel, n_streams: int, P: int, ncol: int, interpret: bool):
+    """pallas_call wrapper for the in-kernel-reduction variants."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    in_specs, _ = _tile_specs(n_streams)
+    zero = np.int32(0)
+    partial_spec = pl.BlockSpec(
+        (1, COL_BLOCK, ROWS), lambda p, jb: (p, zero, zero),
+        memory_space=pltpu.VMEM,
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=(P, ncol // COL_BLOCK),
+        in_specs=in_specs,
+        out_specs=[partial_spec, partial_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((P, COL_BLOCK, ROWS), f32),
+            jax.ShapeDtypeStruct((P, COL_BLOCK, ROWS), f32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((COL_BLOCK, ROWS), f32),
+            pltpu.VMEM((COL_BLOCK, ROWS), f32),
+        ],
+        interpret=interpret,
+    )
+
+
 def interp_multiply(
     ghat: jax.Array,
     i1: jax.Array,
@@ -259,12 +365,21 @@ def interp_multiply(
     t4: jax.Array,
     *,
     interpret: bool = False,
-) -> jax.Array:
-    """``ghat * cubic_interp(F, i1 + sfrac)`` for (P, ncol, 128) tiles."""
+    reduce: bool = False,
+) -> "jax.Array | list[jax.Array]":
+    """``ghat * cubic_interp(F, i1 + sfrac)`` for (P, ncol, 128) tiles.
+
+    With ``reduce=True`` the trapezoid accumulation happens in-kernel and
+    the return is a pair of (P, COL_BLOCK, 128) compensated partial sums
+    (Kahan sum + compensation) instead of the full integrand."""
     from jax.experimental import pallas as pl
 
     P, ncol, rows = ghat.shape
     assert rows == ROWS and ncol % COL_BLOCK == 0
+    if reduce:
+        return _reduced_call(_kernel_reduce, 3, P, ncol, interpret)(
+            ghat, i1, sfrac, t4
+        )
     in_specs, out_spec = _tile_specs(3)
     return pl.pallas_call(
         _kernel,
@@ -285,12 +400,20 @@ def interp_multiply_fused(
     t4: jax.Array,
     *,
     interpret: bool = False,
-) -> jax.Array:
-    """``g2 * e^(a_hi+a_lo) * cubic_interp(F, i1 + sfrac)`` on tiles."""
+    reduce: bool = False,
+) -> "jax.Array | list[jax.Array]":
+    """``g2 * e^(a_hi+a_lo) * cubic_interp(F, i1 + sfrac)`` on tiles.
+
+    With ``reduce=True`` the return is the [sum, compensation] pair of
+    (P, COL_BLOCK, 128) partials (see `interp_multiply`)."""
     from jax.experimental import pallas as pl
 
     P, ncol, rows = g2.shape
     assert rows == ROWS and ncol % COL_BLOCK == 0
+    if reduce:
+        return _reduced_call(_kernel_fused_reduce, 5, P, ncol, interpret)(
+            g2, a_hi, a_lo, i1, sfrac, t4
+        )
     in_specs, out_spec = _tile_specs(5)
     return pl.pallas_call(
         _kernel_fused,
@@ -323,6 +446,7 @@ def integrate_YB_pallas(
     *,
     interpret: bool = False,
     fuse_exp: bool = False,
+    reduce: bool = True,
 ) -> jax.Array:
     """Batched fast-path Y_B with the Pallas interpolation kernel.
 
@@ -429,6 +553,7 @@ def integrate_YB_pallas(
             s_t,
             t4,
             interpret=interpret,
+            reduce=reduce,
         )
     else:
         g = xp.exp(A - A_max[:, None]) * bf * wtrap
@@ -441,13 +566,17 @@ def integrate_YB_pallas(
         out = interp_multiply(
             _to_tiles(g.astype(f32), n_y, ncol, 0.0), i1_t, s_t, t4,
             interpret=interpret,
+            reduce=reduce,
         )
-    YB = (
-        KK
-        * xp.exp(A_max)
-        * gscale[:, 0]
-        * xp.sum(out.astype(f64), axis=(1, 2))
-    )
+    if reduce:
+        # Kahan reconstruction: the true sum of each lane column is
+        # acc - comp to O(eps^2), so only (COL_BLOCK x 128) partials per
+        # point cross into emulated f64 instead of the n_y-node integrand.
+        ssum, scomp = out
+        total = xp.sum(ssum.astype(f64) - scomp.astype(f64), axis=(1, 2))
+    else:
+        total = xp.sum(out.astype(f64), axis=(1, 2))
+    YB = KK * xp.exp(A_max) * gscale[:, 0] * total
     return xp.where(y_hi > y_lo, YB, 0.0)
 
 
@@ -537,6 +666,7 @@ def point_yields_pallas(
     *,
     interpret: bool = False,
     fuse_exp: bool = False,
+    reduce: bool = True,
 ):
     """Batched flagship pipeline on the Pallas hot path.
 
@@ -547,7 +677,8 @@ def point_yields_pallas(
     from bdlz_tpu.models.yields_pipeline import final_Y_chi_quadrature, present_day
 
     Y_B = integrate_YB_pallas(
-        pp, static.chi_stats, table, t4, n_y, interpret=interpret, fuse_exp=fuse_exp
+        pp, static.chi_stats, table, t4, n_y, interpret=interpret,
+        fuse_exp=fuse_exp, reduce=reduce,
     )
     Y_chi = jax.vmap(lambda p: final_Y_chi_quadrature(p, static, jnp))(pp)
     return present_day(Y_B, Y_chi, pp.m_chi_GeV, pp.m_B_kg, jnp)

@@ -544,6 +544,14 @@ def run_sweep(
 
     manifest_path = None
     manifest: Dict[str, Any] = {}
+    if impl == "pallas":
+        # Kernel-level knobs that change pallas results at the ~1e-7
+        # level join the identity (same reasoning as ode_method/rtol/atol
+        # for the stiff engine): a resumed directory must not splice
+        # chunks from different summation/exp algorithms.  "reduce"
+        # records the in-kernel Kahan accumulation default.
+        hash_extra = dict(hash_extra or {})
+        hash_extra["pallas"] = {"fuse_exp": bool(fuse_exp), "reduce": True}
     h = grid_hash(base, axes, n_y, impl, extra=hash_extra)
     if out_dir is not None:
         import os

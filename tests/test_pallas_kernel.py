@@ -156,6 +156,37 @@ def test_pallas_fused_exp_matches_tabulated(setup):
     assert rel.max() < 5e-7, rel.max()
 
 
+def test_reduce_modes_agree(setup):
+    """In-kernel Kahan reduction vs streaming the full integrand: same
+    Y_B to ~f32-eps (the compensated sum reconstructs the f64 host sum),
+    for both kernel variants."""
+    base, static, table, t4 = setup
+    rng = np.random.default_rng(3)
+    n = 8
+    grid = build_grid(
+        base,
+        {
+            "m_chi_GeV": np.concatenate([rng.uniform(0.1, 5.0, n - 2),
+                                         [300.0, 900.0]]),
+            "T_p_GeV": rng.uniform(30.0, 300.0, n),
+            "v_w": rng.uniform(0.05, 0.95, n),
+            "source_shape_sigma_y": rng.uniform(2.0, 20.0, n),
+        },
+        product=False,
+    )
+    grid = jax.tree.map(jnp.asarray, grid)
+    for fuse in (False, True):
+        full = np.asarray(integrate_YB_pallas(
+            grid, static.chi_stats, table, t4, n_y=2048, interpret=True,
+            fuse_exp=fuse, reduce=False,
+        ))
+        red = np.asarray(integrate_YB_pallas(
+            grid, static.chi_stats, table, t4, n_y=2048, interpret=True,
+            fuse_exp=fuse, reduce=True,
+        ))
+        np.testing.assert_allclose(red, full, rtol=3e-7)
+
+
 def test_preflight_reports_failure_without_raising():
     """On a platform where the real (non-interpret) kernel cannot run —
     this CPU test env — the preflight must come back as a failure report,
