@@ -11,10 +11,12 @@ in the single-crossing limit (reference PDF Eqs. 8-9).
 Seam contract (reference `maybe_P`, :317-328): (profile, v_w) -> P in [0, 1].
 """
 from bdlz_tpu.lz.kernel import (  # noqa: F401
+    dephased_probability,
     lambda_eff_from_profile,
     local_lambdas,
     probability_from_lambda,
     probability_from_profile,
+    propagate_bloch,
     transfer_matrix_propagation,
 )
 from bdlz_tpu.lz.momentum import (  # noqa: F401

@@ -129,6 +129,30 @@ def _quat_to_matrix(q) -> np.ndarray:
     )
 
 
+def _ordered_tree_product(xs, compose, identity, xp):
+    """Ordered product x_N ∘ ··· ∘ x_1 by pairwise halving.
+
+    Log-depth like ``associative_scan`` but O(N) peak memory instead of
+    storing all N prefix products — which matters when the momentum-
+    averaging layer vmaps thousands of nodes over a long profile.  Pads
+    to a power of two with ``identity`` elements, then halves repeatedly,
+    composing adjacent pairs with the LATER element on the left.  Shared
+    by the quaternion (SU(2)) and Bloch (SO(3)) propagators so the two
+    trees cannot structurally diverge.
+    """
+    n = xs.shape[0]
+    size = 1 << max(n - 1, 1).bit_length()
+    if size != n:
+        pad = xp.broadcast_to(
+            xp.asarray(identity, dtype=xs.dtype), (size - n,) + xs.shape[1:]
+        )
+        xs = xp.concatenate([xs, pad], axis=0)
+    while xs.shape[0] > 1:
+        pairs = xs.reshape((-1, 2) + xs.shape[1:])
+        xs = compose(pairs[:, 1], pairs[:, 0])
+    return xs[0]
+
+
 def propagate_quaternion(a, b, dxi, v, xp):
     """Total SU(2) propagator (as a quaternion) across segments, traced.
 
@@ -136,32 +160,88 @@ def propagate_quaternion(a, b, dxi, v, xp):
     ops over per-segment (a, b, dxi) with traversal speed ``v`` (may be a
     traced scalar — the momentum-averaging layer vmaps over it).  Returns
     the (4,) quaternion of U_N···U_1; P_{χ→B} = q_x² + q_y².
-
-    The ordered product is taken by a pairwise tree reduction (log-depth,
-    like ``associative_scan``, but O(N) peak memory instead of storing all
-    N prefix products — which matters when the momentum-averaging layer
-    vmaps thousands of nodes over a long profile).
     """
     tau = dxi / xp.maximum(v, 1e-12)
     qs = _su2_quaternions(a, b, tau, xp)
-    # Pad to a power of two with identity quaternions, then halve
-    # repeatedly, composing adjacent pairs with the LATER segment on the
-    # left (U_total = U_N ··· U_1).
-    n = qs.shape[0]
-    size = 1 << max(n - 1, 1).bit_length()
-    if size != n:
-        ident = xp.concatenate(
-            [
-                xp.ones((size - n, 1), dtype=qs.dtype),
-                xp.zeros((size - n, 3), dtype=qs.dtype),
-            ],
-            axis=1,
-        )
-        qs = xp.concatenate([qs, ident], axis=0)
-    while qs.shape[0] > 1:
-        pairs = qs.reshape(-1, 2, 4)
-        qs = _quat_compose(pairs[:, 1, :], pairs[:, 0, :], xp)
-    return qs[0]
+    return _ordered_tree_product(
+        qs, lambda q1, q2: _quat_compose(q1, q2, xp),
+        np.array([1.0, 0.0, 0.0, 0.0]), xp,
+    )
+
+
+def _quat_to_rotations(q, xp):
+    """Batched SO(3) adjoint of SU(2) quaternions: (…, 4) → (…, 3, 3).
+
+    R is defined by U (r·σ) U† = (R r)·σ — the Bloch-sphere action of the
+    segment propagator — and for q = (w, x, y, z) it is the standard
+    quaternion rotation matrix (convention pinned by test_lz's Γ=0
+    equivalence with the quaternion path)."""
+    w, x, y, z = (q[..., i] for i in range(4))
+    one = xp.ones_like(w)
+    rows = [
+        xp.stack([one - 2 * (y * y + z * z), 2 * (x * y - w * z),
+                  2 * (x * z + w * y)], axis=-1),
+        xp.stack([2 * (x * y + w * z), one - 2 * (x * x + z * z),
+                  2 * (y * z - w * x)], axis=-1),
+        xp.stack([2 * (x * z - w * y), 2 * (y * z + w * x),
+                  one - 2 * (x * x + y * y)], axis=-1),
+    ]
+    return xp.stack(rows, axis=-2)
+
+
+def propagate_bloch(a, b, dxi, v, gamma_phi, xp):
+    """Dephased distributed-LZ transport: final Bloch vector from r₀ = ẑ.
+
+    Density-matrix evolution ρ = (I + r·σ)/2 of the χ/B two-level system
+    with pure dephasing in the diabatic (σ_z) basis at rate ``gamma_phi``
+    (same energy units as Δ and m_mix): each segment applies the exact
+    SO(3) rotation of its SU(2) propagator followed by coherence decay
+    diag(e^(−Γτ), e^(−Γτ), 1) over the traversal time τ = dξ/v — the
+    first-order Lindblad splitting of the dissipative LZ problem
+    (environment-coupled sweeps: arXiv:0906.1473; multi-crossing chains:
+    arXiv:1212.2907).  Per-segment maps are 3×3 real matrices composed
+    with the same log-depth pairwise tree as the quaternion path (batched
+    matmuls — MXU/VPU work on TPU, no complex dtype).
+
+    Γ = 0 reproduces the coherent kernel exactly (same segmentation);
+    Γ → ∞ kills Stückelberg interference between crossings and reduces to
+    the classical (incoherent) composition of per-crossing flips — the
+    two limits the tests pin.  P_{χ→B} = (1 − r_z)/2.
+    """
+    tau = dxi / xp.maximum(v, 1e-12)
+    qs = _su2_quaternions(a, b, tau, xp)
+    Rs = _quat_to_rotations(qs, xp)
+    # Γ < 0 is rejected at every host API boundary (dephased_probability,
+    # sweep_bridge, the CLIs); the in-trace clamp only guards NaN-free
+    # behavior for traced values.
+    decay = xp.exp(-xp.maximum(gamma_phi, 0.0) * tau)
+    # D @ R: scale the x/y rows of each rotation by the segment's decay
+    scale = xp.stack([decay, decay, xp.ones_like(decay)], axis=-1)
+    Ms = Rs * scale[:, :, None]
+    M_total = _ordered_tree_product(
+        Ms, lambda m1, m2: xp.matmul(m1, m2), np.eye(3), xp
+    )
+    r0 = xp.asarray([0.0, 0.0, 1.0], dtype=M_total.dtype)
+    return M_total @ r0
+
+
+def dephased_probability(
+    profile: BounceProfile, v_w: float, gamma_phi: float
+) -> float:
+    """P_{χ→B} with diabatic-basis dephasing at rate Γ_φ (host seam)."""
+    if gamma_phi < 0.0:
+        raise ValueError(f"gamma_phi must be >= 0, got {gamma_phi}")
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    import jax.numpy as jnp
+
+    a, b, dxi = _segment_hamiltonians(profile, jnp)
+    r = propagate_bloch(
+        a, b, dxi, jnp.asarray(max(float(v_w), 1e-12)),
+        jnp.asarray(float(gamma_phi)), jnp,
+    )
+    return float(min(max(0.5 * (1.0 - float(r[2])), 0.0), 1.0))
 
 
 def transfer_matrix_propagation(
@@ -213,17 +293,24 @@ def probability_from_profile(
     profile_csv_path: str,
     v_w: float,
     method: str = "coherent",
+    gamma_phi: float = 0.0,
 ) -> float:
     """Seam contract of the reference's `maybe_P` (:317-328): (csv, v_w) → P∈[0,1].
 
     ``method="coherent"`` (default) runs the full distributed transfer-matrix
     kernel; ``method="local"`` composes per-crossing λ's and applies
-    P = 1 − e^(−2πλ_eff) (the reference's map for external λ's).
+    P = 1 − e^(−2πλ_eff) (the reference's map for external λ's);
+    ``method="dephased"`` runs the density-matrix transport with
+    diabatic-basis dephasing rate ``gamma_phi``.
     """
     profile = load_profile_csv(profile_csv_path)
     if method == "local":
         return probability_from_lambda(lambda_eff_from_profile(profile, v_w))
+    if method == "dephased":
+        return dephased_probability(profile, v_w, gamma_phi)
     if method != "coherent":
-        raise ValueError(f"method must be 'coherent' or 'local', got {method!r}")
+        raise ValueError(
+            f"method must be 'coherent', 'local', or 'dephased', got {method!r}"
+        )
     _, P = transfer_matrix_propagation(profile, v_w)
     return float(min(max(P, 0.0), 1.0))

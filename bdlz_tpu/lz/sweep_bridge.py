@@ -20,6 +20,10 @@ Methods (accuracy contract in mind):
 * ``"local-momentum"`` — flux-weighted thermal average of the local
   composition per unique (v_w, T_p, m_χ) combination (the paper's F(k)
   layer applied point-wise).
+* ``"dephased"`` — density-matrix transport with diabatic-basis
+  dephasing at rate ``gamma_phi`` (`lz.kernel.propagate_bloch`) —
+  interpolates between the coherent kernel (Γ = 0) and the incoherent
+  per-crossing composition (Γ → ∞).
 """
 from __future__ import annotations
 
@@ -30,7 +34,7 @@ import numpy as np
 from bdlz_tpu.lz.kernel import local_lambdas
 from bdlz_tpu.lz.profile import BounceProfile, find_crossings, load_profile_csv
 
-VALID_METHODS = ("local", "coherent", "local-momentum")
+VALID_METHODS = ("local", "coherent", "local-momentum", "dephased")
 
 
 def profile_fingerprint(profile: Union[str, BounceProfile]) -> str:
@@ -51,6 +55,7 @@ def probabilities_for_points(
     method: str = "local",
     T_p_GeV=None,
     m_chi_GeV=None,
+    gamma_phi: float = 0.0,
 ) -> np.ndarray:
     """P_{χ→B} for each sweep point's wall speed (host-side, pre-sweep).
 
@@ -67,6 +72,8 @@ def probabilities_for_points(
     """
     if method not in VALID_METHODS:
         raise ValueError(f"method must be one of {VALID_METHODS}, got {method!r}")
+    if gamma_phi < 0.0:
+        raise ValueError(f"gamma_phi must be >= 0, got {gamma_phi}")
     if isinstance(profile, str):
         profile = load_profile_csv(profile)
 
@@ -77,7 +84,7 @@ def probabilities_for_points(
         v = np.clip(v_w, 1e-6, 1.0 - 1e-12)
         return 1.0 - np.exp(-2.0 * np.pi * lam1 / v)
 
-    if method == "coherent":
+    if method in ("coherent", "dephased"):
         # jax_numpy() probes the accelerator relay before the first
         # backend touch — a direct jax import here could hang forever on
         # a dead relay (documented environment failure mode)
@@ -86,18 +93,29 @@ def probabilities_for_points(
         jnp = jax_numpy()
         import jax
 
-        from bdlz_tpu.lz.kernel import _segment_hamiltonians, propagate_quaternion
+        from bdlz_tpu.lz.kernel import (
+            _segment_hamiltonians,
+            propagate_bloch,
+            propagate_quaternion,
+        )
 
         a, b, dxi = _segment_hamiltonians(profile, jnp)
         uniq, inverse = np.unique(v_w, return_inverse=True)
         speeds = jnp.clip(jnp.asarray(uniq), 1e-6, 1.0 - 1e-12)
 
-        def P_of_speed(speed):
-            q = propagate_quaternion(a, b, dxi, speed, jnp)
-            return q[1] ** 2 + q[2] ** 2
+        if method == "dephased":
+            gam = jnp.asarray(float(gamma_phi))
+
+            def P_of_speed(speed):
+                r = propagate_bloch(a, b, dxi, speed, gam, jnp)
+                return 0.5 * (1.0 - r[2])
+        else:
+            def P_of_speed(speed):
+                q = propagate_quaternion(a, b, dxi, speed, jnp)
+                return q[1] ** 2 + q[2] ** 2
 
         P_uniq = np.asarray(jax.vmap(P_of_speed)(speeds))
-        return P_uniq[inverse]
+        return np.clip(P_uniq, 0.0, 1.0)[inverse]
 
     # local-momentum: one jit-batched evaluation per unique thermal
     # state (T_p, m_chi), covering all of that state's unique wall
@@ -148,8 +166,9 @@ class PTable(NamedTuple):
 #: (Stückelberg phases) and needs dense nodes (cubic error is 4th order —
 #: measured 3e-5 @ 4096 → 1.2e-7 @ 16384 on a strongly oscillatory test
 #: profile); the momentum average is a smooth thermal integral of the
-#: local composition.
-_TABLE_N_DEFAULT = {"coherent": 16384, "local-momentum": 1024}
+#: local composition.  The dephased estimator inherits the coherent
+#: density: its oscillations damp with Γ but are fully present at Γ → 0.
+_TABLE_N_DEFAULT = {"coherent": 16384, "local-momentum": 1024, "dephased": 16384}
 
 
 def make_P_of_vw_table(
@@ -160,6 +179,7 @@ def make_P_of_vw_table(
     n: int = 0,
     T_p_GeV: float | None = None,
     m_chi_GeV: float | None = None,
+    gamma_phi: float = 0.0,
     xp=np,
 ) -> PTable:
     """Precompute P(v_w) over [v_lo, v_hi] for in-jit interpolation.
@@ -198,7 +218,9 @@ def make_P_of_vw_table(
             profile, vs, float(T_p_GeV), float(m_chi_GeV)
         )
     else:
-        P = probabilities_for_points(profile, vs, method=method)
+        P = probabilities_for_points(
+            profile, vs, method=method, gamma_phi=gamma_phi
+        )
     inv_du = (n - 1) / (1.0 / v_lo - 1.0 / v_hi)
     return PTable(
         u0=1.0 / v_hi,

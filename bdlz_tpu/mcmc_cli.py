@@ -49,16 +49,25 @@ def main(argv=None) -> None:
                          "wall speed through the two-channel LZ kernel, so "
                          "sampling v_w samples the distributed-LZ physics")
     ap.add_argument("--lz-method", default="local", dest="lz_method",
-                    choices=("local", "coherent", "local-momentum"),
+                    choices=("local", "coherent", "local-momentum", "dephased"),
                     help="LZ estimator with --lz-profile: local (analytic in "
                          "v_w, evaluated exactly in-jit), coherent (full "
-                         "transfer matrix) and local-momentum (thermal "
-                         "flux-weighted average) via a dense P(v_w) "
-                         "interpolation table built once at startup")
+                         "transfer matrix), local-momentum (thermal "
+                         "flux-weighted average), and dephased (density-"
+                         "matrix transport at --lz-gamma-phi) via a dense "
+                         "P(v_w) interpolation table built once at startup")
     ap.add_argument("--lz-table-n", type=int, default=0, dest="lz_table_n",
                     help="Nodes of the P(v_w) table for coherent/"
-                         "local-momentum (0 = per-method default)")
+                         "local-momentum/dephased (0 = per-method default)")
+    ap.add_argument("--lz-gamma-phi", type=float, default=0.0,
+                    dest="lz_gamma_phi",
+                    help="Diabatic-basis dephasing rate for --lz-method "
+                         "dephased (energy units of the profile's Delta)")
     args = ap.parse_args(argv)
+    if args.lz_gamma_phi and args.lz_method != "dephased":
+        raise SystemExit("--lz-gamma-phi requires --lz-method dephased")
+    if args.lz_gamma_phi < 0.0:
+        raise SystemExit("--lz-gamma-phi must be >= 0")
     if not 0 <= args.burn < args.steps:
         raise SystemExit(
             f"--burn {args.burn} must satisfy 0 <= burn < --steps {args.steps}"
@@ -140,7 +149,8 @@ def main(argv=None) -> None:
                 from bdlz_tpu.lz.sweep_bridge import probabilities_for_points
 
                 P_pin = float(probabilities_for_points(
-                    profile, [cfg.v_w], method="coherent",
+                    profile, [cfg.v_w], method=args.lz_method,
+                    gamma_phi=args.lz_gamma_phi,
                 )[0])
             import dataclasses
 
@@ -151,7 +161,8 @@ def main(argv=None) -> None:
             v_lo, v_hi = params["v_w"]
             ptab = make_P_of_vw_table(
                 profile, args.lz_method, v_lo, v_hi, n=args.lz_table_n,
-                T_p_GeV=cfg.T_p_GeV, m_chi_GeV=cfg.m_chi_GeV, xp=jnp,
+                T_p_GeV=cfg.T_p_GeV, m_chi_GeV=cfg.m_chi_GeV,
+                gamma_phi=args.lz_gamma_phi, xp=jnp,
             )
             lz_kwargs["lz_P_table"] = ptab
             _table_n = int(ptab.values.shape[0])
@@ -200,6 +211,11 @@ def main(argv=None) -> None:
                             # change to the per-method default must also
                             # invalidate resume
                             "table_n": _table_n,
+                            # the dephasing rate changes every P — keyed
+                            # only for the method that uses it so existing
+                            # checkpoint identities are untouched
+                            **({"gamma_phi": args.lz_gamma_phi}
+                               if args.lz_method == "dephased" else {}),
                         }
                     }
                     if args.lz_profile
@@ -252,6 +268,8 @@ def main(argv=None) -> None:
         summary["resumed_segments"] = resumed_segments
     if args.lz_profile:
         summary["lz"] = {"profile": args.lz_profile, "method": args.lz_method}
+        if args.lz_method == "dephased":
+            summary["lz"]["gamma_phi"] = args.lz_gamma_phi
     if args.out:
         np.savez(args.out, chain=full_chain, logp=full_logp,
                  param_names=list(params))

@@ -396,6 +396,7 @@ def run_sweep(
     fuse_exp: bool = False,
     lz_profile=None,
     lz_method: str = "local",
+    lz_gamma_phi: float = 0.0,
 ) -> SweepResult:
     """Run a full sweep: grid build → per-chunk jitted sharded evaluation →
     (optional) chunk files + manifest with resume.
@@ -445,12 +446,18 @@ def run_sweep(
             lz_profile, np.asarray(pp_all.v_w), method=lz_method,
             T_p_GeV=np.asarray(pp_all.T_p_GeV),
             m_chi_GeV=np.asarray(pp_all.m_chi_GeV),
+            gamma_phi=lz_gamma_phi,
         )
         pp_all = pp_all._replace(P=P_pts)
         hash_extra = {
             "lz_profile": profile_fingerprint(lz_profile),
             "lz_method": lz_method,
         }
+        if lz_method == "dephased":
+            # the dephasing rate changes every P — different Γ_φ are
+            # different sweeps (only keyed for the method that uses it,
+            # so existing directories keep their hashes)
+            hash_extra["lz_gamma_phi"] = float(lz_gamma_phi)
     if mesh is not None:
         # The sharded batch axis must divide evenly across the mesh; chunks
         # are padded to chunk_size, so just round chunk_size itself up.

@@ -68,12 +68,18 @@ def main(argv=None) -> None:
                          "kernel (v_w scans then exercise the distributed-LZ "
                          "physics end to end)")
     ap.add_argument("--lz-method", default="local", dest="lz_method",
-                    choices=("local", "coherent", "local-momentum"),
+                    choices=("local", "coherent", "local-momentum", "dephased"),
                     help="Per-point LZ estimator with --lz-profile: local "
                          "(analytic composition, spectrally exact — the "
                          "1e-6-contract default), coherent (full transfer "
                          "matrix, carries Stueckelberg oscillations), "
-                         "local-momentum (thermal flux-weighted average)")
+                         "local-momentum (thermal flux-weighted average), "
+                         "dephased (density-matrix transport with "
+                         "--lz-gamma-phi dephasing)")
+    ap.add_argument("--lz-gamma-phi", type=float, default=0.0,
+                    dest="lz_gamma_phi",
+                    help="Diabatic-basis dephasing rate for --lz-method "
+                         "dephased (energy units of the profile's Delta)")
     ap.add_argument("--multihost", action="store_true",
                     help="Initialize jax.distributed from JAX_COORDINATOR_ADDRESS/"
                          "JAX_NUM_PROCESSES/JAX_PROCESS_ID before building the mesh "
@@ -81,6 +87,10 @@ def main(argv=None) -> None:
     args = ap.parse_args(argv)
     if args.fuse_exp and args.impl != "pallas":
         ap.error("--fuse-exp requires --impl pallas")
+    if args.lz_gamma_phi and args.lz_method != "dephased":
+        ap.error("--lz-gamma-phi requires --lz-method dephased")
+    if args.lz_gamma_phi < 0.0:
+        ap.error("--lz-gamma-phi must be >= 0")
 
     if args.multihost:
         from bdlz_tpu.parallel import init_multihost
@@ -131,6 +141,7 @@ def main(argv=None) -> None:
         event_log=event_log, trace_dir=args.profile_dir,
         impl=args.impl, interpret=interpret, fuse_exp=args.fuse_exp,
         lz_profile=args.lz_profile, lz_method=args.lz_method,
+        lz_gamma_phi=args.lz_gamma_phi,
     )
 
     ratios = res.outputs["DM_over_B"]

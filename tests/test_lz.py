@@ -330,6 +330,119 @@ class TestSweepBridge:
         assert a == profile_fingerprint(linear_profile(alpha=1.0))
 
 
+class TestDephased:
+    """Density-matrix (Bloch) distributed-LZ transport with diabatic-basis
+    dephasing: Γ = 0 must reproduce the coherent SU(2) kernel exactly, and
+    Γ → ∞ must kill Stückelberg interference, approaching the classical
+    composition of per-crossing flips."""
+
+    def _two_crossing_profile(self, alpha=0.1, kappa=0.34, x0=20.0, N=40001):
+        # Δ = α(ξ² − x0²): zeros at ±x0 with slope 2αx0; LZ zones of width
+        # ~κ/(2αx0) around each, far narrower than the 2·x0 separation, so
+        # between-crossing coherence and within-crossing dynamics have
+        # cleanly separated timescales for the dephasing to discriminate.
+        L = 2.0 * x0
+        xi = np.linspace(-L, L, N)
+        return BounceProfile(
+            xi=xi, delta=alpha * (xi * xi - x0 * x0), mix=np.full_like(xi, kappa)
+        )
+
+    def test_gamma_zero_matches_coherent(self):
+        import jax.numpy as jnp
+
+        from bdlz_tpu.lz.kernel import (
+            _segment_hamiltonians,
+            propagate_bloch,
+            propagate_quaternion,
+        )
+
+        prof = self._two_crossing_profile()
+        a, b, dxi = _segment_hamiltonians(prof, jnp)
+        for v in (0.3, 0.62, 0.95):
+            q = np.asarray(propagate_quaternion(a, b, dxi, jnp.asarray(v), jnp))
+            P_coh = q[1] ** 2 + q[2] ** 2
+            r = np.asarray(propagate_bloch(
+                a, b, dxi, jnp.asarray(v), jnp.asarray(0.0), jnp
+            ))
+            assert np.abs(np.linalg.norm(r) - 1.0) < 1e-10  # pure state stays pure
+            P_bloch = 0.5 * (1.0 - r[2])
+            assert P_bloch == pytest.approx(P_coh, rel=1e-9, abs=1e-12), v
+
+    def test_large_gamma_approaches_incoherent_composition(self):
+        from bdlz_tpu.lz.kernel import dephased_probability, local_lambdas
+
+        prof = self._two_crossing_profile()
+        v = 0.5
+        lams = local_lambdas(find_crossings(prof), v)
+        assert lams.size == 2
+        p1, p2 = (1.0 - np.exp(-2.0 * np.pi * lams))
+        P_incoh = p1 * (1.0 - p2) + (1.0 - p1) * p2
+        # Γ chosen so Γ·τ_sep ≈ 40 (inter-crossing coherence dead) while
+        # Γ·τ_zone ≈ 0.1 (single-crossing dynamics barely touched)
+        P_deph = dephased_probability(prof, v, gamma_phi=0.5)
+        assert P_deph == pytest.approx(P_incoh, rel=0.1)
+
+    def test_dephasing_damps_stueckelberg_oscillations(self):
+        from bdlz_tpu.lz.sweep_bridge import probabilities_for_points
+
+        prof = self._two_crossing_profile()
+        vs = np.linspace(0.4, 0.6, 41)
+        P_coh = probabilities_for_points(prof, vs, method="coherent")
+        P_mid = probabilities_for_points(
+            prof, vs, method="dephased", gamma_phi=0.05
+        )
+        P_dead = probabilities_for_points(
+            prof, vs, method="dephased", gamma_phi=1.0
+        )
+        swing = lambda P: P.max() - P.min()  # noqa: E731
+        assert swing(P_coh) > 0.1  # the interference structure is there
+        assert swing(P_mid) < swing(P_coh)
+        assert swing(P_dead) < 0.2 * swing(P_coh)
+        assert np.all((P_dead >= 0.0) & (P_dead <= 1.0))
+
+    def test_dephased_table_matches_host_kernel(self):
+        import jax.numpy as jnp
+
+        from bdlz_tpu.lz.kernel import dephased_probability
+        from bdlz_tpu.lz.sweep_bridge import eval_P_table, make_P_of_vw_table
+
+        prof = self._two_crossing_profile(N=2001)
+        tab = make_P_of_vw_table(
+            prof, "dephased", 0.3, 0.9, n=4096, gamma_phi=0.2, xp=jnp
+        )
+        rng = np.random.default_rng(5)
+        vs = rng.uniform(0.3, 0.9, 16)
+        got = np.asarray(eval_P_table(jnp.asarray(vs), tab, jnp))
+        ref = np.array([dephased_probability(prof, v, 0.2) for v in vs])
+        assert np.abs(got - ref).max() < 1e-6
+
+    def test_negative_gamma_rejected(self):
+        from bdlz_tpu.lz.kernel import dephased_probability
+        from bdlz_tpu.lz.sweep_bridge import probabilities_for_points
+
+        prof = self._two_crossing_profile(N=201)
+        with pytest.raises(ValueError, match="gamma_phi"):
+            dephased_probability(prof, 0.5, -0.1)
+        with pytest.raises(ValueError, match="gamma_phi"):
+            probabilities_for_points(
+                prof, [0.5], method="dephased", gamma_phi=-1.0
+            )
+
+    def test_seam_contract(self, tmp_path):
+        """(csv, v_w) → P ∈ [0,1] through probability_from_profile."""
+        prof = self._two_crossing_profile(N=2001)
+        p = tmp_path / "prof.csv"
+        rows = "\n".join(
+            f"{x},{d},{m}" for x, d, m in zip(prof.xi, prof.delta, prof.mix)
+        )
+        p.write_text("xi,delta,m_mix\n" + rows + "\n")
+        P = probability_from_profile(str(p), 0.5, method="dephased", gamma_phi=0.3)
+        assert 0.0 <= P <= 1.0
+        P0 = probability_from_profile(str(p), 0.5, method="dephased", gamma_phi=0.0)
+        Pc = probability_from_profile(str(p), 0.5, method="coherent")
+        assert P0 == pytest.approx(Pc, rel=1e-9)
+
+
 class TestPTable:
     """P(v_w) interpolation tables: the in-jit bridge that makes the
     coherent and momentum-averaged estimators samplable (MCMC) — built on

@@ -259,6 +259,27 @@ def test_resume_invalidated_by_engine_change(base_cfg, mesh8, tmp_path):
     assert r.resumed_chunks == 0
 
 
+def test_resume_invalidated_by_pallas_knob_change(base_cfg, mesh8, tmp_path):
+    """Pallas kernel knobs (fuse_exp; the in-kernel reduce default) join
+    the resume identity: results differ at ~1e-7 between kernel variants,
+    so a directory written with one must not be resumed with another
+    (review regression, r3)."""
+    static = static_choices_from_config(base_cfg)
+    axes = {"m_chi_GeV": [0.5, 0.95]}
+    out = str(tmp_path / "sweep")
+    run_sweep(base_cfg, axes, static, mesh=mesh8, chunk_size=2, out_dir=out,
+              impl="pallas", interpret=True)
+    # same knobs → resumes
+    r_same = run_sweep(base_cfg, axes, static, mesh=mesh8, chunk_size=2,
+                       out_dir=out, impl="pallas", interpret=True)
+    assert r_same.resumed_chunks == 1
+    # different exp algorithm → full recompute
+    r_fuse = run_sweep(base_cfg, axes, static, mesh=mesh8, chunk_size=2,
+                       out_dir=out, impl="pallas", interpret=True,
+                       fuse_exp=True)
+    assert r_fuse.resumed_chunks == 0
+
+
 class TestResumeHardening:
     def test_missing_chunk_file_recomputed_not_fatal(self, base_cfg, mesh8,
                                                      tmp_path, capsys):
