@@ -225,23 +225,59 @@ def propagate_bloch(a, b, dxi, v, gamma_phi, xp):
     return M_total @ r0
 
 
+def validate_gamma_phi(gamma_phi: float, method: str) -> None:
+    """Host-boundary Γ_φ contract, shared by every (method, Γ) seam:
+    negative rates are invalid, and a rate the method would silently
+    ignore is a caller error (same pairing the CLIs enforce)."""
+    if gamma_phi < 0.0:
+        raise ValueError(f"gamma_phi must be >= 0, got {gamma_phi}")
+    if gamma_phi and method != "dephased":
+        raise ValueError(f"gamma_phi has no effect with method={method!r}")
+
+
+def make_P_of_speed(method: str, a, b, dxi, gamma_phi, xp):
+    """P_{χ→B}(traversal speed) closure for the propagating estimators.
+
+    The single home of the quaternion→P and Bloch→P formulas
+    (P = q_x² + q_y², P = (1 − r_z)/2), shared by the momentum-averaging
+    layer, the sweep bridge, and the host seams so the estimators cannot
+    drift apart.  ``method`` must be "coherent" or "dephased" (the local
+    composition is analytic in v and has no propagation closure).
+    """
+    if method == "dephased":
+        gam = xp.asarray(float(gamma_phi))
+
+        def P_of_speed(speed):
+            r = propagate_bloch(a, b, dxi, speed, gam, xp)
+            return 0.5 * (1.0 - r[2])
+    elif method == "coherent":
+        def P_of_speed(speed):
+            q = propagate_quaternion(a, b, dxi, speed, xp)
+            return q[1] ** 2 + q[2] ** 2
+    else:
+        raise ValueError(
+            f"no propagation closure for method={method!r} "
+            "(expected 'coherent' or 'dephased')"
+        )
+    return P_of_speed
+
+
 def dephased_probability(
     profile: BounceProfile, v_w: float, gamma_phi: float
 ) -> float:
     """P_{χ→B} with diabatic-basis dephasing at rate Γ_φ (host seam)."""
-    if gamma_phi < 0.0:
-        raise ValueError(f"gamma_phi must be >= 0, got {gamma_phi}")
-    import jax
+    validate_gamma_phi(gamma_phi, "dephased")
+    # jax_numpy() probes the accelerator relay before the first backend
+    # touch — a direct jax import here would hang forever on a dead relay
+    # (documented environment failure mode)
+    from bdlz_tpu.backend import jax_numpy
 
-    jax.config.update("jax_enable_x64", True)
-    import jax.numpy as jnp
+    jnp = jax_numpy()
 
     a, b, dxi = _segment_hamiltonians(profile, jnp)
-    r = propagate_bloch(
-        a, b, dxi, jnp.asarray(max(float(v_w), 1e-12)),
-        jnp.asarray(float(gamma_phi)), jnp,
-    )
-    return float(min(max(0.5 * (1.0 - float(r[2])), 0.0), 1.0))
+    P_of_speed = make_P_of_speed("dephased", a, b, dxi, gamma_phi, jnp)
+    P = float(P_of_speed(jnp.asarray(max(float(v_w), 1e-12))))
+    return float(min(max(P, 0.0), 1.0))
 
 
 def transfer_matrix_propagation(
@@ -261,10 +297,12 @@ def transfer_matrix_propagation(
     matrix-exponential path (arXiv:1004.2914), kept as an independent
     cross-check (complex dtype ⇒ CPU only in this environment).
     """
-    import jax
+    # relay-probed backend import: a direct jax import hangs forever on a
+    # dead accelerator relay (documented environment failure mode)
+    from bdlz_tpu.backend import jax_numpy
 
-    jax.config.update("jax_enable_x64", True)
-    import jax.numpy as jnp
+    jnp = jax_numpy()
+    import jax
     from jax import lax
 
     v = max(float(v_w), 1e-12)
@@ -303,6 +341,7 @@ def probability_from_profile(
     ``method="dephased"`` runs the density-matrix transport with
     diabatic-basis dephasing rate ``gamma_phi``.
     """
+    validate_gamma_phi(gamma_phi, method)
     profile = load_profile_csv(profile_csv_path)
     if method == "local":
         return probability_from_lambda(lambda_eff_from_profile(profile, v_w))

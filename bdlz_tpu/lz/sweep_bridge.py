@@ -72,8 +72,9 @@ def probabilities_for_points(
     """
     if method not in VALID_METHODS:
         raise ValueError(f"method must be one of {VALID_METHODS}, got {method!r}")
-    if gamma_phi < 0.0:
-        raise ValueError(f"gamma_phi must be >= 0, got {gamma_phi}")
+    from bdlz_tpu.lz.kernel import validate_gamma_phi
+
+    validate_gamma_phi(gamma_phi, method)
     if isinstance(profile, str):
         profile = load_profile_csv(profile)
 
@@ -93,27 +94,12 @@ def probabilities_for_points(
         jnp = jax_numpy()
         import jax
 
-        from bdlz_tpu.lz.kernel import (
-            _segment_hamiltonians,
-            propagate_bloch,
-            propagate_quaternion,
-        )
+        from bdlz_tpu.lz.kernel import _segment_hamiltonians, make_P_of_speed
 
         a, b, dxi = _segment_hamiltonians(profile, jnp)
         uniq, inverse = np.unique(v_w, return_inverse=True)
         speeds = jnp.clip(jnp.asarray(uniq), 1e-6, 1.0 - 1e-12)
-
-        if method == "dephased":
-            gam = jnp.asarray(float(gamma_phi))
-
-            def P_of_speed(speed):
-                r = propagate_bloch(a, b, dxi, speed, gam, jnp)
-                return 0.5 * (1.0 - r[2])
-        else:
-            def P_of_speed(speed):
-                q = propagate_quaternion(a, b, dxi, speed, jnp)
-                return q[1] ** 2 + q[2] ** 2
-
+        P_of_speed = make_P_of_speed(method, a, b, dxi, gamma_phi, jnp)
         P_uniq = np.asarray(jax.vmap(P_of_speed)(speeds))
         return np.clip(P_uniq, 0.0, 1.0)[inverse]
 

@@ -80,6 +80,13 @@ LANES = 128
 #: that the kernel jaxpr stays tiny (the grid, not the unroll, walks n_y).
 COL_BLOCK = 8
 
+#: Default for the in-kernel Kahan reduction.  The sweep resume identity
+#: references THIS constant (`parallel/sweep.py`), so flipping it — e.g.
+#: reverting to the streaming kernel after a hardware regression —
+#: invalidates pallas sweep directories instead of silently splicing
+#: chunks from two summation algorithms.
+REDUCE_DEFAULT = True
+
 
 def build_shifted_table(table: KJMATable) -> jax.Array:
     """(512, 128) f32 stencil-shifted TRANSPOSED layout of an F table.
@@ -446,7 +453,7 @@ def integrate_YB_pallas(
     *,
     interpret: bool = False,
     fuse_exp: bool = False,
-    reduce: bool = True,
+    reduce: bool = REDUCE_DEFAULT,
 ) -> jax.Array:
     """Batched fast-path Y_B with the Pallas interpolation kernel.
 
@@ -666,7 +673,7 @@ def point_yields_pallas(
     *,
     interpret: bool = False,
     fuse_exp: bool = False,
-    reduce: bool = True,
+    reduce: bool = REDUCE_DEFAULT,
 ):
     """Batched flagship pipeline on the Pallas hot path.
 

@@ -556,9 +556,15 @@ def run_sweep(
         # level join the identity (same reasoning as ode_method/rtol/atol
         # for the stiff engine): a resumed directory must not splice
         # chunks from different summation/exp algorithms.  "reduce"
-        # records the in-kernel Kahan accumulation default.
+        # records the kernel's actual accumulation default — referencing
+        # the constant (not a literal) so flipping it invalidates
+        # existing pallas directories.
+        from bdlz_tpu.ops.kjma_pallas import REDUCE_DEFAULT
+
         hash_extra = dict(hash_extra or {})
-        hash_extra["pallas"] = {"fuse_exp": bool(fuse_exp), "reduce": True}
+        hash_extra["pallas"] = {
+            "fuse_exp": bool(fuse_exp), "reduce": bool(REDUCE_DEFAULT),
+        }
     h = grid_hash(base, axes, n_y, impl, extra=hash_extra)
     if out_dir is not None:
         import os

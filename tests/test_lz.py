@@ -416,6 +416,26 @@ class TestDephased:
         ref = np.array([dephased_probability(prof, v, 0.2) for v in vs])
         assert np.abs(got - ref).max() < 1e-6
 
+    def test_momentum_average_dephased(self):
+        """The F(k) layer accepts the dephased estimator: Γ = 0 matches
+        the coherent average, and a finite Γ stays a valid probability."""
+        from bdlz_tpu.lz.momentum import momentum_averaged_probability
+
+        prof = self._two_crossing_profile(N=801)
+        P0, F0 = momentum_averaged_probability(
+            prof, 0.5, 100.0, 0.95, n_k=32, n_mu=8,
+            method="dephased", gamma_phi=0.0,
+        )
+        Pc, Fc = momentum_averaged_probability(
+            prof, 0.5, 100.0, 0.95, n_k=32, n_mu=8, method="coherent",
+        )
+        assert P0 == pytest.approx(Pc, rel=1e-9)
+        Pd, Fd = momentum_averaged_probability(
+            prof, 0.5, 100.0, 0.95, n_k=32, n_mu=8,
+            method="dephased", gamma_phi=0.5,
+        )
+        assert 0.0 <= Pd <= 1.0 and np.isfinite(Fd)
+
     def test_negative_gamma_rejected(self):
         from bdlz_tpu.lz.kernel import dephased_probability
         from bdlz_tpu.lz.sweep_bridge import probabilities_for_points
@@ -426,6 +446,18 @@ class TestDephased:
         with pytest.raises(ValueError, match="gamma_phi"):
             probabilities_for_points(
                 prof, [0.5], method="dephased", gamma_phi=-1.0
+            )
+        # a rate the method would silently ignore is a caller error
+        with pytest.raises(ValueError, match="no effect"):
+            probabilities_for_points(
+                prof, [0.5], method="coherent", gamma_phi=0.5
+            )
+        from bdlz_tpu.lz.momentum import momentum_averaged_probability
+
+        with pytest.raises(ValueError, match="no effect"):
+            momentum_averaged_probability(
+                prof, 0.5, 100.0, 0.95, n_k=16, n_mu=4,
+                method="local", gamma_phi=0.5,
             )
 
     def test_seam_contract(self, tmp_path):
