@@ -134,6 +134,7 @@ def make_sweep_step(
     impl: str = "tabulated",
     interpret: bool = False,
     fuse_exp: bool = False,
+    reduce: "bool | None" = None,
 ):
     """Compile the per-chunk step: batched pipeline, batch sharded over the mesh.
 
@@ -157,13 +158,15 @@ def make_sweep_step(
         impl = "direct"
 
     if impl == "pallas":
-        from bdlz_tpu.ops.kjma_pallas import point_yields_pallas
+        from bdlz_tpu.ops.kjma_pallas import REDUCE_DEFAULT, point_yields_pallas
+
+        _reduce = REDUCE_DEFAULT if reduce is None else bool(reduce)
 
         def batched(pp, aux):
             table, t4 = aux
             return point_yields_pallas(
                 pp, static, table, t4, n_y=n_y, interpret=interpret,
-                fuse_exp=fuse_exp,
+                fuse_exp=fuse_exp, reduce=_reduce,
             )
 
         if mesh is None:
@@ -314,6 +317,7 @@ def make_chunk_runner(
     impl: str = "tabulated",
     n_y: int = 8000,
     fuse_exp: bool = False,
+    reduce: "bool | None" = None,
 ):
     """``(run_chunk, chunk)`` — padded, device-put chunk evaluation.
 
@@ -336,6 +340,7 @@ def make_chunk_runner(
         step = make_sweep_step(
             static, mesh=mesh, n_y=n_y, impl="pallas",
             interpret=jax.devices()[0].platform == "cpu", fuse_exp=fuse_exp,
+            reduce=reduce,
         )
         aux = (table, build_shifted_table(table))
     else:

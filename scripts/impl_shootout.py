@@ -1,7 +1,8 @@
 #!/usr/bin/env python3
-"""Timed engine comparison on the current platform: tabulated vs pallas
-(vs pallas+fuse_exp), one JSON line per engine plus a markdown table row
-for docs/perf_notes.md.
+"""Timed engine comparison on the current platform: tabulated vs the
+pallas kernel variants (+fuse: in-kernel Cody-Waite exp; +stream: full
+integrand writeback instead of the in-kernel Kahan reduction), one JSON
+line per engine plus a markdown table row for docs/perf_notes.md.
 
 This is the evidence collector behind VERDICT r2 item #1/#2 ("a timed
 pallas-vs-tabulated comparison"): same grid, same chunking, per-engine
@@ -26,7 +27,13 @@ def main() -> None:
     ap.add_argument("--points", type=int, default=65536)
     ap.add_argument("--chunk", type=int, default=8192)
     ap.add_argument("--n-y", type=int, default=8000, dest="n_y")
-    ap.add_argument("--engines", default="tabulated,pallas,pallas+fuse")
+    ap.add_argument(
+        "--engines",
+        default="tabulated,pallas,pallas+stream,pallas+fuse,pallas+fuse+stream",
+        help="Comma list; pallas variants: +fuse (in-kernel Cody-Waite "
+             "exp), +stream (write the full integrand instead of the "
+             "in-kernel Kahan reduction)",
+    )
     args = ap.parse_args()
 
     from bdlz_tpu.utils.platform import ensure_live_backend
@@ -85,11 +92,21 @@ def main() -> None:
     for engine in args.engines.split(","):
         engine = engine.strip()
         impl = "pallas" if engine.startswith("pallas") else engine
-        fuse = engine.endswith("+fuse")
+        mods = engine.split("+")[1:]
+        unknown = set(mods) - {"fuse", "stream"}
+        if unknown:
+            # a typo'd modifier must not silently record a mislabeled row
+            row = {"engine": engine, "platform": platform,
+                   "error": f"ValueError: unknown engine modifiers {sorted(unknown)}"}
+            rows.append(row)
+            print(json.dumps(row), flush=True)
+            continue
+        fuse = "fuse" in mods
+        reduce = False if "stream" in mods else None  # None -> kernel default
         try:
             run_chunk, eff_chunk = make_chunk_runner(
                 pp_all, chunk, static, mesh, sharding, table,
-                impl=impl, n_y=args.n_y, fuse_exp=fuse,
+                impl=impl, n_y=args.n_y, fuse_exp=fuse, reduce=reduce,
             )
 
             first = np.asarray(run_chunk(0, min(eff_chunk, n_total)))  # warm-up
