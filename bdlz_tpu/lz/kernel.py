@@ -245,7 +245,9 @@ def make_P_of_speed(method: str, a, b, dxi, gamma_phi, xp):
     composition is analytic in v and has no propagation closure).
     """
     if method == "dephased":
-        gam = xp.asarray(float(gamma_phi))
+        # no float() coercion: gamma_phi may be a traced scalar (the 2-D
+        # table builder jits over it)
+        gam = xp.asarray(gamma_phi)
 
         def P_of_speed(speed):
             r = propagate_bloch(a, b, dxi, speed, gam, xp)

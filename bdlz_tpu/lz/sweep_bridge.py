@@ -218,6 +218,134 @@ def make_P_of_vw_table(
     )
 
 
+class PTable2D(NamedTuple):
+    """Dense P(v_w, Γ_φ) table for the dephased estimator, in-jit.
+
+    The v axis uses the same uniform-1/v node rationale as :class:`PTable`;
+    the Γ axis is uniform — dephasing enters only through smooth, monotone
+    e^(−Γτ) damping factors, so a modest cubic-interpolated Γ grid
+    converges fast.  Built once at logp-construction time so the MCMC can
+    SAMPLE the decoherence rate (constraining Γ_φ against Planck data)
+    alongside the wall speed.
+    """
+
+    u0: float        # first node in u = 1/v (= 1/v_hi)
+    inv_du: float    # 1 / node spacing in u
+    g0: float        # first Γ node (= gamma_lo)
+    inv_dg: float    # 1 / Γ node spacing
+    values: Any      # P at the nodes, shape (n_v, n_g)
+    v_lo: float
+    v_hi: float
+    g_lo: float
+    g_hi: float
+
+
+def make_P_of_vw_gamma_table(
+    profile: Union[str, BounceProfile],
+    v_lo: float,
+    v_hi: float,
+    gamma_lo: float,
+    gamma_hi: float,
+    n_v: int = 0,
+    n_g: int = 0,
+    xp=np,
+    speed_chunk: int = 512,
+) -> PTable2D:
+    """Precompute P(v_w, Γ_φ) over [v_lo, v_hi] × [gamma_lo, gamma_hi].
+
+    One dephased-kernel evaluation per (v, Γ) node, chunked over speeds so
+    the vmapped Bloch tree product's (chunk × segments × 3 × 3) transient
+    stays bounded for long profiles; the segment Hamiltonians are hoisted
+    and the per-chunk program is jitted ONCE with Γ as a traced argument,
+    so the (n_g × n_chunks) loop pays no re-trace.  Γ = 0 columns
+    reproduce the coherent kernel, so a table whose domain includes 0
+    smoothly contains the coherent limit — which is also why the default
+    v-axis density matches the 1-D dephased/coherent default
+    (`_TABLE_N_DEFAULT`): near Γ = 0 the full Stückelberg oscillation is
+    present and a coarser u-grid would reintroduce the ~3e-5 cubic error
+    the 1-D sizing was measured to avoid.
+    """
+    if isinstance(profile, str):
+        profile = load_profile_csv(profile)
+    if not (0.0 < v_lo < v_hi <= 1.0):
+        raise ValueError(f"need 0 < v_lo < v_hi <= 1, got [{v_lo}, {v_hi}]")
+    if not (0.0 <= gamma_lo < gamma_hi):
+        raise ValueError(
+            f"need 0 <= gamma_lo < gamma_hi, got [{gamma_lo}, {gamma_hi}]"
+        )
+    n_v = int(n_v) or _TABLE_N_DEFAULT["dephased"]
+    n_g = int(n_g) or 33
+    if n_v < 8 or n_g < 8:
+        raise ValueError(f"table needs >= 8 nodes per axis, got {n_v}x{n_g}")
+    us = np.linspace(1.0 / v_hi, 1.0 / v_lo, n_v)
+    vs = np.clip(1.0 / us, 1e-6, 1.0 - 1e-12)
+    gs = np.linspace(gamma_lo, gamma_hi, n_g)
+
+    from bdlz_tpu.backend import jax_numpy
+
+    jnp = jax_numpy()
+    import jax
+
+    from bdlz_tpu.lz.kernel import _segment_hamiltonians, make_P_of_speed
+
+    a, b, dxi = _segment_hamiltonians(profile, jnp)
+
+    @jax.jit
+    def P_chunk(v_chunk, g):
+        # make_P_of_speed is gamma-closure-based; rebuild inside the jit so
+        # g stays a traced argument (one compile per chunk SHAPE, not per Γ)
+        P_of_speed = make_P_of_speed("dephased", a, b, dxi, g, jnp)
+        return jax.vmap(P_of_speed)(v_chunk)
+
+    vals = np.empty((n_v, n_g))
+    for j, g in enumerate(gs):
+        for lo in range(0, n_v, int(speed_chunk)):
+            sl = slice(lo, min(lo + int(speed_chunk), n_v))
+            vals[sl, j] = np.asarray(
+                P_chunk(jnp.asarray(vs[sl]), jnp.asarray(float(g)))
+            )
+    vals = np.clip(vals, 0.0, 1.0)
+    return PTable2D(
+        u0=1.0 / v_hi,
+        inv_du=(n_v - 1) / (1.0 / v_lo - 1.0 / v_hi),
+        g0=float(gamma_lo),
+        inv_dg=(n_g - 1) / (gamma_hi - gamma_lo),
+        values=xp.asarray(vals),
+        v_lo=float(v_lo),
+        v_hi=float(v_hi),
+        g_lo=float(gamma_lo),
+        g_hi=float(gamma_hi),
+    )
+
+
+def eval_P_table_2d(v_w, gamma_phi, table: PTable2D, xp):
+    """P(v_w, Γ_φ) by separable cubic Lagrange interpolation, in-jit.
+
+    Scalar queries (the MCMC logp evaluates one walker at a time under
+    vmap); both coordinates are clamped into the table's domain and the
+    result into [0, 1].  Cubic in Γ via four u-interpolated columns
+    combined with the equispaced Lagrange weights — the same stencil as
+    `cubic_lagrange_uniform` applied on each axis.
+    """
+    from bdlz_tpu.ops.kjma_table import cubic_lagrange_uniform
+
+    u = 1.0 / xp.clip(v_w, table.v_lo, table.v_hi)
+    tu = (u - table.u0) * table.inv_du
+    g = xp.clip(gamma_phi, table.g_lo, table.g_hi)
+    tg = (g - table.g0) * table.inv_dg
+    n_g = table.values.shape[1]
+    j1 = xp.clip(xp.floor(tg).astype("int32"), 1, n_g - 3)
+    s = tg - j1
+    cols = xp.stack([
+        cubic_lagrange_uniform(tu, xp.take(table.values, j1 + k, axis=1), xp)
+        for k in (-1, 0, 1, 2)
+    ])
+    # Γ-axis combine through the same shared stencil: with 4 rows the
+    # base index clips to 1 and t = s + 1 recovers offsets (-1, 0, 1, 2).
+    P = cubic_lagrange_uniform(s + 1.0, cols, xp)
+    return xp.clip(P, 0.0, 1.0)
+
+
 def eval_P_table(v_w, table: PTable, xp):
     """P(v_w) by cubic Lagrange interpolation on the 1/v grid, in-jit.
 

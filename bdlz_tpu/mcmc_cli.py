@@ -92,9 +92,11 @@ def main(argv=None) -> None:
     static = static_choices_from_config(cfg)
     params = dict(parse_param(s) for s in args.param)
 
-    if not args.lz_profile and (args.lz_method != "local" or args.lz_table_n):
+    if not args.lz_profile and (args.lz_method != "local" or args.lz_table_n
+                                or "lz_gamma_phi" in params):
         raise SystemExit(
-            "--lz-method/--lz-table-n have no effect without --lz-profile"
+            "--lz-method/--lz-table-n/lz_gamma_phi sampling have no effect "
+            "without --lz-profile"
         )
     lz_kwargs = {}
     _profile_fp = None
@@ -110,6 +112,24 @@ def main(argv=None) -> None:
 
         profile = load_profile_csv(args.lz_profile)
         _profile_fp = profile_fingerprint(profile)
+        gamma_sampled = "lz_gamma_phi" in params
+        if gamma_sampled:
+            # the decoherence rate as a sampled parameter: P comes from a
+            # 2-D (v_w, gamma) table, so both axes must really be sampled
+            if args.lz_method != "dephased":
+                raise SystemExit(
+                    "sampling lz_gamma_phi requires --lz-method dephased"
+                )
+            if args.lz_gamma_phi:
+                raise SystemExit(
+                    "--lz-gamma-phi pins the rate; drop the flag to sample "
+                    "lz_gamma_phi"
+                )
+            if "v_w" not in params:
+                raise SystemExit(
+                    "sampling lz_gamma_phi requires sampling v_w too (the "
+                    "P table is 2-D in (v_w, gamma))"
+                )
         if args.lz_method == "local-momentum":
             # P then depends on the thermal state too — whether v_w is
             # sampled (1-D table at pinned T_p/m_chi) or pinned (single
@@ -155,6 +175,17 @@ def main(argv=None) -> None:
             import dataclasses
 
             cfg = dataclasses.replace(cfg, P_chi_to_B=P_pin)
+        elif gamma_sampled:
+            from bdlz_tpu.lz.sweep_bridge import make_P_of_vw_gamma_table
+
+            v_lo, v_hi = params["v_w"]
+            g_lo, g_hi = params["lz_gamma_phi"]
+            ptab2 = make_P_of_vw_gamma_table(
+                profile, v_lo, v_hi, g_lo, g_hi,
+                n_v=args.lz_table_n, xp=jnp,
+            )
+            lz_kwargs["lz_P_table2d"] = ptab2
+            _table_n = list(ptab2.values.shape)
         else:
             from bdlz_tpu.lz.sweep_bridge import make_P_of_vw_table
 
@@ -213,8 +244,10 @@ def main(argv=None) -> None:
                             "table_n": _table_n,
                             # the dephasing rate changes every P — keyed
                             # only for the method that uses it so existing
-                            # checkpoint identities are untouched
-                            **({"gamma_phi": args.lz_gamma_phi}
+                            # checkpoint identities are untouched; when
+                            # sampled, the bounds already live in "params"
+                            **({"gamma_phi": ("sampled" if gamma_sampled
+                                              else args.lz_gamma_phi)}
                                if args.lz_method == "dephased" else {}),
                         }
                     }
@@ -269,7 +302,10 @@ def main(argv=None) -> None:
     if args.lz_profile:
         summary["lz"] = {"profile": args.lz_profile, "method": args.lz_method}
         if args.lz_method == "dephased":
-            summary["lz"]["gamma_phi"] = args.lz_gamma_phi
+            # a sampled rate must not be misreported as pinned-at-0
+            summary["lz"]["gamma_phi"] = (
+                "sampled" if gamma_sampled else args.lz_gamma_phi
+            )
     if args.out:
         np.savez(args.out, chain=full_chain, logp=full_logp,
                  param_names=list(params))

@@ -51,6 +51,7 @@ def make_pipeline_logprob(
     n_y: int = 2000,
     lz_lambda1: float | None = None,
     lz_P_table=None,
+    lz_P_table2d=None,
 ) -> Callable:
     """Build logp(θ) = Planck likelihood of the pipeline at θ.
 
@@ -73,13 +74,36 @@ def make_pipeline_logprob(
     profile by ``make_P_of_vw_table`` over the sampled v_w bounds) and
     every evaluation interpolates P(v_w) inside jit to the table's
     interpolation error.  Mutually exclusive with ``lz_lambda1``.
+
+    ``lz_P_table2d`` (a :class:`bdlz_tpu.lz.sweep_bridge.PTable2D`) makes
+    the DEPHASING RATE itself a sampled parameter: include the special
+    key ``"lz_gamma_phi"`` in ``param_keys`` (it is not a config field —
+    it feeds the P(v_w, Γ_φ) interpolation, not PointParams) and every
+    evaluation interpolates P at the walker's (v_w, Γ_φ), so the MCMC
+    constrains the decoherence of the distributed-LZ transport against
+    the Planck data.
     """
+    n_lz = sum(x is not None for x in (lz_lambda1, lz_P_table, lz_P_table2d))
+    if n_lz > 1:
+        raise ValueError(
+            "pass at most one of lz_lambda1 / lz_P_table / lz_P_table2d"
+        )
     for k in param_keys:
+        if k == "lz_gamma_phi":
+            if lz_P_table2d is None:
+                raise ValueError(
+                    "sampling 'lz_gamma_phi' requires lz_P_table2d "
+                    "(a P(v_w, gamma) table from make_P_of_vw_gamma_table)"
+                )
+            continue
         if k not in AXIS_MAP:
             raise ValueError(f"unknown parameter {k!r}; valid: {sorted(AXIS_MAP)}")
-    if lz_lambda1 is not None and lz_P_table is not None:
-        raise ValueError("pass at most one of lz_lambda1 / lz_P_table")
-    if (lz_lambda1 is not None or lz_P_table is not None) and "P_chi_to_B" in param_keys:
+    if lz_P_table2d is not None and "lz_gamma_phi" not in param_keys:
+        raise ValueError(
+            "lz_P_table2d is only for sampling 'lz_gamma_phi'; use the 1-D "
+            "lz_P_table when the rate is pinned"
+        )
+    if n_lz and "P_chi_to_B" in param_keys:
         raise ValueError(
             "P_chi_to_B cannot be sampled when the profile ties P to the "
             "wall speed; sample v_w instead"
@@ -95,6 +119,7 @@ def make_pipeline_logprob(
 
     def logp(theta):
         values = {}
+        gamma_phi = None
         lp = jnp.zeros(())
         for i, k in enumerate(param_keys):
             v = theta[i]
@@ -104,6 +129,9 @@ def make_pipeline_logprob(
                 lo, hi = bounds[k]
                 inside = jnp.logical_and(theta[i] >= lo, theta[i] <= hi)
                 lp = jnp.where(inside, lp, -jnp.inf)
+            if k == "lz_gamma_phi":
+                gamma_phi = v  # feeds the P table, not PointParams
+                continue
             if k == "m_B_GeV":
                 v = v * GEV_TO_KG  # PointParams stores the baryon mass in kg
             values[AXIS_MAP[k]] = v
@@ -115,6 +143,12 @@ def make_pipeline_logprob(
             from bdlz_tpu.lz.sweep_bridge import eval_P_table
 
             pp = pp._replace(P=eval_P_table(pp.v_w, lz_P_table, jnp))
+        elif lz_P_table2d is not None:
+            from bdlz_tpu.lz.sweep_bridge import eval_P_table_2d
+
+            pp = pp._replace(
+                P=eval_P_table_2d(pp.v_w, gamma_phi, lz_P_table2d, jnp)
+            )
         pp = PointParams(*(jnp.asarray(f) for f in pp))
         res = point_yields_fast(pp, static, table, jnp, n_y=n_y)
         ob, od = omegas_from_result(res)

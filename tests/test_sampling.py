@@ -546,6 +546,121 @@ class TestLZTableLikelihood:
         assert s["lz"]["method"] == "coherent"
         assert np.isfinite(s["map_logp"])
 
+    def test_gamma_table_2d_matches_host_kernel(self):
+        """P(v_w, Γ) bicubic interpolation vs the host dephased kernel."""
+        import jax
+        import jax.numpy as jnp
+
+        from bdlz_tpu.lz.kernel import dephased_probability
+        from bdlz_tpu.lz.sweep_bridge import (
+            eval_P_table_2d,
+            make_P_of_vw_gamma_table,
+        )
+
+        prof = self._profile()
+        tab = make_P_of_vw_gamma_table(
+            prof, 0.2, 0.9, 0.0, 1.0, n_v=512, n_g=33, xp=jnp
+        )
+        rng = np.random.default_rng(9)
+        vs = rng.uniform(0.2, 0.9, 12)
+        gs = rng.uniform(0.0, 1.0, 12)
+        got = np.asarray(jax.vmap(
+            lambda v, g: eval_P_table_2d(v, g, tab, jnp)
+        )(jnp.asarray(vs), jnp.asarray(gs)))
+        ref = np.array([
+            dephased_probability(prof, float(v), float(g))
+            for v, g in zip(vs, gs)
+        ])
+        assert np.abs(got - ref).max() < 1e-6
+
+    def test_sampled_gamma_matches_pinned_rate_table(self):
+        """logp sampling (v_w, lz_gamma_phi) with the 2-D table must match
+        logp with the 1-D dephased table pinned at that rate, up to the
+        tables' interpolation error."""
+        import jax.numpy as jnp
+
+        from bdlz_tpu.lz.sweep_bridge import (
+            make_P_of_vw_gamma_table,
+            make_P_of_vw_table,
+        )
+        from bdlz_tpu.ops.kjma_table import make_f_table
+
+        base = config_from_dict(dict(BENCH_OVER))
+        static = static_choices_from_config(base)
+        table = make_f_table(base.I_p, jnp, n=4096)
+        prof = self._profile()
+        # gamma node value -> the 2-D interpolation in gamma is exact there
+        gam = 0.25
+        tab2 = make_P_of_vw_gamma_table(
+            prof, 0.2, 0.9, 0.0, 1.0, n_v=1024, n_g=17, xp=jnp
+        )
+        tab1 = make_P_of_vw_table(
+            prof, "dephased", 0.2, 0.9, n=1024, gamma_phi=gam, xp=jnp
+        )
+        logp_2d = make_pipeline_logprob(
+            base, static, table, param_keys=("v_w", "lz_gamma_phi"),
+            n_y=2000, lz_P_table2d=tab2,
+        )
+        logp_1d = make_pipeline_logprob(
+            base, static, table, param_keys=("v_w",), n_y=2000,
+            lz_P_table=tab1,
+        )
+        for vw in (0.25, 0.5, 0.85):
+            got = float(logp_2d(jnp.array([vw, gam])))
+            want = float(logp_1d(jnp.array([vw])))
+            assert got == pytest.approx(want, rel=1e-6, abs=1e-6), vw
+
+    def test_gamma_table_conflicts(self):
+        import jax.numpy as jnp
+
+        from bdlz_tpu.lz.sweep_bridge import make_P_of_vw_gamma_table
+        from bdlz_tpu.ops.kjma_table import make_f_table
+
+        base = config_from_dict(dict(BENCH_OVER))
+        static = static_choices_from_config(base)
+        table = make_f_table(base.I_p, jnp, n=4096)
+        tab2 = make_P_of_vw_gamma_table(
+            self._profile(), 0.2, 0.9, 0.0, 1.0, n_v=64, n_g=9, xp=jnp
+        )
+        # gamma key without the 2-D table
+        with pytest.raises(ValueError, match="lz_P_table2d"):
+            make_pipeline_logprob(
+                base, static, table, param_keys=("v_w", "lz_gamma_phi"),
+            )
+        # 2-D table without the gamma key
+        with pytest.raises(ValueError, match="lz_gamma_phi"):
+            make_pipeline_logprob(
+                base, static, table, param_keys=("v_w",), lz_P_table2d=tab2,
+            )
+
+    def test_mcmc_cli_sampled_gamma_end_to_end(self, tmp_path, capsys):
+        """`--param lz_gamma_phi=... --lz-method dephased` runs end to end."""
+        import json as _json
+
+        from bdlz_tpu.mcmc_cli import main as mcmc_main
+
+        prof = self._profile()
+        csv = tmp_path / "profile.csv"
+        csv.write_text(
+            "xi,delta,m_mix\n"
+            + "\n".join(f"{x},{d},{m}" for x, d, m in
+                        zip(prof.xi, prof.delta, prof.mix))
+            + "\n"
+        )
+        cfg = tmp_path / "cfg.json"
+        cfg.write_text(_json.dumps(BENCH_OVER))
+        mcmc_main([
+            "--config", str(cfg), "--param", "v_w=0.2:0.9",
+            "--param", "lz_gamma_phi=0.0:1.0",
+            "--walkers", "16", "--steps", "6", "--burn", "2",
+            "--lz-profile", str(csv), "--lz-method", "dephased",
+            "--lz-table-n", "128",
+        ])
+        s = _json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert s["lz"]["method"] == "dephased"
+        assert "lz_gamma_phi" in s["posterior_mean"]
+        assert np.isfinite(s["map_logp"])
+
     def test_mcmc_cli_rejects_sampled_P_with_profile(self, tmp_path):
         import json as _json
 
