@@ -594,6 +594,7 @@ def pallas_preflight(
     fuse_exp: bool = False,
     tol: float = 1e-6,
     table_n: int = 16384,
+    reduce: bool = REDUCE_DEFAULT,
 ):
     """Compile-and-compare the kernel on a tiny chunk, on THIS platform.
 
@@ -649,7 +650,8 @@ def pallas_preflight(
         grid = jax.tree.map(jnp.asarray, grid)
         got = _np.asarray(
             integrate_YB_pallas(
-                grid, chi_stats, table, t4, n_y=n_y, fuse_exp=fuse_exp
+                grid, chi_stats, table, t4, n_y=n_y, fuse_exp=fuse_exp,
+                reduce=reduce,
             )
         )
         ref = _np.asarray(

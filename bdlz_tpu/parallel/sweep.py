@@ -307,6 +307,50 @@ def _clamp_chunk_to_memory(chunk_size: int, n_y: int, mesh, impl: str) -> int:
     return chunk_size
 
 
+def resolve_pallas_tier(
+    chi_stats: str,
+    n_y: int,
+    fuse_exp: bool = False,
+    table_nodes: int = 16384,
+    reduce: "bool | None" = None,
+):
+    """Pick the pallas kernel tier that works on THIS platform.
+
+    Preflights the requested (or default) kernel and, when the request
+    was the default, degrades from the in-kernel Kahan reduction to the
+    streaming kernel — the reduction's scratch/accumulation lowering is
+    the newest Mosaic surface, and a regression there should cost the 4x
+    writeback win, not the whole MXU path.  Lives in the shared engine
+    layer so the bench and the production sweep degrade IDENTICALLY (and
+    the chosen tier can feed the sweep's resume identity).
+
+    Returns ``(tier, message)``: ``tier`` is the reduce flag to run with,
+    or ``None`` if no tier preflights clean; ``message`` concatenates the
+    per-tier preflight reports (``None`` on CPU, where the real kernel
+    cannot compile and interpret mode needs no preflight).
+    """
+    import jax
+
+    from bdlz_tpu.ops.kjma_pallas import REDUCE_DEFAULT, pallas_preflight
+
+    requested = REDUCE_DEFAULT if reduce is None else bool(reduce)
+    if jax.devices()[0].platform == "cpu":
+        return requested, None
+    tiers = [requested]
+    if reduce is None and requested:
+        tiers.append(False)
+    msgs = []
+    for red in tiers:
+        ok, _, detail = pallas_preflight(
+            chi_stats=chi_stats, n_y=n_y, fuse_exp=fuse_exp,
+            table_n=table_nodes, reduce=red,
+        )
+        msgs.append(f"{'PASS' if ok else 'FAIL'} [reduce={red}]: {detail}")
+        if ok:
+            return red, "; ".join(msgs)
+    return None, "; ".join(msgs)
+
+
 def make_chunk_runner(
     pp_all: PointParams,
     chunk: int,
@@ -508,6 +552,7 @@ def run_sweep(
     from bdlz_tpu.parallel.multihost import broadcast_from_coordinator as _bcast
 
     chunk_size = int(np.asarray(_bcast(np.array([chunk_size])))[0])
+    pallas_reduce: "bool | None" = None  # resolved tier (None = kernel default)
     if impl in ("direct", "esdirk"):
         aux = make_kjma_grid(jnp)
     else:
@@ -516,33 +561,29 @@ def run_sweep(
             from bdlz_tpu.ops.kjma_pallas import build_shifted_table
 
             if not interpret and jax.devices()[0].platform != "cpu":
-                # Hardware preflight: compile-and-compare the real kernel
-                # on a tiny chunk before committing the whole sweep to it
-                # — Mosaic lowering regressions are platform-specific and
-                # invisible to the CPU interpret-mode tests, so they must
-                # fail loudly here, not silently corrupt a long run.
-                from bdlz_tpu.ops.kjma_pallas import pallas_preflight
-
-                # preflight at the sweep's OWN shapes — lowering failures
-                # are shape-dependent (the r2 RecursionError needed
-                # n_y=8000's column count to fire)
-                ok, _, detail = pallas_preflight(
-                    chi_stats=static.chi_stats, n_y=n_y,
-                    fuse_exp=fuse_exp, table_n=table_nodes,
+                # Hardware preflight at the sweep's OWN shapes (lowering
+                # failures are shape-dependent — the r2 RecursionError
+                # needed n_y=8000's column count to fire), through the
+                # shared tier resolver so the sweep degrades reduce ->
+                # streaming exactly like the bench.
+                tier, msg = resolve_pallas_tier(
+                    static.chi_stats, n_y, fuse_exp=fuse_exp,
+                    table_nodes=table_nodes,
                 )
-                print(f"[sweep] pallas preflight {'PASS' if ok else 'FAIL'}: "
-                      f"{detail}", file=sys.stderr)
-                if not ok:
+                print(f"[sweep] pallas preflight {msg}", file=sys.stderr)
+                if tier is None:
                     raise RuntimeError(
-                        f"pallas preflight failed on this platform: {detail}; "
-                        "rerun with impl='tabulated' or fix the kernel"
+                        f"no pallas kernel tier preflights clean on this "
+                        f"platform ({msg}); rerun with impl='tabulated' or "
+                        "fix the kernel"
                     )
+                pallas_reduce = tier
             aux = (table, build_shifted_table(table))
         else:
             aux = table
     step = make_sweep_step(
         static, mesh=mesh, n_y=n_y, use_table=use_table, impl=impl,
-        interpret=interpret, fuse_exp=fuse_exp,
+        interpret=interpret, fuse_exp=fuse_exp, reduce=pallas_reduce,
     )
 
     from bdlz_tpu.parallel.multihost import (
@@ -561,14 +602,16 @@ def run_sweep(
         # level join the identity (same reasoning as ode_method/rtol/atol
         # for the stiff engine): a resumed directory must not splice
         # chunks from different summation/exp algorithms.  "reduce"
-        # records the kernel's actual accumulation default — referencing
-        # the constant (not a literal) so flipping it invalidates
-        # existing pallas directories.
+        # records the tier this sweep actually runs with — the resolved
+        # preflight tier on hardware, the kernel default otherwise.
         from bdlz_tpu.ops.kjma_pallas import REDUCE_DEFAULT
 
         hash_extra = dict(hash_extra or {})
         hash_extra["pallas"] = {
-            "fuse_exp": bool(fuse_exp), "reduce": bool(REDUCE_DEFAULT),
+            "fuse_exp": bool(fuse_exp),
+            "reduce": bool(
+                REDUCE_DEFAULT if pallas_reduce is None else pallas_reduce
+            ),
         }
     h = grid_hash(base, axes, n_y, impl, extra=hash_extra)
     if out_dir is not None:

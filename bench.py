@@ -122,7 +122,7 @@ def main() -> None:
     sharding = batch_sharding(mesh)
     table = make_f_table(base.I_p, jnp)
 
-    def make_run_chunk(impl: str):
+    def make_run_chunk(impl: str, reduce=None):
         # shared engine-runner (pallas aux pairing, interpret-on-CPU,
         # memory clamp, pad + shard + evaluate) —
         # bdlz_tpu.parallel.sweep.make_chunk_runner, also used by
@@ -133,7 +133,7 @@ def main() -> None:
         fuse = os.environ.get("BDLZ_BENCH_FUSE_EXP", "0") == "1"
         run_chunk, chunk = make_chunk_runner(
             pp_all, chunk, static, mesh, sharding, table,
-            impl=impl, n_y=n_y, fuse_exp=fuse,
+            impl=impl, n_y=n_y, fuse_exp=fuse, reduce=reduce,
         )
         return run_chunk
 
@@ -182,29 +182,36 @@ def main() -> None:
     impl = os.environ.get("BDLZ_BENCH_IMPL", default_impl)
     run_chunk = None
     preflight = None
+    pallas_reduce = None  # the tier actually benched (for the JSON)
     if impl == "pallas":
+        # Tier selection through the SHARED resolver
+        # (bdlz_tpu.parallel.sweep.resolve_pallas_tier): the reduction
+        # kernel degrades to the streaming kernel exactly like the
+        # production sweep would, so the bench cannot report a pallas
+        # number the sweep engine wouldn't reproduce.
         try:
-            if jax.devices()[0].platform != "cpu":
-                # Hardware preflight: compile-and-compare the real kernel
-                # on a tiny chunk FIRST, so a Mosaic lowering regression
-                # fails loudly here instead of surfacing as a silent
-                # engine downgrade after the full-bench warm-up.
-                from bdlz_tpu.ops.kjma_pallas import pallas_preflight
+            from bdlz_tpu.parallel.sweep import resolve_pallas_tier
 
-                fuse = os.environ.get("BDLZ_BENCH_FUSE_EXP", "0") == "1"
-                # at the bench's own n_y — lowering failures are
-                # shape-dependent (the r2 RecursionError needed n_y=8000)
-                ok, _, detail = pallas_preflight(n_y=n_y, fuse_exp=fuse)
-                preflight = f"{'PASS' if ok else 'FAIL'}: {detail}"
+            fuse = os.environ.get("BDLZ_BENCH_FUSE_EXP", "0") == "1"
+            # at the bench's own n_y — lowering failures are
+            # shape-dependent (the r2 RecursionError needed n_y=8000)
+            tier, preflight = resolve_pallas_tier(
+                static.chi_stats, n_y, fuse_exp=fuse
+            )
+            if preflight is not None:
                 print(f"[bench] pallas preflight {preflight}", file=sys.stderr)
-                if not ok:
-                    raise RuntimeError(f"preflight {preflight}")
-            run_chunk = make_run_chunk("pallas")
+            if tier is None:
+                raise RuntimeError(f"preflight {preflight}")
+            run_chunk = make_run_chunk("pallas", reduce=tier)
             max_rel = accuracy_gate(run_chunk)
             if max_rel > 1e-6:
-                raise RuntimeError(f"pallas path rel err {max_rel:.3e} > 1e-6")
+                raise RuntimeError(
+                    f"pallas(reduce={tier}) rel err {max_rel:.3e} > 1e-6"
+                )
+            pallas_reduce = tier
         except Exception as exc:  # noqa: BLE001 — any failure → safe path
-            print(f"[bench] pallas path unavailable ({exc}); falling back", file=sys.stderr)
+            print(f"[bench] pallas path unavailable ({exc}); falling back",
+                  file=sys.stderr)
             impl, run_chunk = "tabulated", None
     if run_chunk is None:
         run_chunk = make_run_chunk(impl)
@@ -293,6 +300,10 @@ def main() -> None:
                 "seconds": round(seconds, 3),
                 "rel_err_vs_reference": float(f"{max_rel:.3e}"),
                 "impl": impl,
+                # the summation tier actually benched (kernel-identity
+                # relevant: reduce/stream differ at ~1e-7); null off the
+                # pallas path
+                "pallas_reduce": pallas_reduce,
                 "pallas_preflight": preflight,
                 "platform": jax.devices()[0].platform,
                 "tpu_unavailable": tpu_unavailable,
