@@ -1,0 +1,123 @@
+#!/usr/bin/env python3
+"""y-grid convergence study: the truncation error behind n_y defaults.
+
+The reference hard-codes n_y = 8000 trapezoid nodes (max(n_y, 2000),
+`first_principles_yields.py:244`) with no recorded convergence evidence.
+This study evaluates Y_B for the benchmark point over a ladder of n_y,
+reports each level's relative distance to the finest level (Richardson-
+style self-convergence), and runs the LARGEST grid through the
+sp-sharded quadrature (`parallel/gridshard.py` — the intra-point
+"sequence-parallel" axis) so the giant-grid path is exercised the way a
+real convergence study would use it.
+
+Output: one JSON line per n_y plus a markdown table for
+docs/perf_notes.md.  Runs on whatever platform is alive (CPU fallback is
+fine — the truncation error is platform-independent at f64).
+
+Usage: python scripts/ny_convergence.py [--levels 2000,4000,8000,...]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument(
+        "--levels", default="2000,4000,8000,16000,32000,64000,128000",
+        help="Comma list of n_y trapezoid-node counts (ascending; the "
+             "finest is the self-convergence reference)",
+    )
+    ap.add_argument("--sp", type=int, default=2,
+                    help="sp mesh axis for the giant-grid (largest-level) "
+                         "sharded evaluation; 1 disables it")
+    args = ap.parse_args()
+
+    from bdlz_tpu.utils.platform import ensure_live_backend
+
+    ensure_live_backend("ny-convergence")
+
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    import jax.numpy as jnp
+    import numpy as np
+
+    from bdlz_tpu.config import (
+        config_from_dict,
+        point_params_from_config,
+        static_choices_from_config,
+    )
+    from bdlz_tpu.models.yields_pipeline import point_yields_fast
+    from bdlz_tpu.ops.kjma_table import make_f_table
+
+    levels = sorted(int(x) for x in args.levels.split(","))
+    base = config_from_dict(
+        {
+            "regime": "nonthermal",
+            "P_chi_to_B": 0.14925839040304145,
+            "source_shape_sigma_y": 9.0,
+            "incident_flux_scale": 1.07e-9,
+            "Y_chi_init": 4.90e-10,
+        }
+    )
+    static = static_choices_from_config(base)
+    table = make_f_table(base.I_p, jnp)
+    pp = point_params_from_config(base, base.P_chi_to_B)
+    pp_j = type(pp)(*(jnp.asarray(f) for f in pp))
+
+    Y = {}
+    for n_y in levels:
+        Y[n_y] = float(point_yields_fast(pp_j, static, table, jnp, n_y=n_y).Y_B)
+
+    finest = levels[-1]
+    rows = []
+    for n_y in levels:
+        rel = abs(Y[n_y] / Y[finest] - 1.0) if n_y != finest else 0.0
+        row = {"n_y": n_y, "Y_B": Y[n_y], "rel_vs_finest": float(f"{rel:.3e}")}
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+
+    # giant-grid evaluation through the sp-sharded quadrature: same
+    # finest-level integral, y-grid split across the mesh with one psum
+    if args.sp > 1:
+        from bdlz_tpu.parallel.gridshard import make_sp_quadrature
+        from bdlz_tpu.parallel.mesh import make_mesh
+
+        n_dev = len(jax.devices())
+        sp = args.sp if n_dev % args.sp == 0 else 1
+        if sp == 1:
+            print(
+                f"[ny-convergence] skipping gridshard row: {n_dev} device(s) "
+                f"not divisible by --sp {args.sp} (run with "
+                "XLA_FLAGS=--xla_force_host_platform_device_count=8 for the "
+                "virtual mesh)",
+                file=sys.stderr,
+            )
+        if sp > 1:
+            mesh = make_mesh(shape=(n_dev // sp, sp))
+            fn = make_sp_quadrature(static, mesh, n_y=finest)
+            Y_sp = float(fn(pp, table))
+            rel_sp = abs(Y_sp / Y[finest] - 1.0)
+            row = {
+                "n_y": finest, "engine": f"gridshard(sp={sp})",
+                "Y_B": Y_sp, "rel_vs_single_device": float(f"{rel_sp:.3e}"),
+            }
+            rows.append(row)
+            print(json.dumps(row), flush=True)
+
+    print("\n| n_y | Y_B | rel vs finest |")
+    print("|---|---|---|")
+    for r in rows:
+        tag = f"{r['n_y']}" + (f" ({r['engine']})" if "engine" in r else "")
+        rel = r.get("rel_vs_finest", r.get("rel_vs_single_device"))
+        print(f"| {tag} | {r['Y_B']:.12e} | {rel:.2e} |")
+
+
+if __name__ == "__main__":
+    main()

@@ -683,6 +683,43 @@ class TestLZTableLikelihood:
                 "--lz-profile", str(csv), "--lz-method", "coherent",
             ])
 
+    def test_mcmc_cli_gamma_sampling_validation(self, tmp_path):
+        """Sampled lz_gamma_phi: requires dephased, a sampled v_w, and no
+        pinned --lz-gamma-phi flag."""
+        import json as _json
+
+        from bdlz_tpu.mcmc_cli import main as mcmc_main
+
+        prof = self._profile()
+        csv = tmp_path / "profile.csv"
+        csv.write_text(
+            "xi,delta,m_mix\n"
+            + "\n".join(f"{x},{d},{m}" for x, d, m in
+                        zip(prof.xi, prof.delta, prof.mix))
+            + "\n"
+        )
+        cfg = tmp_path / "cfg.json"
+        cfg.write_text(_json.dumps(BENCH_OVER))
+        common = ["--config", str(cfg), "--walkers", "16", "--steps", "6",
+                  "--burn", "2", "--lz-profile", str(csv)]
+        with pytest.raises(SystemExit, match="dephased"):
+            mcmc_main(common + ["--param", "v_w=0.2:0.9",
+                                "--param", "lz_gamma_phi=0:1",
+                                "--lz-method", "coherent"])
+        with pytest.raises(SystemExit, match="drop the flag"):
+            mcmc_main(common + ["--param", "v_w=0.2:0.9",
+                                "--param", "lz_gamma_phi=0:1",
+                                "--lz-method", "dephased",
+                                "--lz-gamma-phi", "0.5"])
+        with pytest.raises(SystemExit, match="v_w"):
+            mcmc_main(common + ["--param", "lz_gamma_phi=0:1",
+                                "--lz-method", "dephased"])
+        with pytest.raises(SystemExit, match="lz-profile"):
+            mcmc_main(["--config", str(cfg), "--walkers", "16",
+                       "--steps", "6", "--burn", "2",
+                       "--param", "v_w=0.2:0.9",
+                       "--param", "lz_gamma_phi=0:1"])
+
     def test_mcmc_cli_pinned_vw_resolves_P_without_table(self, tmp_path, capsys):
         """Not sampling v_w with --lz-profile resolves P once host-side
         (no table build); the chain then samples other parameters."""
