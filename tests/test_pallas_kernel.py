@@ -156,6 +156,68 @@ def test_pallas_fused_exp_matches_tabulated(setup):
     assert rel.max() < 5e-7, rel.max()
 
 
+def test_pallas_parity_vs_numpy_reference_population(setup):
+    """Broad interpret-mode parity: 64 randomized configs spanning both
+    n_eq branches, clip edges, and the T = m/3 seam, against the
+    bit-reproducible NumPy reference path (not just the tabulated JAX
+    path) — the same population shape as scripts/accuracy_audit.py."""
+    from bdlz_tpu.models.yields_pipeline import point_yields
+    from bdlz_tpu.physics.percolation import make_kjma_grid
+
+    base, static, table, t4 = setup
+    rng = np.random.default_rng(11)
+    n = 64
+    m = 10 ** rng.uniform(-1.0, 1.0, n)
+    T_p = 10 ** rng.uniform(1.5, 2.5, n)
+    m[-8:] = 3.0 * T_p[-8:] * rng.uniform(0.8, 1.2, 8)   # seam inside window
+    m[-16:-8] = 10 ** rng.uniform(1.5, 3.0, 8)           # deep MB
+    grid = build_grid(
+        base,
+        {
+            "m_chi_GeV": m,
+            "T_p_GeV": T_p,
+            "source_shape_sigma_y": rng.uniform(2.0, 20.0, n),
+            "beta_over_H": rng.uniform(50.0, 500.0, n),
+            "v_w": rng.uniform(0.05, 0.95, n),
+            "P_chi_to_B": rng.uniform(0.01, 0.9, n),
+        },
+        product=False,
+    )
+    grid_j = jax.tree.map(jnp.asarray, grid)
+    got = np.asarray(integrate_YB_pallas(
+        grid_j, static.chi_stats, table, t4, n_y=8000, interpret=True
+    ))
+    grid_np = make_kjma_grid(np)
+    ref = np.array([
+        point_yields(
+            type(grid)(*(float(np.asarray(f)[i]) for f in grid)),
+            static, grid_np, np,
+        ).Y_B
+        for i in range(n)
+    ])
+    rel = np.abs(got / ref - 1.0)
+    assert rel.max() < 1e-6, rel.max()
+
+
+def test_scaling_linearity_in_P_and_flux(setup):
+    """Paper §8 physics contract: Y_B is exactly linear in P_chi_to_B and
+    in the incident flux scale on the quadrature path — the pallas kernel
+    must preserve the scaling bitwise-level (both enter one per-point
+    prefactor)."""
+    base, static, table, t4 = setup
+    grid1 = build_grid(base, {"m_chi_GeV": [0.5, 0.95, 2.0]})
+    g2 = grid1._replace(P=grid1.P * 2.0, flux_scale=grid1.flux_scale * 3.0)
+    y1 = np.asarray(integrate_YB_pallas(
+        jax.tree.map(jnp.asarray, grid1), static.chi_stats, table, t4,
+        n_y=2048, interpret=True,
+    ))
+    y2 = np.asarray(integrate_YB_pallas(
+        jax.tree.map(jnp.asarray, g2), static.chi_stats, table, t4,
+        n_y=2048, interpret=True,
+    ))
+    np.testing.assert_allclose(y2, 6.0 * y1, rtol=1e-12)
+
+
 def test_reduce_modes_agree(setup):
     """In-kernel Kahan reduction vs streaming the full integrand: same
     Y_B to ~f32-eps (the compensated sum reconstructs the f64 host sum),
