@@ -259,6 +259,49 @@ def test_resume_invalidated_by_engine_change(base_cfg, mesh8, tmp_path):
     assert r.resumed_chunks == 0
 
 
+def test_pallas_tier_resolver_degrades(monkeypatch):
+    """The shared tier ladder (reduce -> streaming) only runs on
+    accelerator platforms, so CI pins its logic with a faked platform and
+    preflight: default request degrades past a broken reduction kernel;
+    an explicit request never silently switches tiers."""
+    import jax
+
+    import bdlz_tpu.ops.kjma_pallas as kp
+    from bdlz_tpu.parallel.sweep import resolve_pallas_tier
+
+    class _Dev:
+        platform = "tpu"
+
+    monkeypatch.setattr(jax, "devices", lambda *a, **k: [_Dev()])
+    calls = []
+
+    def fake_preflight(chi_stats="fermion", n_points=128, n_y=2000,
+                       fuse_exp=False, tol=1e-6, table_n=16384,
+                       reduce=kp.REDUCE_DEFAULT):
+        calls.append(reduce)
+        ok = not reduce  # the reduction kernel "fails to lower"
+        return ok, (0.0 if ok else float("inf")), "fake"
+
+    monkeypatch.setattr(kp, "pallas_preflight", fake_preflight)
+
+    tier, msg = resolve_pallas_tier("fermion", 8000)
+    assert tier is False and calls == [True, False]
+    assert "FAIL [reduce=True]" in msg and "PASS [reduce=False]" in msg
+
+    # explicit tier request: no silent degrade to a different kernel
+    calls.clear()
+    tier2, msg2 = resolve_pallas_tier("fermion", 8000, reduce=True)
+    assert tier2 is None and calls == [True]
+
+    # both tiers broken -> None
+    monkeypatch.setattr(
+        kp, "pallas_preflight",
+        lambda **kw: (False, float("inf"), "dead"),
+    )
+    tier3, _ = resolve_pallas_tier("fermion", 8000)
+    assert tier3 is None
+
+
 def test_resume_invalidated_by_pallas_knob_change(base_cfg, mesh8, tmp_path):
     """Pallas kernel knobs (fuse_exp; the in-kernel reduce default) join
     the resume identity: results differ at ~1e-7 between kernel variants,
