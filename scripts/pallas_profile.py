@@ -1,0 +1,173 @@
+#!/usr/bin/env python3
+"""Attribute pallas-path runtime: kernel vs prep vs fallback, on hardware.
+
+Three timed stages on identical shapes (one chunk, production n_y):
+
+* ``kernel-only`` — the bare `pallas_call` on pre-staged device tiles
+  (realistic index/fraction distributions), both reduce tiers.  This is
+  the MXU one-hot interpolation in isolation: its throughput bounds what
+  any prep optimization could unlock.
+* ``end-to-end`` — `integrate_YB_pallas` on a real parameter chunk (the
+  f64 stream prep + kernel + f64 trapezoid reconstruction).
+* ``tabulated`` — the pure-XLA gather path on the same chunk (the
+  engine the kernel exists to beat; ~90% gather per r2 measurements).
+
+``end-to-end − kernel-only`` ≈ the emulated-f64 prep + reduction cost:
+if that dominates, round-4 effort goes to double-float in-kernel prep;
+if kernel-only dominates, it goes to cutting the one-hot matmul work
+(e.g. dynamic row-slicing — nodes of one 128-lane column span only ~3-4
+table rows at production shapes).
+
+Usage: python scripts/pallas_profile.py [--points 8192] [--n-y 8000]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--points", type=int, default=8192)
+    ap.add_argument("--n-y", type=int, default=8000, dest="n_y")
+    ap.add_argument("--repeats", type=int, default=3)
+    args = ap.parse_args()
+
+    from bdlz_tpu.utils.platform import ensure_live_backend
+
+    ensure_live_backend("pallas-profile")
+
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    import jax.numpy as jnp
+    import numpy as np
+
+    from bdlz_tpu.config import config_from_dict, static_choices_from_config
+    from bdlz_tpu.models.yields_pipeline import point_yields_fast
+    from bdlz_tpu.ops.kjma_pallas import (
+        COL_BLOCK,
+        ROWS,
+        build_shifted_table,
+        integrate_YB_pallas,
+        interp_multiply,
+        interp_multiply_fused,
+        split_f64,
+    )
+    from bdlz_tpu.ops.kjma_table import make_f_table
+    from bdlz_tpu.parallel.sweep import build_grid
+
+    platform = jax.devices()[0].platform
+    interpret = platform == "cpu"
+    if interpret:
+        print("[profile] WARNING: CPU interpret mode — timings are NOT "
+              "hardware numbers", file=sys.stderr)
+
+    # same device-memory clamp as bench.py/impl_shootout — an OOM'd
+    # compile can destabilize the accelerator relay
+    from bdlz_tpu.parallel.sweep import _clamp_chunk_to_memory
+
+    P = _clamp_chunk_to_memory(int(args.points), int(args.n_y), None, "pallas")
+    if P != int(args.points):
+        print(f"[profile] --points clamped to {P}", file=sys.stderr)
+    n_y = int(args.n_y)
+    ncol = -(-n_y // (ROWS * COL_BLOCK)) * COL_BLOCK
+
+    base = config_from_dict(
+        {
+            "regime": "nonthermal",
+            "P_chi_to_B": 0.14925839040304145,
+            "source_shape_sigma_y": 9.0,
+            "incident_flux_scale": 1.07e-9,
+            "Y_chi_init": 4.90e-10,
+        }
+    )
+    static = static_choices_from_config(base)
+    table = make_f_table(base.I_p, jnp)
+    t4 = build_shifted_table(table)
+    rng = np.random.default_rng(0)
+    grid = build_grid(
+        base,
+        {
+            "m_chi_GeV": rng.uniform(0.1, 5.0, P),
+            "T_p_GeV": rng.uniform(30.0, 300.0, P),
+            "v_w": rng.uniform(0.05, 0.95, P),
+        },
+        product=False,
+    )
+    grid = jax.tree.map(jnp.asarray, grid)
+
+    def timed(fn, *xs):
+        # compile + warm-up, BLOCKED — async dispatch would otherwise let
+        # the warm-up tail bleed into the first measured repeat
+        jax.tree.map(
+            lambda a: a.block_until_ready() if hasattr(a, "block_until_ready") else a,
+            fn(*xs),
+        )
+        best = float("inf")
+        for _ in range(args.repeats):
+            t0 = time.time()
+            out = fn(*xs)
+            jax.tree.map(
+                lambda a: a.block_until_ready() if hasattr(a, "block_until_ready") else a,
+                out,
+            )
+            best = min(best, time.time() - t0)
+        return best
+
+    rows = []
+
+    def report(name, seconds):
+        row = {"stage": name, "seconds": round(seconds, 4),
+               "points_per_sec": round(P / seconds, 1), "platform": platform}
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+
+    # --- kernel-only on pre-staged tiles (realistic distributions) ---
+    n_tab = int(np.asarray(table.values).shape[0])
+    ghat = jnp.asarray(
+        rng.uniform(0.0, 1.0, (P, ncol, ROWS)).astype(np.float32)
+    )
+    i1 = jnp.asarray(
+        rng.integers(1, n_tab - 3, (P, ncol, ROWS)).astype(np.int32)
+    )
+    sfrac = jnp.asarray(rng.uniform(0.0, 1.0, (P, ncol, ROWS)).astype(np.float32))
+    a = jnp.asarray(rng.uniform(-60.0, 0.0, (P, ncol, ROWS)))
+    a_hi, a_lo = split_f64(a)
+
+    kern_red = jax.jit(lambda g, i, s: interp_multiply(
+        g, i, s, t4, interpret=interpret, reduce=True))
+    report("kernel-only reduce", timed(kern_red, ghat, i1, sfrac))
+    kern_str = jax.jit(lambda g, i, s: interp_multiply(
+        g, i, s, t4, interpret=interpret, reduce=False))
+    report("kernel-only stream", timed(kern_str, ghat, i1, sfrac))
+    kern_fus = jax.jit(lambda g, ah, al, i, s: interp_multiply_fused(
+        g, ah, al, i, s, t4, interpret=interpret, reduce=True))
+    report("kernel-only fused+reduce",
+           timed(kern_fus, ghat, a_hi, a_lo, i1, sfrac))
+
+    # --- end-to-end pallas (prep + kernel + reconstruction) ---
+    for fuse in (False, True):
+        e2e = jax.jit(lambda g, f=fuse: integrate_YB_pallas(
+            g, static.chi_stats, table, t4, n_y=n_y,
+            interpret=interpret, fuse_exp=f, reduce=True))
+        report(f"end-to-end pallas fuse={fuse}", timed(e2e, grid))
+
+    # --- the XLA tabulated fallback on the same chunk ---
+    tab_fn = jax.jit(jax.vmap(
+        lambda p: point_yields_fast(p, static, table, jnp, n_y=n_y).Y_B))
+    report("tabulated (XLA gather)", timed(tab_fn, grid))
+
+    print("\n| stage | seconds | pts/s |")
+    print("|---|---|---|")
+    for r in rows:
+        print(f"| {r['stage']} | {r['seconds']} | {r['points_per_sec']} |")
+
+
+if __name__ == "__main__":
+    main()
