@@ -400,6 +400,20 @@ class TestLZProfileSweep:
         path.write_text("xi,delta,m_mix\n" + rows + "\n")
         return str(path)
 
+    @staticmethod
+    def _assert_pointwise_parity(res, base_cfg, static, v_ws, P_pts):
+        """Each sweep point equals a pointwise run at the profile-derived P."""
+        grid_np = make_kjma_grid(np)
+        pp_all = build_grid(base_cfg, {"v_w": v_ws})
+        for i in range(len(v_ws)):
+            pp_i = type(pp_all)(
+                *(np.asarray(f)[i] for f in pp_all)
+            )._replace(P=P_pts[i])
+            ref = point_yields(pp_i, static, grid_np, np)
+            assert res.outputs["DM_over_B"][i] == pytest.approx(
+                float(ref.DM_over_B), rel=1e-9
+            ), i
+
     def test_v_w_scan_uses_profile_P(self, base_cfg, mesh8, tmp_path):
         from bdlz_tpu.lz import load_profile_csv, probabilities_for_points
 
@@ -411,20 +425,9 @@ class TestLZProfileSweep:
             n_y=2000, lz_profile=prof_path,
         )
         assert res.n_failed == 0
-
-        # each point must equal a pointwise run with the profile-derived P
         prof = load_profile_csv(prof_path)
         P_pts = probabilities_for_points(prof, np.asarray(v_ws))
-        grid_np = make_kjma_grid(np)
-        pp_all = build_grid(base_cfg, {"v_w": v_ws})
-        for i in range(3):
-            pp_i = type(pp_all)(
-                *(np.asarray(f)[i] for f in pp_all)
-            )._replace(P=P_pts[i])
-            ref = point_yields(pp_i, static, grid_np, np)
-            assert res.outputs["DM_over_B"][i] == pytest.approx(
-                float(ref.DM_over_B), rel=1e-9
-            ), i
+        self._assert_pointwise_parity(res, base_cfg, static, v_ws, P_pts)
 
     def test_P_axis_conflict_rejected(self, base_cfg, mesh8, tmp_path):
         static = static_choices_from_config(base_cfg)
@@ -433,6 +436,41 @@ class TestLZProfileSweep:
                 base_cfg, {"P_chi_to_B": [0.1, 0.2]}, static, mesh=mesh8,
                 lz_profile=self._profile(tmp_path),
             )
+
+    def test_dephased_sweep_and_gamma_identity(self, base_cfg, mesh8, tmp_path):
+        """A dephased v_w scan derives each point's P from the Bloch
+        transport at the sweep's Γ_φ, and a changed rate invalidates
+        resume (different Γ are different sweeps)."""
+        from bdlz_tpu.lz import load_profile_csv, probabilities_for_points
+
+        prof_path = self._profile(tmp_path)
+        static = static_choices_from_config(base_cfg)
+        v_ws = [0.2, 0.5]
+        out = str(tmp_path / "sweep")
+        res = run_sweep(
+            base_cfg, {"v_w": v_ws}, static, mesh=mesh8, chunk_size=2,
+            n_y=2000, out_dir=out, lz_profile=prof_path,
+            lz_method="dephased", lz_gamma_phi=0.3,
+        )
+        assert res.n_failed == 0
+        prof = load_profile_csv(prof_path)
+        P_pts = probabilities_for_points(
+            prof, np.asarray(v_ws), method="dephased", gamma_phi=0.3
+        )
+        self._assert_pointwise_parity(res, base_cfg, static, v_ws, P_pts)
+        # same gamma resumes; different gamma recomputes
+        r_same = run_sweep(
+            base_cfg, {"v_w": v_ws}, static, mesh=mesh8, chunk_size=2,
+            n_y=2000, out_dir=out, lz_profile=prof_path,
+            lz_method="dephased", lz_gamma_phi=0.3,
+        )
+        assert r_same.resumed_chunks == 1
+        r_other = run_sweep(
+            base_cfg, {"v_w": v_ws}, static, mesh=mesh8, chunk_size=2,
+            n_y=2000, out_dir=out, lz_profile=prof_path,
+            lz_method="dephased", lz_gamma_phi=0.6,
+        )
+        assert r_other.resumed_chunks == 0
 
     def test_changed_profile_invalidates_resume(self, base_cfg, mesh8, tmp_path):
         static = static_choices_from_config(base_cfg)
