@@ -610,6 +610,29 @@ class TestLZTableLikelihood:
             want = float(logp_1d(jnp.array([vw])))
             assert got == pytest.approx(want, rel=1e-6, abs=1e-6), vw
 
+    def test_gamma_table_2d_clamps_to_domain(self):
+        """Queries outside the (v, Γ) table domain clamp to the edges on
+        both axes, and every result stays a probability."""
+        import jax.numpy as jnp
+
+        from bdlz_tpu.lz.sweep_bridge import (
+            eval_P_table_2d,
+            make_P_of_vw_gamma_table,
+        )
+
+        tab = make_P_of_vw_gamma_table(
+            self._profile(), 0.3, 0.8, 0.1, 1.0, n_v=64, n_g=9, xp=jnp
+        )
+        corners = [(0.3, 0.1), (0.8, 0.1), (0.3, 1.0), (0.8, 1.0)]
+        outside = [(0.05, 0.0), (0.99, 0.0), (0.05, 5.0), (0.99, 5.0)]
+        for (vi, gi), (vo, go) in zip(corners, outside):
+            pin = float(eval_P_table_2d(
+                jnp.asarray(vi), jnp.asarray(gi), tab, jnp))
+            pout = float(eval_P_table_2d(
+                jnp.asarray(vo), jnp.asarray(go), tab, jnp))
+            assert pout == pytest.approx(pin, rel=1e-12), (vi, gi)
+            assert 0.0 <= pout <= 1.0
+
     def test_gamma_table_conflicts(self):
         import jax.numpy as jnp
 
