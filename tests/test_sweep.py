@@ -472,6 +472,18 @@ class TestLZProfileSweep:
         )
         assert r_other.resumed_chunks == 0
 
+    def test_gamma_with_wrong_method_rejected(self, base_cfg, mesh8, tmp_path):
+        """A dephasing rate the chosen estimator would silently ignore is
+        a caller error at the sweep level too."""
+        static = static_choices_from_config(base_cfg)
+        with pytest.raises(ValueError, match="no effect"):
+            run_sweep(
+                base_cfg, {"v_w": [0.2, 0.4]}, static, mesh=mesh8,
+                chunk_size=2, n_y=2000,
+                lz_profile=self._profile(tmp_path),
+                lz_method="coherent", lz_gamma_phi=0.5,
+            )
+
     def test_changed_profile_invalidates_resume(self, base_cfg, mesh8, tmp_path):
         static = static_choices_from_config(base_cfg)
         out = str(tmp_path / "sweep")
