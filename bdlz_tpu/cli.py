@@ -31,14 +31,33 @@ from bdlz_tpu.solvers.boltzmann import solve_scipy_radau
 from bdlz_tpu.utils.io import write_yields_out
 
 
-def resolve_P(cfg: Config, profile_csv: Optional[str], momentum_average: bool = False) -> float:
+def resolve_P(
+    cfg: Config,
+    profile_csv: Optional[str],
+    momentum_average: bool = False,
+    lz_method: str = "coherent",
+    lz_gamma_phi: float = 0.0,
+) -> float:
     """LZ-probability resolution order (reference `maybe_P`, :317-328).
 
     Profile CSV (through the framework's two-channel LZ kernel — the seam
     the reference only stubs via dynamic imports, :170-187) takes precedence
     over the config value; both absent is a hard error. Prints are part of
-    the CLI contract.
+    the CLI contract.  ``lz_method``/``lz_gamma_phi`` pick the estimator
+    (coherent | local | dephased — same family as the sweep/MCMC CLIs);
+    with ``momentum_average`` the chosen estimator is flux-averaged over
+    incident momenta.
     """
+    # caller-contract errors raise BEFORE the reference-style swallow-all:
+    # only the computation itself gets the warn-and-fall-back treatment
+    from bdlz_tpu.lz.kernel import validate_gamma_phi
+
+    if lz_method not in ("coherent", "local", "dephased"):
+        raise ValueError(
+            f"lz_method must be 'coherent', 'local', or 'dephased', "
+            f"got {lz_method!r}"
+        )
+    validate_gamma_phi(lz_gamma_phi, lz_method)
     P_used = cfg.P_chi_to_B
     if profile_csv:
         P_try, reason = None, None
@@ -47,13 +66,17 @@ def resolve_P(cfg: Config, profile_csv: Optional[str], momentum_average: bool = 
                 from bdlz_tpu.lz import momentum_averaged_probability
 
                 P_try, F_k = momentum_averaged_probability(
-                    profile_csv, cfg.v_w, cfg.T_p_GeV, cfg.m_chi_GeV
+                    profile_csv, cfg.v_w, cfg.T_p_GeV, cfg.m_chi_GeV,
+                    method=lz_method, gamma_phi=lz_gamma_phi,
                 )
                 print(f"[info] momentum-averaged LZ kernel: F_k = {F_k:.6g}")
             else:
                 from bdlz_tpu.lz import probability_from_profile
 
-                P_try = float(probability_from_profile(profile_csv, cfg.v_w))
+                P_try = float(probability_from_profile(
+                    profile_csv, cfg.v_w, method=lz_method,
+                    gamma_phi=lz_gamma_phi,
+                ))
             P_try = max(min(P_try, 1.0), 0.0)
         except Exception as exc:  # fall back to config, like the reference
             P_try, reason = None, f"{type(exc).__name__}: {exc}"
@@ -181,6 +204,16 @@ def main(argv: Optional[list] = None) -> None:
                          "thermal average of the LZ probability over incident "
                          "chi momenta at T_p (the paper's F(k) layer; "
                          "framework addition).")
+    ap.add_argument("--lz-method", default="coherent", dest="lz_method",
+                    choices=("coherent", "local", "dephased"),
+                    help="With --maybe-compute-P-from-profile: the LZ "
+                         "estimator (framework addition; same family as the "
+                         "sweep/MCMC CLIs). Default: coherent transfer "
+                         "matrix.")
+    ap.add_argument("--lz-gamma-phi", type=float, default=0.0,
+                    dest="lz_gamma_phi",
+                    help="Diabatic-basis dephasing rate for --lz-method "
+                         "dephased (framework addition).")
     ap.add_argument("--planck", action="store_true",
                     help="Print the Planck comparison block: settling factor "
                          "f_settle and effective probability P_eff (paper "
@@ -189,6 +222,14 @@ def main(argv: Optional[list] = None) -> None:
 
     if args.lz_momentum_average and not args.profile_csv:
         ap.error("--lz-momentum-average requires --maybe-compute-P-from-profile")
+    if (args.lz_method != "coherent" or args.lz_gamma_phi) and not args.profile_csv:
+        ap.error("--lz-method/--lz-gamma-phi require "
+                 "--maybe-compute-P-from-profile")
+    from bdlz_tpu.lz.kernel import gamma_phi_cli_error
+
+    _gerr = gamma_phi_cli_error(args.lz_method, args.lz_gamma_phi)
+    if _gerr:
+        ap.error(_gerr)
     if args.write_template:
         write_template(args.config or "yields_config.json")
         return
@@ -199,7 +240,10 @@ def main(argv: Optional[list] = None) -> None:
     cfg = load_config(args.config)
     backend = args.backend or cfg.backend
     cfg = validate(cfg, backend=backend)
-    P_used = resolve_P(cfg, args.profile_csv, momentum_average=args.lz_momentum_average)
+    P_used = resolve_P(
+        cfg, args.profile_csv, momentum_average=args.lz_momentum_average,
+        lz_method=args.lz_method, lz_gamma_phi=args.lz_gamma_phi,
+    )
 
     result = run_point(cfg, P_used, backend)
 

@@ -225,6 +225,19 @@ def propagate_bloch(a, b, dxi, v, gamma_phi, xp):
     return M_total @ r0
 
 
+def gamma_phi_cli_error(method: str, gamma_phi: float) -> "str | None":
+    """The CLIs' --lz-gamma-phi pairing rule as a message (None = valid).
+
+    One home for the rule shared by the main, sweep, and MCMC CLIs —
+    the flag-level mirror of :func:`validate_gamma_phi`.
+    """
+    if gamma_phi and method != "dephased":
+        return "--lz-gamma-phi requires --lz-method dephased"
+    if gamma_phi < 0.0:
+        return "--lz-gamma-phi must be >= 0"
+    return None
+
+
 def validate_gamma_phi(gamma_phi: float, method: str) -> None:
     """Host-boundary Γ_φ contract, shared by every (method, Γ) seam:
     negative rates are invalid, and a rate the method would silently

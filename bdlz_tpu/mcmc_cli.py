@@ -64,10 +64,11 @@ def main(argv=None) -> None:
                     help="Diabatic-basis dephasing rate for --lz-method "
                          "dephased (energy units of the profile's Delta)")
     args = ap.parse_args(argv)
-    if args.lz_gamma_phi and args.lz_method != "dephased":
-        raise SystemExit("--lz-gamma-phi requires --lz-method dephased")
-    if args.lz_gamma_phi < 0.0:
-        raise SystemExit("--lz-gamma-phi must be >= 0")
+    from bdlz_tpu.lz.kernel import gamma_phi_cli_error
+
+    _gerr = gamma_phi_cli_error(args.lz_method, args.lz_gamma_phi)
+    if _gerr:
+        raise SystemExit(_gerr)
     if not 0 <= args.burn < args.steps:
         raise SystemExit(
             f"--burn {args.burn} must satisfy 0 <= burn < --steps {args.steps}"

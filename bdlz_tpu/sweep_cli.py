@@ -87,10 +87,11 @@ def main(argv=None) -> None:
     args = ap.parse_args(argv)
     if args.fuse_exp and args.impl != "pallas":
         ap.error("--fuse-exp requires --impl pallas")
-    if args.lz_gamma_phi and args.lz_method != "dephased":
-        ap.error("--lz-gamma-phi requires --lz-method dephased")
-    if args.lz_gamma_phi < 0.0:
-        ap.error("--lz-gamma-phi must be >= 0")
+    from bdlz_tpu.lz.kernel import gamma_phi_cli_error
+
+    _gerr = gamma_phi_cli_error(args.lz_method, args.lz_gamma_phi)
+    if _gerr:
+        ap.error(_gerr)
 
     if args.multihost:
         from bdlz_tpu.parallel import init_multihost

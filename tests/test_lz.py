@@ -184,6 +184,36 @@ class TestSeamContract:
         with pytest.raises(ValueError, match="method"):
             probability_from_profile(path, 1.0, method="bogus")
 
+    def test_cli_seam_method_selection(self, tmp_path, benchmark_config_path,
+                                       capsys):
+        """The main CLI's estimator flags reach the kernel: dephased at
+        Γ = 0 equals the coherent default, and the flag pairing is
+        validated like the sweep/MCMC CLIs."""
+        from bdlz_tpu.cli import main as cli_main, resolve_P
+        from bdlz_tpu.config import load_config
+
+        prof = linear_profile(N=2001)
+        path = self._write_profile(tmp_path, prof)
+        cfg = load_config(benchmark_config_path)
+        P_coh = resolve_P(cfg, path)
+        P_dep0 = resolve_P(cfg, path, lz_method="dephased", lz_gamma_phi=0.0)
+        out = capsys.readouterr().out
+        # both resolutions must have come FROM THE PROFILE — a silent
+        # fall-back to cfg.P_chi_to_B would make the parity check vacuous
+        assert out.count("[info] Using P_chi_to_B from profile:") == 2
+        assert P_dep0 == pytest.approx(P_coh, rel=1e-9)
+        assert P_coh != cfg.P_chi_to_B
+        # caller-contract errors raise instead of warn-and-fall-back
+        with pytest.raises(ValueError, match="no effect"):
+            resolve_P(cfg, path, lz_method="coherent", lz_gamma_phi=0.5)
+        with pytest.raises(SystemExit):
+            cli_main(["--config", benchmark_config_path,
+                      "--lz-method", "dephased"])  # no profile
+        with pytest.raises(SystemExit):
+            cli_main(["--config", benchmark_config_path,
+                      "--maybe-compute-P-from-profile", path,
+                      "--lz-gamma-phi", "0.5"])  # gamma without dephased
+
 
 class TestMomentumAveraging:
     """Paper §10's F(k) layer: flux-weighted thermal average of the coherent
