@@ -231,10 +231,12 @@ def gamma_phi_cli_error(method: str, gamma_phi: float) -> "str | None":
     One home for the rule shared by the main, sweep, and MCMC CLIs —
     the flag-level mirror of :func:`validate_gamma_phi`.
     """
+    if gamma_phi < 0.0:
+        # Non-negativity first, matching validate_gamma_phi: a negative
+        # rate is wrong regardless of the method pairing.
+        return "--lz-gamma-phi must be >= 0"
     if gamma_phi and method != "dephased":
         return "--lz-gamma-phi requires --lz-method dephased"
-    if gamma_phi < 0.0:
-        return "--lz-gamma-phi must be >= 0"
     return None
 
 

@@ -155,6 +155,20 @@ class PTable(NamedTuple):
 #: local composition.  The dephased estimator inherits the coherent
 #: density: its oscillations damp with Γ but are fully present at Γ → 0.
 _TABLE_N_DEFAULT = {"coherent": 16384, "local-momentum": 1024, "dephased": 16384}
+_TABLE_NG_DEFAULT = 33
+
+
+def resolve_table2d_shape(n_v: int = 0, n_g: int = 0) -> "tuple[int, int]":
+    """The (n_v, n_g) a 2-D P(v_w, Γ_φ) table build will actually use.
+
+    Single source for the defaults so callers that announce the build
+    cost up front (mcmc_cli's startup banner) cannot drift from what
+    :func:`make_P_of_vw_gamma_table` then builds.
+    """
+    return (
+        int(n_v) or _TABLE_N_DEFAULT["dephased"],
+        int(n_g) or _TABLE_NG_DEFAULT,
+    )
 
 
 def make_P_of_vw_table(
@@ -273,8 +287,7 @@ def make_P_of_vw_gamma_table(
         raise ValueError(
             f"need 0 <= gamma_lo < gamma_hi, got [{gamma_lo}, {gamma_hi}]"
         )
-    n_v = int(n_v) or _TABLE_N_DEFAULT["dephased"]
-    n_g = int(n_g) or 33
+    n_v, n_g = resolve_table2d_shape(n_v, n_g)
     if n_v < 8 or n_g < 8:
         raise ValueError(f"table needs >= 8 nodes per axis, got {n_v}x{n_g}")
     us = np.linspace(1.0 / v_hi, 1.0 / v_lo, n_v)

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import sys
 
 import numpy as np
 
@@ -181,6 +182,20 @@ def main(argv=None) -> None:
 
             v_lo, v_hi = params["v_w"]
             g_lo, g_hi = params["lz_gamma_phi"]
+            # The default 16384x33 grid is ~540k full-profile Bloch
+            # transports — on the CPU-relay-fallback path this build can
+            # dominate startup, so say what is being paid for before
+            # going quiet (ADVICE r3).
+            from bdlz_tpu.lz.sweep_bridge import resolve_table2d_shape
+
+            _n_v, _n_g = resolve_table2d_shape(args.lz_table_n)
+            print(
+                f"[mcmc] building P(v_w, Gamma_phi) table: {_n_v} speeds "
+                f"x {_n_g} gammas = {_n_v * _n_g} profile transports; "
+                "shrink with --lz-table-n (the speed axis) if startup "
+                "cost matters",
+                file=sys.stderr,
+            )
             ptab2 = make_P_of_vw_gamma_table(
                 profile, v_lo, v_hi, g_lo, g_hi,
                 n_v=args.lz_table_n, xp=jnp,

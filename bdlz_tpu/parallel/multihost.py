@@ -160,6 +160,28 @@ def gather_to_host(tree):
     )
 
 
+def allreduce_min(arr):
+    """Elementwise min of a small host array across processes.
+
+    Identity in single-process runs.  Used for conservative capability
+    agreement: e.g. the pallas tier, where every host must have
+    preflighted a kernel tier clean before the fleet runs it — a
+    coordinator-wins broadcast could force a tier some host's own
+    preflight just proved fails there.
+    """
+    import numpy as np
+
+    import jax
+
+    if jax.process_count() == 1:
+        return np.asarray(arr)
+
+    from jax.experimental import multihost_utils
+
+    g = multihost_utils.process_allgather(np.asarray(arr))
+    return np.asarray(g).min(axis=0)
+
+
 def is_coordinator() -> bool:
     """True on the process that owns filesystem side effects (index 0)."""
     import jax

@@ -307,6 +307,16 @@ def _clamp_chunk_to_memory(chunk_size: int, n_y: int, mesh, impl: str) -> int:
     return chunk_size
 
 
+# Wire codes for the fleet tier agreement (allreduce_min over hosts):
+# ordered so that min() picks the most conservative outcome.  -2 = local
+# preflight failed entirely (fails the whole fleet together); -1 = no
+# hardware preflight (cpu/interpret: kernel default, fleet-uniform);
+# 0 = streaming tier; 1 = in-kernel Kahan reduction tier.
+_TIER_CODE = {None: -1, False: 0, True: 1}
+_TIER_FROM_CODE = {code: tier for tier, code in _TIER_CODE.items()}
+_TIER_FAILED = -2
+
+
 def resolve_pallas_tier(
     chi_stats: str,
     n_y: int,
@@ -560,24 +570,70 @@ def run_sweep(
         if impl == "pallas":
             from bdlz_tpu.ops.kjma_pallas import build_shifted_table
 
+            _tier_code = -1  # non-hardware: kernel default everywhere
+            _tier_msg = "no hardware preflight (cpu/interpret)"
             if not interpret and jax.devices()[0].platform != "cpu":
                 # Hardware preflight at the sweep's OWN shapes (lowering
                 # failures are shape-dependent — the r2 RecursionError
                 # needed n_y=8000's column count to fire), through the
                 # shared tier resolver so the sweep degrades reduce ->
                 # streaming exactly like the bench.
-                tier, msg = resolve_pallas_tier(
+                tier, _tier_msg = resolve_pallas_tier(
                     static.chi_stats, n_y, fuse_exp=fuse_exp,
                     table_nodes=table_nodes,
                 )
-                print(f"[sweep] pallas preflight {msg}", file=sys.stderr)
-                if tier is None:
-                    raise RuntimeError(
-                        f"no pallas kernel tier preflights clean on this "
-                        f"platform ({msg}); rerun with impl='tabulated' or "
-                        "fix the kernel"
-                    )
-                pallas_reduce = tier
+                print(f"[sweep] pallas preflight {_tier_msg}", file=sys.stderr)
+                _tier_code = (
+                    _TIER_FAILED if tier is None else _TIER_CODE[tier]
+                )
+            # The preflight outcome is per-process, but the tier keys both
+            # the compiled step and the grid hash — hosts landing on
+            # different tiers would corrupt the shared manifest/chunk
+            # directory.  A coordinator-wins broadcast could force a tier
+            # some host's own preflight just proved fails there, so agree
+            # on the MIN (most conservative) tier across hosts; a host
+            # whose preflight failed entirely (-2) fails the whole fleet
+            # together instead of deadlocking a later collective.
+            from bdlz_tpu.parallel.multihost import allreduce_min as _armin
+
+            _local_code = _tier_code
+            _tier_code = int(np.asarray(_armin(np.array([_tier_code])))[0])
+            if _tier_code == _TIER_FAILED:
+                raise RuntimeError(
+                    "no pallas kernel tier preflights clean on every host "
+                    f"(this host: {_tier_msg}); rerun with "
+                    "impl='tabulated' or fix the kernel"
+                )
+            pallas_reduce = _TIER_FROM_CODE[_tier_code]
+            _agreed_ok, _agreed_msg = 1, "validated by local resolution"
+            if _local_code > 0 and _tier_code != _local_code:
+                # Another host downgraded the fleet to a tier this host's
+                # resolver short-circuited past without preflighting —
+                # validate it here so a mid-sweep Mosaic failure cannot
+                # be the first time this host compiles the agreed kernel.
+                _agreed, _agreed_msg = resolve_pallas_tier(
+                    static.chi_stats, n_y, fuse_exp=fuse_exp,
+                    table_nodes=table_nodes, reduce=pallas_reduce,
+                )
+                _agreed_ok = 0 if _agreed is None else 1
+            # Second agreement round so a re-preflight failure raises on
+            # EVERY host instead of one host raising while the rest hang
+            # in the first chunk collective.
+            _agreed_ok = int(np.asarray(_armin(np.array([_agreed_ok])))[0])
+            if _agreed_ok == 0:
+                raise RuntimeError(
+                    f"fleet-agreed pallas tier reduce={pallas_reduce} "
+                    f"fails preflight on some host (this host: "
+                    f"{_agreed_msg}); rerun with impl='tabulated' or fix "
+                    "the kernel"
+                )
+            if _local_code != _tier_code:
+                print(
+                    f"[sweep] pallas fleet tier: reduce={pallas_reduce} "
+                    f"(local preflight resolved "
+                    f"{_TIER_FROM_CODE[_local_code]})",
+                    file=sys.stderr,
+                )
             aux = (table, build_shifted_table(table))
         else:
             aux = table

@@ -1,0 +1,79 @@
+#!/bin/bash
+# Relay-recovery evidence collector (VERDICT r3 "Next round" items 1-5).
+#
+# Waits for the axon TPU relay, then collects — phase by phase, each
+# stamped in evidence/stamps/ so a mid-collection relay death resumes at
+# the next incomplete phase on the next invocation:
+#
+#   1. pallas preflight, grown incrementally (2048 -> 8192; heavy first
+#      compiles have killed the relay before — docs/perf_notes.md
+#      "Memory limits")
+#   2. impl shootout: tabulated vs pallas variants incl. the fuse_exp
+#      A/B (VERDICT items 1 and 4)
+#   3. accuracy audit on the chip, 1024 configs (VERDICT item 2)
+#   4. pallas profile: kernel vs prep vs gather attribution (item 8)
+#   5. full bench.py — sweep + ESDIRK metrics on TPU (items 1 and 3);
+#      output preserved at evidence/BENCH_tpu.jsonl (one JSON doc per
+#      line — the ESDIRK metric line, then the main metric line)
+#
+# Logs to stdout (launcher redirects, e.g. >> /tmp/evidence.log).
+# Artifacts: /root/repo/evidence/ + ACCURACY_AUDIT.json
+set -u
+cd /root/repo
+mkdir -p evidence/stamps
+
+phase() {  # phase <name> <timeout-s> <cmd...>
+  local name="$1" tmo="$2"; shift 2
+  if [ -f "evidence/stamps/$name" ]; then
+    echo "=== phase $name: already done, skipping ==="
+    return 0
+  fi
+  echo "=== phase $name: start $(date -u +%H:%M:%S) ==="
+  if timeout "$tmo" "$@"; then
+    touch "evidence/stamps/$name"
+    echo "=== phase $name: OK $(date -u +%H:%M:%S) ==="
+    return 0
+  else
+    echo "=== phase $name: FAILED/TIMEOUT rc=$? $(date -u +%H:%M:%S) ==="
+    return 1
+  fi
+}
+
+wait_relay() {
+  python - <<'EOF'
+from bdlz_tpu.utils.platform import wait_for_relay
+import sys
+sys.exit(0 if wait_for_relay(max_wait_s=float(36000), poll_s=30.0) else 1)
+EOF
+}
+
+echo "=== collector started $(date -u) ==="
+for attempt in 1 2 3 4 5; do
+  echo "=== waiting for relay (attempt $attempt) ==="
+  wait_relay || { echo "RELAY NEVER RECOVERED"; exit 1; }
+  echo "=== relay alive $(date -u) ==="
+
+  phase preflight 1200 python - <<'EOF' || continue
+import time
+import jax
+jax.config.update("jax_enable_x64", True)
+from bdlz_tpu.ops.kjma_pallas import pallas_preflight
+for n_y, fuse in [(2048, False), (8192, False), (8192, True)]:
+    t0 = time.time()
+    ok, rel, detail = pallas_preflight(n_y=n_y, fuse_exp=fuse)
+    print(f"preflight n_y={n_y} fuse={fuse}: ok={ok} rel={rel} "
+          f"{detail} {time.time()-t0:.1f}s", flush=True)
+EOF
+
+  phase shootout 2400 python scripts/impl_shootout.py --points 16384 --n-y 8000 \
+      || continue
+  phase audit 3600 python scripts/accuracy_audit.py --points 1024 || continue
+  phase profile 1800 python scripts/pallas_profile.py --points 8192 || continue
+  phase bench 3600 bash -c \
+      'set -o pipefail; python bench.py | tee evidence/BENCH_tpu.jsonl' \
+      || continue
+  echo "=== ALL PHASES DONE $(date -u) ==="
+  exit 0
+done
+echo "=== collector exhausted attempts $(date -u) ==="
+exit 1

@@ -525,6 +525,17 @@ class TestDephased:
                 method="local", gamma_phi=0.5,
             )
 
+    def test_cli_error_checks_negativity_first(self):
+        # ADVICE r3: the negative-rate message must win regardless of the
+        # method pairing, matching validate_gamma_phi's check order.
+        from bdlz_tpu.lz.kernel import gamma_phi_cli_error
+
+        assert gamma_phi_cli_error("dephased", 0.5) is None
+        assert gamma_phi_cli_error("coherent", 0.0) is None
+        for method in ("coherent", "local", "momentum", "dephased"):
+            assert ">= 0" in gamma_phi_cli_error(method, -1.0)
+        assert "dephased" in gamma_phi_cli_error("coherent", 0.5)
+
     def test_seam_contract(self, tmp_path):
         """(csv, v_w) → P ∈ [0,1] through probability_from_profile."""
         prof = self._two_crossing_profile(N=2001)
