@@ -31,27 +31,82 @@ from bdlz_tpu.solvers.boltzmann import solve_scipy_radau
 from bdlz_tpu.utils.io import write_yields_out
 
 
+#: Module names the reference's dynamic-import hook probes, in order
+#: (`first_principles_yields.py:173`).
+_EXTERNAL_LZ_MODULES = (
+    "lambda_local_LZ_from_profile",
+    "extended_LZ_lambda",
+    "transport_from_profile",
+)
+
+
+def try_external_P_from_profile(
+    profile_csv_path: str, v_w: float
+) -> "tuple[Optional[float], Optional[str]]":
+    """The reference's external-module hook (reference :170-187).
+
+    Probes the three module names on sys.path in the reference's order;
+    the first that imports wins.  ``compute_prob_from_profile(csv, v_w)``
+    is preferred; else ``compute_lambda_eff_from_profile(csv)`` maps
+    through P = 1 − e^(−2πλ) with λ floored at 0; P clamps to [0, 1].
+    Every failure is swallowed (the reference's contract) → (None, None).
+    Returns ``(P, module_name)`` so the CLI can say which module ran.
+    """
+    import importlib
+    import math
+
+    try:
+        for modname in _EXTERNAL_LZ_MODULES:
+            try:
+                mod = importlib.import_module(modname)
+            except Exception:
+                continue
+            if hasattr(mod, "compute_prob_from_profile"):
+                P = float(mod.compute_prob_from_profile(profile_csv_path, v_w))
+            elif hasattr(mod, "compute_lambda_eff_from_profile"):
+                lam = float(mod.compute_lambda_eff_from_profile(profile_csv_path))
+                P = 1.0 - math.exp(-2.0 * math.pi * max(lam, 0.0))
+            else:
+                continue
+            return max(min(P, 1.0), 0.0), modname
+    except Exception:
+        pass
+    return None, None
+
+
 def resolve_P(
     cfg: Config,
     profile_csv: Optional[str],
     momentum_average: bool = False,
-    lz_method: str = "coherent",
+    lz_method: Optional[str] = None,
     lz_gamma_phi: float = 0.0,
 ) -> float:
     """LZ-probability resolution order (reference `maybe_P`, :317-328).
 
-    Profile CSV (through the framework's two-channel LZ kernel — the seam
-    the reference only stubs via dynamic imports, :170-187) takes precedence
-    over the config value; both absent is a hard error. Prints are part of
-    the CLI contract.  ``lz_method``/``lz_gamma_phi`` pick the estimator
-    (coherent | local | dephased — same family as the sweep/MCMC CLIs);
-    with ``momentum_average`` the chosen estimator is flux-averaged over
+    Profile CSV takes precedence over the config value; both absent is a
+    hard error. Prints are part of the CLI contract.
+
+    In a reference-shaped invocation (no estimator flags) the reference's
+    dynamic-import hook is honored FIRST, in its module order (:170-187):
+    a user with ``transport_from_profile`` et al. on sys.path gets
+    identical behavior.  Explicitly selecting an estimator
+    (``--lz-method``/``--lz-gamma-phi``/``--lz-momentum-average``) is the
+    documented divergence: it requests the in-repo two-channel LZ kernel
+    (the seam the reference only stubs), so the hook is skipped.
+    ``lz_method``/``lz_gamma_phi`` pick the estimator (coherent | local |
+    dephased — same family as the sweep/MCMC CLIs); with
+    ``momentum_average`` the chosen estimator is flux-averaged over
     incident momenta.
     """
     # caller-contract errors raise BEFORE the reference-style swallow-all:
     # only the computation itself gets the warn-and-fall-back treatment
     from bdlz_tpu.lz.kernel import validate_gamma_phi
 
+    # None = "no explicit --lz-method": the hook-eligibility sentinel —
+    # explicitly passing the default estimator still opts into the
+    # in-repo kernel, so eligibility cannot be inferred from the value
+    explicit_method = lz_method is not None
+    lz_method = lz_method or "coherent"
     if lz_method not in ("coherent", "local", "dephased"):
         raise ValueError(
             f"lz_method must be 'coherent', 'local', or 'dephased', "
@@ -60,6 +115,20 @@ def resolve_P(
     validate_gamma_phi(lz_gamma_phi, lz_method)
     P_used = cfg.P_chi_to_B
     if profile_csv:
+        reference_shaped = (
+            not momentum_average
+            and not explicit_method
+            and not lz_gamma_phi
+        )
+        if reference_shaped:
+            P_ext, ext_mod = try_external_P_from_profile(profile_csv, cfg.v_w)
+            if P_ext is not None:
+                print(
+                    f"[info] external LZ module {ext_mod!r} provided P "
+                    "(reference dynamic-import hook)"
+                )
+                print(f"[info] Using P_chi_to_B from profile: {P_ext:.6g}")
+                return float(P_ext)
         P_try, reason = None, None
         try:
             if momentum_average:
@@ -191,7 +260,13 @@ def main(argv: Optional[list] = None) -> None:
     )
     ap.add_argument("--config", required=False, help="Path to yields_config.json")
     ap.add_argument("--write-template", action="store_true",
-                    help="Write a template config and exit")
+                    help="Write a template config and exit (the reference's "
+                         "20-key artifact, byte-identical)")
+    ap.add_argument("--template-extensions", action="store_true",
+                    dest="template_extensions",
+                    help="With --write-template: include the framework "
+                         "extension keys (backend, n_y, ode_*, ...) in the "
+                         "template instead of the reference's 20 keys.")
     ap.add_argument("--maybe-compute-P-from-profile", dest="profile_csv", default=None,
                     help="Try to compute P_chi_to_B from the LZ kernel using this profile CSV.")
     ap.add_argument("--diagnostics", action="store_true",
@@ -204,12 +279,14 @@ def main(argv: Optional[list] = None) -> None:
                          "thermal average of the LZ probability over incident "
                          "chi momenta at T_p (the paper's F(k) layer; "
                          "framework addition).")
-    ap.add_argument("--lz-method", default="coherent", dest="lz_method",
+    ap.add_argument("--lz-method", default=None, dest="lz_method",
                     choices=("coherent", "local", "dephased"),
                     help="With --maybe-compute-P-from-profile: the LZ "
                          "estimator (framework addition; same family as the "
                          "sweep/MCMC CLIs). Default: coherent transfer "
-                         "matrix.")
+                         "matrix. Passing the flag (any value) opts into "
+                         "the in-repo kernel, skipping the reference's "
+                         "external-module hook.")
     ap.add_argument("--lz-gamma-phi", type=float, default=0.0,
                     dest="lz_gamma_phi",
                     help="Diabatic-basis dephasing rate for --lz-method "
@@ -222,16 +299,19 @@ def main(argv: Optional[list] = None) -> None:
 
     if args.lz_momentum_average and not args.profile_csv:
         ap.error("--lz-momentum-average requires --maybe-compute-P-from-profile")
-    if (args.lz_method != "coherent" or args.lz_gamma_phi) and not args.profile_csv:
+    if (args.lz_method is not None or args.lz_gamma_phi) and not args.profile_csv:
         ap.error("--lz-method/--lz-gamma-phi require "
                  "--maybe-compute-P-from-profile")
     from bdlz_tpu.lz.kernel import gamma_phi_cli_error
 
-    _gerr = gamma_phi_cli_error(args.lz_method, args.lz_gamma_phi)
+    _gerr = gamma_phi_cli_error(args.lz_method or "coherent", args.lz_gamma_phi)
     if _gerr:
         ap.error(_gerr)
     if args.write_template:
-        write_template(args.config or "yields_config.json")
+        write_template(
+            args.config or "yields_config.json",
+            include_extensions=args.template_extensions,
+        )
         return
     if not args.config:
         print("ERROR: --config is required (or use --write-template).")

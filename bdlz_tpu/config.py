@@ -136,10 +136,20 @@ def load_config(path: str) -> Config:
     return config_from_dict(raw)
 
 
-def write_template(path: str) -> None:
-    """Write the default config as a JSON template (reference :309-312)."""
+def write_template(path: str, include_extensions: bool = False) -> None:
+    """Write the default config as a JSON template (reference :309-312).
+
+    The default artifact is exactly the reference's 20-key template
+    (`json.dump(default_config(), indent=2)` over :291-301's dict, in
+    its declaration order — byte-identical, pinned by a test);
+    ``include_extensions=True`` appends the framework keys for users
+    opting into the TPU features.
+    """
+    cfg = default_config()
+    if not include_extensions:
+        cfg = {k: cfg[k] for k in REFERENCE_KEYS}
     with open(path, "w", encoding="utf-8") as f:
-        json.dump(default_config(), f, indent=2)
+        json.dump(cfg, f, indent=2)
     print(f"Wrote template config to {path}")
 
 

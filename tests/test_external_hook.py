@@ -1,0 +1,173 @@
+"""The reference's external-LZ-module hook, honored for real.
+
+The reference probes sys.path for ``lambda_local_LZ_from_profile``,
+``extended_LZ_lambda``, ``transport_from_profile`` (in that order) before
+giving up on a profile CSV (`first_principles_yields.py:170-187`).
+VERDICT r3 missing #1: a user with one of those modules on path must get
+identical behavior from this framework's CLI — these tests pin the hook's
+probe order, both entry-point shapes, the clamp, the swallow-all
+contract, and the documented divergence (explicit estimator flags request
+the in-repo kernel and skip the hook).
+"""
+from __future__ import annotations
+
+import sys
+import textwrap
+
+import pytest
+
+from bdlz_tpu.cli import resolve_P, try_external_P_from_profile
+from bdlz_tpu.config import config_from_dict
+
+MODNAMES = (
+    "lambda_local_LZ_from_profile",
+    "extended_LZ_lambda",
+    "transport_from_profile",
+)
+
+
+@pytest.fixture
+def modpath(tmp_path, monkeypatch):
+    """A temp dir on sys.path; drops any fake hook modules afterwards."""
+    monkeypatch.syspath_prepend(str(tmp_path))
+    yield tmp_path
+    for name in MODNAMES:
+        sys.modules.pop(name, None)
+
+
+def _write_module(dirpath, name, body):
+    (dirpath / f"{name}.py").write_text(textwrap.dedent(body))
+
+
+def _cfg(**over):
+    return config_from_dict({"P_chi_to_B": 0.149, **over})
+
+
+class TestHookUnit:
+    def test_prob_entry_point(self, modpath):
+        _write_module(modpath, "transport_from_profile", """
+            def compute_prob_from_profile(csv, v_w):
+                assert csv == "prof.csv"
+                return 0.25 + v_w
+        """)
+        P, mod = try_external_P_from_profile("prof.csv", 0.3)
+        assert P == pytest.approx(0.55)
+        assert mod == "transport_from_profile"
+
+    def test_prob_clamped_to_unit_interval(self, modpath):
+        _write_module(modpath, "transport_from_profile", """
+            def compute_prob_from_profile(csv, v_w):
+                return 7.5
+        """)
+        P, _ = try_external_P_from_profile("prof.csv", 0.3)
+        assert P == 1.0
+
+    def test_lambda_entry_point_maps_through_exponential(self, modpath):
+        # P = 1 - e^(-2*pi*lambda), lambda floored at 0 (reference :183)
+        import math
+
+        _write_module(modpath, "extended_LZ_lambda", """
+            def compute_lambda_eff_from_profile(csv):
+                return 0.05
+        """)
+        P, mod = try_external_P_from_profile("prof.csv", 0.3)
+        assert P == pytest.approx(1.0 - math.exp(-2.0 * math.pi * 0.05))
+        assert mod == "extended_LZ_lambda"
+
+        _write_module(modpath, "extended_LZ_lambda", """
+            def compute_lambda_eff_from_profile(csv):
+                return -3.0
+        """)
+        sys.modules.pop("extended_LZ_lambda")
+        P, _ = try_external_P_from_profile("prof.csv", 0.3)
+        assert P == 0.0  # floored lambda -> e^0
+
+    def test_probe_order_first_module_wins(self, modpath):
+        _write_module(modpath, "lambda_local_LZ_from_profile", """
+            def compute_prob_from_profile(csv, v_w):
+                return 0.111
+        """)
+        _write_module(modpath, "transport_from_profile", """
+            def compute_prob_from_profile(csv, v_w):
+                return 0.999
+        """)
+        P, mod = try_external_P_from_profile("prof.csv", 0.3)
+        assert P == pytest.approx(0.111)
+        assert mod == "lambda_local_LZ_from_profile"
+
+    def test_module_without_entry_points_is_skipped(self, modpath):
+        _write_module(modpath, "lambda_local_LZ_from_profile", """
+            SOMETHING_ELSE = 1
+        """)
+        _write_module(modpath, "transport_from_profile", """
+            def compute_prob_from_profile(csv, v_w):
+                return 0.42
+        """)
+        P, mod = try_external_P_from_profile("prof.csv", 0.3)
+        assert P == pytest.approx(0.42)
+        assert mod == "transport_from_profile"
+
+    def test_raising_module_swallowed_to_none(self, modpath):
+        _write_module(modpath, "transport_from_profile", """
+            def compute_prob_from_profile(csv, v_w):
+                raise RuntimeError("boom")
+        """)
+        assert try_external_P_from_profile("prof.csv", 0.3) == (None, None)
+
+    def test_absent_modules_give_none(self):
+        assert try_external_P_from_profile("prof.csv", 0.3) == (None, None)
+
+
+class TestResolvePIntegration:
+    def test_hook_wins_on_reference_shaped_invocation(self, modpath, capsys):
+        _write_module(modpath, "transport_from_profile", """
+            def compute_prob_from_profile(csv, v_w):
+                return 0.321
+        """)
+        P = resolve_P(_cfg(), "prof.csv")
+        out = capsys.readouterr().out
+        assert P == pytest.approx(0.321)
+        assert "Using P_chi_to_B from profile: 0.321" in out
+        assert "transport_from_profile" in out
+
+    def test_explicit_estimator_skips_hook(self, modpath, tmp_path, capsys):
+        # Documented divergence: --lz-method selects the in-repo kernel.
+        # The fake returns a sentinel rather than raising — a raise would
+        # be swallowed by the hook's swallow-all contract and the test
+        # could not detect a regression of the skip logic.
+        _write_module(modpath, "transport_from_profile", """
+            def compute_prob_from_profile(csv, v_w):
+                return 0.777
+        """)
+        import numpy as np
+
+        xi = np.linspace(-30.0, 30.0, 2001)
+        m1 = np.full_like(xi, 1.0)
+        m2 = 1.0 + 0.08 * np.tanh(xi / 4.0)
+        m12 = np.full_like(xi, 0.02)
+        csv = tmp_path / "prof.csv"
+        np.savetxt(csv, np.c_[xi, m1, m2, m12], delimiter=",",
+                   header="xi,m11,m22,m12", comments="")
+        for kwargs in (
+            {"lz_method": "dephased", "lz_gamma_phi": 0.1},
+            # explicitly passing the DEFAULT estimator opts out too
+            {"lz_method": "coherent"},
+        ):
+            P = resolve_P(_cfg(), str(csv), **kwargs)
+            assert 0.0 <= P <= 1.0
+            assert P != pytest.approx(0.777), kwargs
+            # and the in-repo kernel (not the config value) provided it
+            assert "Using P_chi_to_B from profile" in capsys.readouterr().out
+
+    def test_hook_failure_falls_through_to_kernel_then_config(
+        self, modpath, capsys
+    ):
+        _write_module(modpath, "transport_from_profile", """
+            def compute_prob_from_profile(csv, v_w):
+                raise RuntimeError("boom")
+        """)
+        # nonexistent CSV: hook swallows, in-repo kernel fails, config wins
+        P = resolve_P(_cfg(), "does_not_exist.csv")
+        out = capsys.readouterr().out
+        assert P == pytest.approx(0.149)
+        assert "falling back to config" in out
