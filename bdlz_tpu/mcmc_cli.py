@@ -38,6 +38,12 @@ def main(argv=None) -> None:
     ap.add_argument("--steps", type=int, default=500)
     ap.add_argument("--burn", type=int, default=100)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--multihost", action="store_true",
+                    help="Initialize jax.distributed from JAX_COORDINATOR_"
+                         "ADDRESS/JAX_NUM_PROCESSES/JAX_PROCESS_ID before "
+                         "building the mesh (run one identical invocation "
+                         "per host; chain/summary files are written by the "
+                         "coordinator)")
     ap.add_argument("--out", default=None, help="Write the chain to this .npz")
     ap.add_argument("--checkpoint-dir", default=None,
                     help="Flush chain segments here incrementally; an "
@@ -75,9 +81,18 @@ def main(argv=None) -> None:
             f"--burn {args.burn} must satisfy 0 <= burn < --steps {args.steps}"
         )
 
-    from bdlz_tpu.utils.platform import ensure_live_backend
+    if args.multihost:
+        # One identical invocation per host; the distributed runtime owns
+        # platform selection, and walkers shard across the global mesh.
+        from bdlz_tpu.parallel import init_multihost
 
-    ensure_live_backend("mcmc")
+        init_multihost()
+    else:
+        # A dead accelerator relay would hang the first backend touch
+        # forever; probe and pin CPU instead (never in multihost runs).
+        from bdlz_tpu.utils.platform import ensure_live_backend
+
+        ensure_live_backend("mcmc")
 
     import jax
 
@@ -278,8 +293,10 @@ def main(argv=None) -> None:
     else:
         run = run_ensemble(jax.random.PRNGKey(args.seed + 1), logp, init,
                            n_steps=args.steps, mesh=mesh)
-        full_chain = np.asarray(run.chain)
-        full_logp = np.asarray(run.logp_chain)
+        # global arrays in multi-process runs; identity single-process
+        from bdlz_tpu.parallel.multihost import gather_to_host
+
+        full_chain, full_logp = gather_to_host((run.chain, run.logp_chain))
         acceptance = float(run.acceptance)
 
     from bdlz_tpu.sampling.diagnostics import integrated_autocorr_time, split_rhat
@@ -322,11 +339,15 @@ def main(argv=None) -> None:
             summary["lz"]["gamma_phi"] = (
                 "sampled" if gamma_sampled else args.lz_gamma_phi
             )
+    from bdlz_tpu.parallel.multihost import is_coordinator
+
     if args.out:
-        np.savez(args.out, chain=full_chain, logp=full_logp,
-                 param_names=list(params))
+        if is_coordinator():
+            np.savez(args.out, chain=full_chain, logp=full_logp,
+                     param_names=list(params))
         summary["out"] = args.out
-    print(json.dumps(summary))
+    if is_coordinator():
+        print(json.dumps(summary))
 
 
 if __name__ == "__main__":

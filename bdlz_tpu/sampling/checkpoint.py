@@ -81,7 +81,10 @@ def run_ensemble_checkpointed(
     import jax
     import jax.numpy as jnp
 
+    from bdlz_tpu.parallel.multihost import gather_to_host, is_coordinator
     from bdlz_tpu.sampling.ensemble import EnsembleState, run_ensemble
+
+    coordinator = is_coordinator()
 
     init_walkers = np.asarray(init_walkers, dtype=np.float64)
     W, D = init_walkers.shape
@@ -94,45 +97,72 @@ def run_ensemble_checkpointed(
     os.makedirs(out_dir, exist_ok=True)
     manifest_path = os.path.join(out_dir, "manifest.json")
     h = _run_hash(init_walkers, seed, n_steps, checkpoint_every, a, thin, identity)
+
+    # Resume plan: the COORDINATOR reads the manifest, validates the
+    # longest loadable segment prefix, and broadcasts the count (same
+    # design as the sweep's broadcast chunk plan).  A non-coordinator
+    # probing the directory itself could race a coordinator still
+    # flushing the previous invocation's files, diverge on the plan, and
+    # deadlock the collectives below; after the broadcast the agreed
+    # prefix is complete on disk, because the coordinator wrote those
+    # files before entering this (ordering) collective.
     manifest = {}
-    if os.path.exists(manifest_path):
-        try:
-            with open(manifest_path) as f:
-                manifest = json.load(f)
-        except Exception:
-            manifest = {}
-        if manifest.get("hash") != h:
-            manifest = {}
+    resumed = 0
+    chain_parts, logp_parts = [], []
+    state = None
+    if coordinator:
+        if os.path.exists(manifest_path):
+            try:
+                with open(manifest_path) as f:
+                    manifest = json.load(f)
+            except Exception:
+                manifest = {}
+            if manifest.get("hash") != h:
+                manifest = {}
+        done = set(int(i) for i in manifest.get("done", []))
+        for k in range(n_segs):
+            if k not in done:
+                break
+            seg_file = os.path.join(out_dir, f"seg_{k:05d}.npz")
+            try:
+                # validation IS the load — one read per segment
+                with np.load(seg_file) as data:
+                    chain_parts.append(data["chain"])
+                    logp_parts.append(data["logp"])
+                    state = (data["walkers"], data["state_logp"],
+                             data["n_accept"].item())
+            except Exception as exc:
+                import sys
+
+                print(
+                    f"[mcmc] resume: segment {k} listed in manifest but "
+                    f"{seg_file} unreadable ({exc!r}); recomputing from here",
+                    file=sys.stderr,
+                )
+                chain_parts, logp_parts = chain_parts[:k], logp_parts[:k]
+                break
+            resumed += 1
+        if resumed == 0:
+            state = None
+        # drop stale done-entries past an unreadable segment
+        manifest["done"] = list(range(resumed))
+    from bdlz_tpu.parallel.multihost import broadcast_from_coordinator
+
+    resumed = int(np.asarray(broadcast_from_coordinator(np.array([resumed])))[0])
     manifest.setdefault("hash", h)
     manifest.setdefault("n_segments", n_segs)
     manifest.setdefault("done", [])
 
-    # longest prefix of loadable segments
-    chain_parts, logp_parts = [], []
-    state = None
-    resumed = 0
-    done = set(int(i) for i in manifest["done"])
-    for k in range(n_segs):
-        if k not in done:
-            break
-        seg_file = os.path.join(out_dir, f"seg_{k:05d}.npz")
-        try:
+    # non-coordinators load the agreed (coordinator-validated) prefix
+    # from the shared checkpoint directory
+    if not coordinator:
+        for k in range(resumed):
+            seg_file = os.path.join(out_dir, f"seg_{k:05d}.npz")
             with np.load(seg_file) as data:
                 chain_parts.append(data["chain"])
                 logp_parts.append(data["logp"])
                 state = (data["walkers"], data["state_logp"],
                          data["n_accept"].item())
-        except Exception as exc:
-            import sys
-
-            print(
-                f"[mcmc] resume: segment {k} listed in manifest but "
-                f"{seg_file} unreadable ({exc!r}); recomputing from here",
-                file=sys.stderr,
-            )
-            chain_parts, logp_parts = chain_parts[:k], logp_parts[:k]
-            break
-        resumed += 1
 
     base_key = jax.random.PRNGKey(seed)
 
@@ -161,21 +191,31 @@ def run_ensemble_checkpointed(
         logp0 = run.final.logp
         seg_accept = int(run.final.n_accept)
         n_accept += seg_accept
-        seg_chain = np.asarray(run.chain)
-        seg_logp = np.asarray(run.logp_chain)
+        # In multi-process runs the chain and sampler state are GLOBAL
+        # arrays (walkers sharded across the mesh) — a bare np.asarray
+        # raises there; gather_to_host replicates them on every host and
+        # is a zero-copy identity single-process (bitwise the old path).
+        seg_chain, seg_logp, host_walkers, host_logp0 = gather_to_host(
+            (run.chain, run.logp_chain, walkers, logp0)
+        )
         chain_parts.append(seg_chain)
         logp_parts.append(seg_logp)
 
-        seg_file = os.path.join(out_dir, f"seg_{k:05d}.npz")
-        np.savez(
-            seg_file,
-            chain=seg_chain, logp=seg_logp,
-            walkers=np.asarray(walkers), state_logp=np.asarray(logp0),
-            n_accept=np.int64(n_accept),
-        )
+        # Coordinator owns filesystem side effects (multihost contract,
+        # same as the sweep manifest); resume assumes the checkpoint dir
+        # is on a filesystem every process can read.
+        if coordinator:
+            seg_file = os.path.join(out_dir, f"seg_{k:05d}.npz")
+            np.savez(
+                seg_file,
+                chain=seg_chain, logp=seg_logp,
+                walkers=host_walkers, state_logp=host_logp0,
+                n_accept=np.int64(n_accept),
+            )
         manifest["done"] = sorted(set(int(i) for i in manifest["done"]) | {k})
-        with open(manifest_path, "w") as f:
-            json.dump(manifest, f)
+        if coordinator:
+            with open(manifest_path, "w") as f:
+                json.dump(manifest, f)
         if event_log is not None:
             event_log.emit(
                 "mcmc_segment_done", segment=k, steps=steps_k,

@@ -1,0 +1,75 @@
+"""Worker for the real 2-process jax.distributed MCMC test.
+
+Launched twice by ``tests/test_multihost.py::test_two_process_mcmc`` as
+``python _mp_mcmc_worker.py <port> <process_id> <out_dir>``.  Each process
+joins the distributed runtime (2 processes × 2 local CPU devices = 4
+global devices) and runs a checkpointed ensemble chain over the *global*
+mesh — exercising the multi-process branches the MCMC layer gained in r4:
+``gather_to_host`` on the per-segment chain/state (global arrays a bare
+``np.asarray`` would reject) and coordinator-only segment/manifest writes.
+A second, resumed invocation must reproduce the chain bitwise from the
+coordinator's files.
+"""
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+
+def main() -> None:
+    port, pid, out_dir = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 2)
+    jax.config.update("jax_enable_x64", True)
+
+    from bdlz_tpu.parallel.multihost import init_multihost
+
+    assert init_multihost(f"localhost:{port}", 2, pid) is True
+    assert jax.process_count() == 2, jax.process_count()
+    assert len(jax.devices()) == 4, jax.devices()
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from bdlz_tpu.parallel import make_mesh
+    from bdlz_tpu.sampling.checkpoint import run_ensemble_checkpointed
+
+    def logp(theta):  # (D,) -> scalar: correlated Gaussian, cheap but real
+        return -0.5 * (theta[0] ** 2 + 2.0 * (theta[1] - theta[0]) ** 2)
+
+    W, D = 16, 2
+    init = np.asarray(
+        jax.random.uniform(jax.random.PRNGKey(7), (W, D), minval=-1.0,
+                           maxval=1.0, dtype=jnp.float64)
+    )
+    mesh = make_mesh(shape=(4, 1))
+
+    run = run_ensemble_checkpointed(
+        seed=3, logp_fn=logp, init_walkers=init, n_steps=24,
+        out_dir=f"{out_dir}/chain", checkpoint_every=8, mesh=mesh,
+        identity={"toy": "gaussian-v1"},
+    )
+    assert run.segments == 3 and run.resumed_segments == 0
+    assert run.chain.shape == (24, W, D), run.chain.shape
+
+    # resume pass: every segment must load from the coordinator's files,
+    # on both processes, and reproduce the chain bitwise
+    run2 = run_ensemble_checkpointed(
+        seed=3, logp_fn=logp, init_walkers=init, n_steps=24,
+        out_dir=f"{out_dir}/chain", checkpoint_every=8, mesh=mesh,
+        identity={"toy": "gaussian-v1"},
+    )
+    assert run2.resumed_segments == 3, run2.resumed_segments
+    np.testing.assert_array_equal(run.chain, run2.chain)
+    np.testing.assert_array_equal(run.logp_chain, run2.logp_chain)
+
+    np.savez(f"{out_dir}/mcmc_p{pid}.npz", chain=run.chain,
+             logp=run.logp_chain)
+    print(f"worker {pid} OK")
+
+
+if __name__ == "__main__":
+    main()
