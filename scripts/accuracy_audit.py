@@ -55,13 +55,10 @@ def main() -> None:
     import numpy as np
 
     from bdlz_tpu.config import config_from_dict, static_choices_from_config
-    from bdlz_tpu.models.yields_pipeline import point_yields, point_yields_fast
+    from bdlz_tpu.models.yields_pipeline import point_yields_fast
     from bdlz_tpu.ops.kjma_table import eval_f_table, make_f_table
-    from bdlz_tpu.parallel.sweep import build_grid
-    from bdlz_tpu.physics.percolation import make_kjma_grid
 
     platform = jax.devices()[0].platform
-    rng = np.random.default_rng(args.seed)
     n = int(args.points)
 
     base = config_from_dict(
@@ -75,66 +72,22 @@ def main() -> None:
     )
     static = static_choices_from_config(base)
 
-    # --- the randomized config population -------------------------------
-    # 60% broad random draws; 20% deep-MB (seam inside or below window);
-    # 10% windows shoved against the y-support clips; 10% near-seam
-    # (T = m/3 crossing the percolation temperature).
-    n_broad = int(0.6 * n)
-    n_mb = int(0.2 * n)
-    n_clip = int(0.1 * n)
-    n_seam = n - n_broad - n_mb - n_clip
+    # Shared population builder (bdlz_tpu.validation): the bench's
+    # on-hardware gate draws from the same design, so this artifact and
+    # the benched-engine gate cannot drift apart.
+    from bdlz_tpu.validation import build_audit_population, reference_ratios
 
-    m = np.concatenate([
-        10 ** rng.uniform(-1.0, 1.0, n_broad),            # 0.1..10 GeV
-        10 ** rng.uniform(1.5, 3.0, n_mb),                # 30..1000 GeV: MB
-        10 ** rng.uniform(-1.0, 1.0, n_clip),
-        np.full(n_seam, np.nan),                          # filled below
-    ])
-    T_p = np.concatenate([
-        10 ** rng.uniform(1.5, 2.5, n_broad),             # 30..300 GeV
-        10 ** rng.uniform(1.4, 1.7, n_mb),                # ~25..50 GeV
-        10 ** rng.uniform(1.5, 2.5, n_clip),
-        10 ** rng.uniform(1.5, 2.5, n_seam),
-    ])
-    # seam points: m = 3·T with T inside the quadrature window (the hard
-    # n_eq/vbar branch at T = m/3 lands mid-integration)
-    m[-n_seam:] = 3.0 * T_p[-n_seam:] * rng.uniform(0.8, 1.2, n_seam)
-
-    sigma_y = rng.uniform(2.0, 20.0, n)
-    beta = rng.uniform(50.0, 500.0, n)
-    v_w = rng.uniform(0.05, 0.95, n)
-    P = rng.uniform(0.01, 0.9, n)
-    T_min = np.full(n, base.T_min_over_Tp)
-    T_max = np.full(n, base.T_max_over_Tp)
-    # clip-edge population: push the window so y(T_lo/T_hi) crosses the
-    # support clips (y=+50 needs T ≪ T_p at big beta; y=−80 needs T > T_p)
-    T_min[n_broad + n_mb:n_broad + n_mb + n_clip] = 10 ** rng.uniform(
-        -4.0, -2.0, n_clip
-    )
-    T_max[n_broad + n_mb:n_broad + n_mb + n_clip] = rng.uniform(3.0, 8.0, n_clip)
-
-    grid = build_grid(
-        base,
-        {
-            "m_chi_GeV": m,
-            "T_p_GeV": T_p,
-            "source_shape_sigma_y": sigma_y,
-            "beta_over_H": beta,
-            "v_w": v_w,
-            "P_chi_to_B": P,
-            "T_min_over_Tp": T_min,
-            "T_max_over_Tp": T_max,
-        },
-        product=False,
-    )
+    pop = build_audit_population(base, n, seed=args.seed)
+    grid = pop.grid
+    m, T_p = pop.axes["m_chi_GeV"], pop.axes["T_p_GeV"]
+    sigma_y, beta = pop.axes["source_shape_sigma_y"], pop.axes["beta_over_H"]
+    T_min, T_max = pop.axes["T_min_over_Tp"], pop.axes["T_max_over_Tp"]
 
     # --- reference: the bit-reproducible NumPy path ---------------------
-    grid_np = make_kjma_grid(np)
     t0 = time.time()
-    ref = np.empty(n)
-    for i in range(n):
-        pp_i = type(grid)(*(float(np.asarray(f)[i]) for f in grid))
-        ref[i] = float(point_yields(pp_i, static, grid_np, np).DM_over_B)
+    # n_y aligned with the JAX leg: the artifact must measure backend
+    # error at equal discretization, not y-grid truncation
+    ref = reference_ratios(grid, static, n_y=args.n_y)
     t_ref = time.time() - t0
 
     # --- JAX path (tabulated engine, the bench's fallback/default) ------
@@ -164,10 +117,7 @@ def main() -> None:
         "p90_rel_err": pct(90),
         "median_rel_err": pct(50),
         "contract_1e-6_ok": bool(rel.max() <= 1e-6),
-        "population": {
-            "broad": n_broad, "deep_MB": n_mb,
-            "clip_edges": n_clip, "seam_T=m/3": n_seam,
-        },
+        "population": dict(pop.counts),
         "worst_points": [
             {
                 "rel_err": float(rel[i]),

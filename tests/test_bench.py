@@ -21,6 +21,9 @@ def test_bench_cpu_smoke():
         BDLZ_BENCH_POINTS="256",
         BDLZ_BENCH_CHUNK="256",
         BDLZ_BENCH_NY="2000",
+        # small audit-style gate population: the smoke test exercises the
+        # population gate's machinery, not its full 128-point cost
+        BDLZ_BENCH_GATE_POINTS="24",
         PYTHONPATH=REPO,
     )
     out = subprocess.run(
@@ -38,4 +41,5 @@ def test_bench_cpu_smoke():
     assert d["platform"] == "cpu"
     assert d["impl"] == "tabulated"  # pallas is TPU-only by default
     assert d["rel_err_vs_reference"] <= 1e-6
+    assert d["gate_points"] == 24  # the audit-style population ran
     assert np.isfinite(d["value"])
