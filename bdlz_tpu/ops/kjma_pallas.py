@@ -23,7 +23,8 @@ reformulates the lookup as dense MXU work:
   + reduction (again exact; plain VPU ops, no dynamic indexing for
   Mosaic to trip on);
 * the Pallas grid is 2-D ``(P, ncol/COL_BLOCK)`` — the batch axis times
-  column *blocks* of COL_BLOCK=8 sublane rows, so the kernel jaxpr is
+  column *blocks* of COL_BLOCK sublane rows (default 8, tunable via
+  BDLZ_PALLAS_COL_BLOCK at import), so the kernel jaxpr is
   O(1) in n_y.  (A first version statically unrolled a Python loop over
   all ~n_y/128 columns; the jaxpr grew linearly and blew Mosaic's
   recursive lowering with a RecursionError at n_y=8000 — the grid is
@@ -53,6 +54,7 @@ remains the bit-parity reference path.
 """
 from __future__ import annotations
 
+import os
 from typing import Any
 
 import jax
@@ -78,7 +80,17 @@ LANES = 128
 #: Lane columns (of 128 nodes each) handled per Pallas grid step.  Small
 #: static unroll: big enough to amortize per-step overhead, small enough
 #: that the kernel jaxpr stays tiny (the grid, not the unroll, walks n_y).
-COL_BLOCK = 8
+#: Tunable at import via BDLZ_PALLAS_COL_BLOCK (multiples of 8 — the f32
+#: sublane tile — so block shapes stay Mosaic-aligned): the hardware
+#: shootout sweeps it per-subprocess to find the grid-overhead sweet
+#: spot; a non-default value joins the sweep resume identity
+#: (`parallel/sweep.py`).
+COL_BLOCK = int(os.environ.get("BDLZ_PALLAS_COL_BLOCK", "8"))
+if COL_BLOCK < 8 or COL_BLOCK % 8:
+    raise ValueError(
+        f"BDLZ_PALLAS_COL_BLOCK must be a positive multiple of 8 (the f32 "
+        f"sublane tile), got {COL_BLOCK}"
+    )
 
 #: Default for the in-kernel Kahan reduction.  The sweep resume identity
 #: references THIS constant (`parallel/sweep.py`), so flipping it — e.g.

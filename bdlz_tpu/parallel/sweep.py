@@ -570,6 +570,22 @@ def run_sweep(
         if impl == "pallas":
             from bdlz_tpu.ops.kjma_pallas import build_shifted_table
 
+            # COL_BLOCK is import-time per-process (BDLZ_PALLAS_COL_BLOCK)
+            # and keys both the Kahan accumulation order and (when
+            # non-default) the grid hash — a per-host env divergence must
+            # fail the whole fleet, not splice mixed-block chunks.  One
+            # elementwise allreduce_min over [cb, -cb] yields [min, -max];
+            # min != max raises identically on every host.
+            from bdlz_tpu.ops.kjma_pallas import COL_BLOCK as _CB
+            from bdlz_tpu.parallel.multihost import allreduce_min as _armin
+
+            _cb_mm = np.asarray(_armin(np.array([_CB, -_CB], dtype=np.int64)))
+            if int(_cb_mm[0]) != int(-_cb_mm[1]):
+                raise RuntimeError(
+                    f"BDLZ_PALLAS_COL_BLOCK differs across hosts (min "
+                    f"{int(_cb_mm[0])}, max {int(-_cb_mm[1])}; this host "
+                    f"{_CB}); set one value fleet-wide"
+                )
             _tier_code = -1  # non-hardware: kernel default everywhere
             _tier_msg = "no hardware preflight (cpu/interpret)"
             if not interpret and jax.devices()[0].platform != "cpu":
@@ -594,8 +610,6 @@ def run_sweep(
             # on the MIN (most conservative) tier across hosts; a host
             # whose preflight failed entirely (-2) fails the whole fleet
             # together instead of deadlocking a later collective.
-            from bdlz_tpu.parallel.multihost import allreduce_min as _armin
-
             _local_code = _tier_code
             _tier_code = int(np.asarray(_armin(np.array([_tier_code])))[0])
             if _tier_code == _TIER_FAILED:
@@ -660,7 +674,7 @@ def run_sweep(
         # chunks from different summation/exp algorithms.  "reduce"
         # records the tier this sweep actually runs with — the resolved
         # preflight tier on hardware, the kernel default otherwise.
-        from bdlz_tpu.ops.kjma_pallas import REDUCE_DEFAULT
+        from bdlz_tpu.ops.kjma_pallas import COL_BLOCK, REDUCE_DEFAULT
 
         hash_extra = dict(hash_extra or {})
         hash_extra["pallas"] = {
@@ -668,6 +682,9 @@ def run_sweep(
             "reduce": bool(
                 REDUCE_DEFAULT if pallas_reduce is None else pallas_reduce
             ),
+            # omit-at-default so pre-r4 directories stay resumable; a
+            # non-default block changes Kahan accumulation order (~1e-13)
+            **({"col_block": COL_BLOCK} if COL_BLOCK != 8 else {}),
         }
     h = grid_hash(base, axes, n_y, impl, extra=hash_extra)
     if out_dir is not None:

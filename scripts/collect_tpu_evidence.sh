@@ -69,6 +69,18 @@ EOF
       || continue
   phase audit 3600 python scripts/accuracy_audit.py --points 1024 || continue
   phase profile 1800 python scripts/pallas_profile.py --points 8192 || continue
+  phase colblock 2400 bash -c '
+    any_ok=0
+    for cb in 8 16 32; do
+      echo "--- COL_BLOCK=$cb ---"
+      if BDLZ_PALLAS_COL_BLOCK=$cb timeout 700 python scripts/impl_shootout.py \
+          --points 8192 --n-y 8000 --engines pallas; then
+        any_ok=1
+      else
+        echo "COL_BLOCK=$cb: failed/timeout"
+      fi
+    done
+    [ "$any_ok" = 1 ]' || continue
   phase bench 3600 bash -c \
       'set -o pipefail; python bench.py | tee evidence/BENCH_tpu.jsonl' \
       || continue

@@ -260,3 +260,68 @@ def test_preflight_reports_failure_without_raising():
     assert isinstance(detail, str) and detail
     if not ok:  # the expected outcome on CPU
         assert rel == float("inf") or rel > 1e-6
+
+
+def test_col_block_env_override_parity(tmp_path):
+    """BDLZ_PALLAS_COL_BLOCK retunes the grid-step unroll at import (the
+    hardware shootout sweeps it per-subprocess); a non-default block must
+    preserve tabulated-path parity and reject misaligned values."""
+    import os
+    import subprocess
+    import sys
+
+    code = r"""
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+import jax.numpy as jnp
+import numpy as np
+from bdlz_tpu.config import config_from_dict, static_choices_from_config
+from bdlz_tpu.models.yields_pipeline import point_yields_fast
+from bdlz_tpu.ops import kjma_pallas as kp
+from bdlz_tpu.ops.kjma_table import make_f_table
+from bdlz_tpu.parallel.sweep import build_grid
+
+assert kp.COL_BLOCK == 16, kp.COL_BLOCK
+base = config_from_dict({
+    "regime": "nonthermal", "P_chi_to_B": 0.149,
+    "source_shape_sigma_y": 9.0, "incident_flux_scale": 1.07e-9,
+    "Y_chi_init": 4.90e-10,
+})
+static = static_choices_from_config(base)
+table = make_f_table(base.I_p, jnp, n=16384)
+t4 = kp.build_shifted_table(table)
+rng = np.random.default_rng(5)
+grid = build_grid(base, {
+    "m_chi_GeV": rng.uniform(0.3, 3.0, 4),
+    "T_p_GeV": rng.uniform(50.0, 200.0, 4),
+}, product=False)
+grid = jax.tree.map(jnp.asarray, grid)
+got = np.asarray(kp.integrate_YB_pallas(
+    grid, static.chi_stats, table, t4, n_y=2048, interpret=True))
+want = np.asarray(jax.vmap(
+    lambda p: point_yields_fast(p, static, table, jnp, n_y=2048).Y_B
+)(grid))
+np.testing.assert_allclose(got, want, rtol=3e-7)
+print("colblock16 OK")
+"""
+    import pathlib
+
+    repo = str(pathlib.Path(__file__).resolve().parents[1])
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("JAX_", "XLA_"))}
+    env.update(PYTHONPATH=repo, PALLAS_AXON_POOL_IPS="",
+               BDLZ_PALLAS_COL_BLOCK="16")
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, env=env, timeout=300)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "colblock16 OK" in r.stdout
+
+    # misaligned values are an import-time error, not a silent mis-tile
+    env["BDLZ_PALLAS_COL_BLOCK"] = "6"
+    r = subprocess.run(
+        [sys.executable, "-c", "import bdlz_tpu.ops.kjma_pallas"],
+        capture_output=True, text=True, env=env, timeout=120,
+    )
+    assert r.returncode != 0
+    assert "multiple of 8" in r.stderr
