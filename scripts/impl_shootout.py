@@ -79,6 +79,7 @@ def main() -> None:
     sharding = batch_sharding(mesh)
     table = make_f_table(base.I_p, jnp)
     grid_np = make_kjma_grid(np)
+    from bdlz_tpu.ops.kjma_pallas import COL_BLOCK as col_block
 
     # accuracy sample (shared across engines)
     rng = np.random.default_rng(0)
@@ -137,6 +138,12 @@ def main() -> None:
                 "max_rel_err_vs_reference": (
                     None if max_rel is None else float(f"{max_rel:.3e}")
                 ),
+                # self-describing under the collector's COL_BLOCK sweep
+                **(
+                    {"pallas_col_block": col_block}
+                    if impl == "pallas" and col_block != 8
+                    else {}
+                ),
             }
         except Exception as exc:  # noqa: BLE001 — report per-engine failure
             row = {"engine": engine, "platform": platform,
@@ -154,6 +161,14 @@ def main() -> None:
             print(f"| {r['engine']} | {r['points_per_sec_per_chip']} "
                   f"| {'n/a' if err is None else format(err, '.2e')} "
                   f"| {r['seconds']} |")
+
+    # Exit status reflects data quality so callers (the evidence
+    # collector's phase gates) can distinguish "timed rows collected"
+    # from "every engine failed": per-engine failures are reported in
+    # the rows either way, but a run with NO timed row must not stamp a
+    # collection phase as done.
+    if not any("error" not in r for r in rows):
+        sys.exit(1)
 
 
 if __name__ == "__main__":
