@@ -85,12 +85,28 @@ LANES = 128
 #: shootout sweeps it per-subprocess to find the grid-overhead sweet
 #: spot; a non-default value joins the sweep resume identity
 #: (`parallel/sweep.py`).
-COL_BLOCK = int(os.environ.get("BDLZ_PALLAS_COL_BLOCK", "8"))
+COL_BLOCK_DEFAULT = 8
+COL_BLOCK = int(
+    os.environ.get("BDLZ_PALLAS_COL_BLOCK", str(COL_BLOCK_DEFAULT))
+)
 if COL_BLOCK < 8 or COL_BLOCK % 8:
     raise ValueError(
         f"BDLZ_PALLAS_COL_BLOCK must be a positive multiple of 8 (the f32 "
         f"sublane tile), got {COL_BLOCK}"
     )
+
+
+def col_block_row() -> dict:
+    """Evidence-row fragment self-describing the kernel block size.
+
+    Labeled whenever the knob was explicitly set (even to the default —
+    the collector's COL_BLOCK sweep includes an 8 leg that must be
+    distinguishable from unlabeled default rows) or differs from
+    ``COL_BLOCK_DEFAULT``.  Callers splice it only on pallas-path rows.
+    """
+    if "BDLZ_PALLAS_COL_BLOCK" in os.environ or COL_BLOCK != COL_BLOCK_DEFAULT:
+        return {"pallas_col_block": COL_BLOCK}
+    return {}
 
 #: Default for the in-kernel Kahan reduction.  The sweep resume identity
 #: references THIS constant (`parallel/sweep.py`), so flipping it — e.g.
@@ -203,8 +219,21 @@ def _interp_column(t4t, subl, i1t, st, j):
     rsel = (subl == r).astype(f32)              # (128, 128): [m, n] = m == r[n]
     # picked[k*128+cc, n] = t4t[k*128+cc, r[n]]: the table arrives
     # transposed (512, 128), so this is the canonical (1,0)-contraction
-    # matmul — the best-trodden Mosaic lowering path.
-    picked = jnp.dot(t4t, rsel, preferred_element_type=f32)  # (512, 128)
+    # matmul — the best-trodden Mosaic lowering path.  Precision is
+    # pinned to HIGHEST (#tpu.contract_precision<fp32>): the design's
+    # exactness rests on each output being a bit-exact COPY of one f32
+    # table entry, and Mosaic's default contract precision — like
+    # XLA-TPU's for f32 dots — may demote operands to bf16 (one MXU
+    # pass), which would round every table value to 8 mantissa bits
+    # (~4e-3 rel err; the preflight would catch it only by degrading
+    # the whole engine to tabulated).  If fp32 contraction proves slow,
+    # the exact cheaper form is a 3-piece mantissa-masked bf16 split of
+    # the table (8+8+8 bits, exact by construction) against the
+    # bf16-exact one-hot — 3 passes instead of fp32's 6.
+    picked = jnp.dot(
+        t4t, rsel, preferred_element_type=f32,
+        precision=jax.lax.Precision.HIGHEST,
+    )  # (512, 128)
     csel = (subl == c).astype(f32)              # (128, 128): [cc, n] = cc == c[n]
     s = st[j:j + 1, :]
     sm1, s0, s1_, s2 = s + f32(1.0), s, s - f32(1.0), s - f32(2.0)

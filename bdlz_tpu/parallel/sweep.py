@@ -674,7 +674,11 @@ def run_sweep(
         # chunks from different summation/exp algorithms.  "reduce"
         # records the tier this sweep actually runs with — the resolved
         # preflight tier on hardware, the kernel default otherwise.
-        from bdlz_tpu.ops.kjma_pallas import COL_BLOCK, REDUCE_DEFAULT
+        from bdlz_tpu.ops.kjma_pallas import (
+            COL_BLOCK,
+            COL_BLOCK_DEFAULT,
+            REDUCE_DEFAULT,
+        )
 
         hash_extra = dict(hash_extra or {})
         hash_extra["pallas"] = {
@@ -684,7 +688,11 @@ def run_sweep(
             ),
             # omit-at-default so pre-r4 directories stay resumable; a
             # non-default block changes Kahan accumulation order (~1e-13)
-            **({"col_block": COL_BLOCK} if COL_BLOCK != 8 else {}),
+            **(
+                {"col_block": COL_BLOCK}
+                if COL_BLOCK != COL_BLOCK_DEFAULT
+                else {}
+            ),
         }
     h = grid_hash(base, axes, n_y, impl, extra=hash_extra)
     if out_dir is not None:

@@ -79,7 +79,7 @@ def main() -> None:
     sharding = batch_sharding(mesh)
     table = make_f_table(base.I_p, jnp)
     grid_np = make_kjma_grid(np)
-    from bdlz_tpu.ops.kjma_pallas import COL_BLOCK as col_block
+    from bdlz_tpu.ops.kjma_pallas import col_block_row
 
     # accuracy sample (shared across engines)
     rng = np.random.default_rng(0)
@@ -139,11 +139,8 @@ def main() -> None:
                     None if max_rel is None else float(f"{max_rel:.3e}")
                 ),
                 # self-describing under the collector's COL_BLOCK sweep
-                **(
-                    {"pallas_col_block": col_block}
-                    if impl == "pallas" and col_block != 8
-                    else {}
-                ),
+                # (incl. its explicit 8 leg)
+                **(col_block_row() if impl == "pallas" else {}),
             }
         except Exception as exc:  # noqa: BLE001 — report per-engine failure
             row = {"engine": engine, "platform": platform,
