@@ -53,7 +53,6 @@ def try_external_P_from_profile(
     Returns ``(P, module_name)`` so the CLI can say which module ran.
     """
     import importlib
-    import math
 
     try:
         for modname in _EXTERNAL_LZ_MODULES:

@@ -248,6 +248,8 @@ def main(argv=None) -> None:
         ],
         axis=1,
     )
+    from bdlz_tpu.parallel.multihost import gather_to_host, is_coordinator
+
     resumed_segments = 0
     if args.checkpoint_dir:
         from bdlz_tpu.config import config_identity_dict
@@ -294,8 +296,6 @@ def main(argv=None) -> None:
         run = run_ensemble(jax.random.PRNGKey(args.seed + 1), logp, init,
                            n_steps=args.steps, mesh=mesh)
         # global arrays in multi-process runs; identity single-process
-        from bdlz_tpu.parallel.multihost import gather_to_host
-
         full_chain, full_logp = gather_to_host((run.chain, run.logp_chain))
         acceptance = float(run.acceptance)
 
@@ -339,8 +339,6 @@ def main(argv=None) -> None:
             summary["lz"]["gamma_phi"] = (
                 "sampled" if gamma_sampled else args.lz_gamma_phi
             )
-    from bdlz_tpu.parallel.multihost import is_coordinator
-
     if args.out:
         if is_coordinator():
             np.savez(args.out, chain=full_chain, logp=full_logp,

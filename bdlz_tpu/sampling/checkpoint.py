@@ -27,6 +27,16 @@ from typing import Callable, NamedTuple, Optional
 import numpy as np
 
 
+def _load_segment(seg_file):
+    """(chain, logp, state) from one segment file; raises if unreadable."""
+    with np.load(seg_file) as data:
+        return (
+            data["chain"],
+            data["logp"],
+            (data["walkers"], data["state_logp"], data["n_accept"].item()),
+        )
+
+
 class CheckpointedRun(NamedTuple):
     chain: np.ndarray        # (n_steps, W, D) kept states, host numpy
     logp_chain: np.ndarray   # (n_steps, W)
@@ -126,11 +136,9 @@ def run_ensemble_checkpointed(
             seg_file = os.path.join(out_dir, f"seg_{k:05d}.npz")
             try:
                 # validation IS the load — one read per segment
-                with np.load(seg_file) as data:
-                    chain_parts.append(data["chain"])
-                    logp_parts.append(data["logp"])
-                    state = (data["walkers"], data["state_logp"],
-                             data["n_accept"].item())
+                seg_chain, seg_logp, state = _load_segment(seg_file)
+                chain_parts.append(seg_chain)
+                logp_parts.append(seg_logp)
             except Exception as exc:
                 import sys
 
@@ -157,12 +165,11 @@ def run_ensemble_checkpointed(
     # from the shared checkpoint directory
     if not coordinator:
         for k in range(resumed):
-            seg_file = os.path.join(out_dir, f"seg_{k:05d}.npz")
-            with np.load(seg_file) as data:
-                chain_parts.append(data["chain"])
-                logp_parts.append(data["logp"])
-                state = (data["walkers"], data["state_logp"],
-                         data["n_accept"].item())
+            seg_chain, seg_logp, state = _load_segment(
+                os.path.join(out_dir, f"seg_{k:05d}.npz")
+            )
+            chain_parts.append(seg_chain)
+            logp_parts.append(seg_logp)
 
     base_key = jax.random.PRNGKey(seed)
 
