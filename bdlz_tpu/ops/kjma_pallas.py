@@ -96,17 +96,22 @@ if COL_BLOCK < 8 or COL_BLOCK % 8:
     )
 
 
-def col_block_row() -> dict:
-    """Evidence-row fragment self-describing the kernel block size.
+def pallas_evidence_row() -> dict:
+    """Evidence-row fragment self-describing the kernel tuning knobs.
 
-    Labeled whenever the knob was explicitly set (even to the default —
-    the collector's COL_BLOCK sweep includes an 8 leg that must be
-    distinguishable from unlabeled default rows) or differs from
-    ``COL_BLOCK_DEFAULT``.  Callers splice it only on pallas-path rows.
+    Each knob (COL_BLOCK, the bf16x3 table split) is labeled whenever
+    its env var was explicitly set — even to the default, so the
+    collector sweeps' default legs stay distinguishable from unlabeled
+    rows — or its value differs from the default.  Callers splice it
+    only on pallas-path rows.
     """
+    row = {}
     if "BDLZ_PALLAS_COL_BLOCK" in os.environ or COL_BLOCK != COL_BLOCK_DEFAULT:
-        return {"pallas_col_block": COL_BLOCK}
-    return {}
+        row["pallas_col_block"] = COL_BLOCK
+    if "BDLZ_PALLAS_TABLE_SPLIT3" in os.environ:
+        row["pallas_table_split3"] = TABLE_SPLIT3
+    return row
+
 
 #: Default for the in-kernel Kahan reduction.  The sweep resume identity
 #: references THIS constant (`parallel/sweep.py`), so flipping it — e.g.
@@ -116,8 +121,51 @@ def col_block_row() -> dict:
 REDUCE_DEFAULT = True
 
 
-def build_shifted_table(table: KJMATable) -> jax.Array:
-    """(512, 128) f32 stencil-shifted TRANSPOSED layout of an F table.
+#: Rows of the stencil-shifted table layout (4 cubic taps × 128 lanes).
+STENCIL_ROWS = 4 * LANES
+
+#: Effective value of the bf16x3 masked-split table layout knob (see
+#: `build_shifted_table`); import-time like COL_BLOCK so the hardware
+#: shootout can A/B it per-subprocess (BDLZ_PALLAS_TABLE_SPLIT3=1).
+#: Strict "0"/"1" parsing: a typo'd value must fail fast, not silently
+#: bench the f32 layout as a duplicate of the baseline.
+_TABLE_SPLIT3_RAW = os.environ.get("BDLZ_PALLAS_TABLE_SPLIT3", "0")
+if _TABLE_SPLIT3_RAW not in ("0", "1"):
+    raise ValueError(
+        f"BDLZ_PALLAS_TABLE_SPLIT3 must be '0' or '1', "
+        f"got {_TABLE_SPLIT3_RAW!r}"
+    )
+TABLE_SPLIT3 = _TABLE_SPLIT3_RAW == "1"
+
+
+def _split3_masked(t4: np.ndarray) -> np.ndarray:
+    """(3·512, 128) bf16-exact mantissa-masked split of an f32 table.
+
+    Each f32 value's 24-bit mantissa is cut into three 8-bit pieces by
+    TRUNCATING bitmasks (top 16 bits of the f32 pattern are exactly a
+    bf16 value; the residual subtraction is exact in f32), so
+    ``x == p0 + p1 + p2`` bit-exactly for every value whose third piece
+    stays in bf16's subnormal range (exponent ≥ −133 + 16) — all normal
+    table entries.  The ~30 f32-subnormal entries of a production F
+    table (the F → 0 underflow tail near y = +50) reconstruct to within
+    2⁻¹³³ absolute — ~1e-34 relative on Y_B, far inside the 1e-6
+    contract.  Unlike a naive 2-piece ROUNDED bf16 split (~1e-5 rel
+    err), this is the exact form of the one-hot contraction at 3 bf16
+    MXU passes instead of fp32's ~6.
+    """
+    x = t4.astype(np.float32).copy()
+    pieces = []
+    for _ in range(3):
+        hi = (x.view(np.uint32) & np.uint32(0xFFFF0000)).view(np.float32)
+        pieces.append(hi)
+        x = x - hi  # exact: hi is x truncated, same binade
+    return np.concatenate(pieces, axis=0)
+
+
+def build_shifted_table(
+    table: KJMATable, split3: "bool | None" = None
+) -> jax.Array:
+    """Stencil-shifted TRANSPOSED layout of an F table for the kernel.
 
     ``T4[k*128 + c, m] = F[clip(m*128 + c + k - 1, 0, N-1)]`` for the four
     cubic taps k = 0..3 (offsets -1..+2 around the base index).  Built
@@ -125,6 +173,13 @@ def build_shifted_table(table: KJMATable) -> jax.Array:
     row-select is the canonical (1,0)-contraction matmul; the edge clips
     are unreachable in use because the base index is clipped to [1, N-3]
     (matching `eval_f_table`).
+
+    ``split3`` (default: the BDLZ_PALLAS_TABLE_SPLIT3 env knob,
+    ``TABLE_SPLIT3``) selects
+    the (3·512, 128) bf16 mantissa-masked layout instead of the
+    (512, 128) f32 one — the kernel dispatches on the table's static
+    shape, so both layouts run through the same entry points
+    (`_split3_masked` documents the exactness argument).
     """
     flat = np.asarray(table.values, dtype=np.float64)
     n = flat.shape[0]
@@ -140,7 +195,12 @@ def build_shifted_table(table: KJMATable) -> jax.Array:
         if rows < ROWS:  # pad to the fixed one-hot width
             block = np.pad(block, ((0, ROWS - rows), (0, 0)))
         cols.append(block)
-    return jnp.asarray(np.concatenate(cols, axis=1).T, dtype=f32)
+    t4 = np.concatenate(cols, axis=1).T.astype(np.float32)
+    if split3 is None:
+        split3 = TABLE_SPLIT3
+    if split3:
+        return jnp.asarray(_split3_masked(t4), dtype=jnp.bfloat16)
+    return jnp.asarray(t4, dtype=f32)
 
 
 #: Cody–Waite constants for the in-kernel f32 exp: ln2 split so n*LN2_HI is
@@ -218,22 +278,34 @@ def _interp_column(t4t, subl, i1t, st, j):
     c = idx - r * lanes
     rsel = (subl == r).astype(f32)              # (128, 128): [m, n] = m == r[n]
     # picked[k*128+cc, n] = t4t[k*128+cc, r[n]]: the table arrives
-    # transposed (512, 128), so this is the canonical (1,0)-contraction
-    # matmul — the best-trodden Mosaic lowering path.  Precision is
-    # pinned to HIGHEST (#tpu.contract_precision<fp32>): the design's
-    # exactness rests on each output being a bit-exact COPY of one f32
-    # table entry, and Mosaic's default contract precision — like
-    # XLA-TPU's for f32 dots — may demote operands to bf16 (one MXU
-    # pass), which would round every table value to 8 mantissa bits
-    # (~4e-3 rel err; the preflight would catch it only by degrading
-    # the whole engine to tabulated).  If fp32 contraction proves slow,
-    # the exact cheaper form is a 3-piece mantissa-masked bf16 split of
-    # the table (8+8+8 bits, exact by construction) against the
-    # bf16-exact one-hot — 3 passes instead of fp32's 6.
-    picked = jnp.dot(
-        t4t, rsel, preferred_element_type=f32,
-        precision=jax.lax.Precision.HIGHEST,
-    )  # (512, 128)
+    # transposed, so this is the canonical (1,0)-contraction matmul —
+    # the best-trodden Mosaic lowering path.  The design's exactness
+    # rests on each output being a bit-exact COPY of one f32 table
+    # entry, so the contraction must not round the table operand:
+    #
+    # * f32 layout (512, 128): precision pinned to HIGHEST
+    #   (#tpu.contract_precision<fp32>) — Mosaic's default, like
+    #   XLA-TPU's for f32 dots, may demote operands to one bf16 MXU
+    #   pass (~4e-3 rel err; the preflight would catch it only by
+    #   degrading the whole engine to tabulated).
+    # * bf16x3 layout (3·512, 128): three mantissa-masked bf16 pieces
+    #   summing bit-exactly to the f32 values (`_split3_masked`), each
+    #   contracted against the bf16-exact one-hot in a single DEFAULT
+    #   pass — 3 MXU passes instead of fp32's ~6; picked for A/B via
+    #   BDLZ_PALLAS_TABLE_SPLIT3, dispatched on the static table shape.
+    if t4t.shape[0] == 3 * STENCIL_ROWS:
+        r16 = rsel.astype(jnp.bfloat16)  # 0/1: exact in bf16
+        picked = jnp.zeros((STENCIL_ROWS, LANES), f32)
+        for p in range(3):
+            picked = picked + jnp.dot(
+                t4t[p * STENCIL_ROWS:(p + 1) * STENCIL_ROWS, :], r16,
+                preferred_element_type=f32,
+            )
+    else:
+        picked = jnp.dot(
+            t4t, rsel, preferred_element_type=f32,
+            precision=jax.lax.Precision.HIGHEST,
+        )  # (512, 128)
     csel = (subl == c).astype(f32)              # (128, 128): [cc, n] = cc == c[n]
     s = st[j:j + 1, :]
     sm1, s0, s1_, s2 = s + f32(1.0), s, s - f32(1.0), s - f32(2.0)
@@ -359,7 +431,7 @@ def _kernel_fused_reduce(g2_ref, ahi_ref, alo_ref, i1_ref, s_ref, t4_ref,
     )
 
 
-def _tile_specs(n_streams: int):
+def _tile_specs(n_streams: int, table_rows: int = STENCIL_ROWS):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -371,19 +443,22 @@ def _tile_specs(n_streams: int):
         (1, COL_BLOCK, ROWS), lambda p, jb: (p, jb, zero), memory_space=pltpu.VMEM
     )
     table = pl.BlockSpec(
-        (4 * LANES, ROWS), lambda p, jb: (zero, zero), memory_space=pltpu.VMEM
+        (table_rows, ROWS), lambda p, jb: (zero, zero), memory_space=pltpu.VMEM
     )
     return [stream] * n_streams + [table], pl.BlockSpec(
         (1, COL_BLOCK, ROWS), lambda p, jb: (p, jb, zero), memory_space=pltpu.VMEM
     )
 
 
-def _reduced_call(kernel, n_streams: int, P: int, ncol: int, interpret: bool):
+def _reduced_call(
+    kernel, n_streams: int, P: int, ncol: int, interpret: bool,
+    table_rows: int = STENCIL_ROWS,
+):
     """pallas_call wrapper for the in-kernel-reduction variants."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    in_specs, _ = _tile_specs(n_streams)
+    in_specs, _ = _tile_specs(n_streams, table_rows)
     zero = np.int32(0)
     partial_spec = pl.BlockSpec(
         (1, COL_BLOCK, ROWS), lambda p, jb: (p, zero, zero),
@@ -425,10 +500,10 @@ def interp_multiply(
     P, ncol, rows = ghat.shape
     assert rows == ROWS and ncol % COL_BLOCK == 0
     if reduce:
-        return _reduced_call(_kernel_reduce, 3, P, ncol, interpret)(
-            ghat, i1, sfrac, t4
-        )
-    in_specs, out_spec = _tile_specs(3)
+        return _reduced_call(
+            _kernel_reduce, 3, P, ncol, interpret, t4.shape[0]
+        )(ghat, i1, sfrac, t4)
+    in_specs, out_spec = _tile_specs(3, t4.shape[0])
     return pl.pallas_call(
         _kernel,
         grid=(P, ncol // COL_BLOCK),
@@ -459,10 +534,10 @@ def interp_multiply_fused(
     P, ncol, rows = g2.shape
     assert rows == ROWS and ncol % COL_BLOCK == 0
     if reduce:
-        return _reduced_call(_kernel_fused_reduce, 5, P, ncol, interpret)(
-            g2, a_hi, a_lo, i1, sfrac, t4
-        )
-    in_specs, out_spec = _tile_specs(5)
+        return _reduced_call(
+            _kernel_fused_reduce, 5, P, ncol, interpret, t4.shape[0]
+        )(g2, a_hi, a_lo, i1, sfrac, t4)
+    in_specs, out_spec = _tile_specs(5, t4.shape[0])
     return pl.pallas_call(
         _kernel_fused,
         grid=(P, ncol // COL_BLOCK),

@@ -570,22 +570,32 @@ def run_sweep(
         if impl == "pallas":
             from bdlz_tpu.ops.kjma_pallas import build_shifted_table
 
-            # COL_BLOCK is import-time per-process (BDLZ_PALLAS_COL_BLOCK)
-            # and keys both the Kahan accumulation order and (when
-            # non-default) the grid hash — a per-host env divergence must
-            # fail the whole fleet, not splice mixed-block chunks.  One
-            # elementwise allreduce_min over [cb, -cb] yields [min, -max];
-            # min != max raises identically on every host.
+            # COL_BLOCK and the bf16x3 table layout are import-time
+            # per-process knobs (BDLZ_PALLAS_COL_BLOCK /
+            # BDLZ_PALLAS_TABLE_SPLIT3) that key the kernel's numerics
+            # and (when non-default) the grid hash — a per-host env
+            # divergence must fail the whole fleet, not splice
+            # mixed-kernel chunks.  One elementwise allreduce_min over
+            # [v, -v] pairs yields [min, -max] per knob; min != max
+            # raises identically on every host.
             from bdlz_tpu.ops.kjma_pallas import COL_BLOCK as _CB
+            from bdlz_tpu.ops.kjma_pallas import TABLE_SPLIT3 as _S3
             from bdlz_tpu.parallel.multihost import allreduce_min as _armin
 
-            _cb_mm = np.asarray(_armin(np.array([_CB, -_CB], dtype=np.int64)))
-            if int(_cb_mm[0]) != int(-_cb_mm[1]):
-                raise RuntimeError(
-                    f"BDLZ_PALLAS_COL_BLOCK differs across hosts (min "
-                    f"{int(_cb_mm[0])}, max {int(-_cb_mm[1])}; this host "
-                    f"{_CB}); set one value fleet-wide"
-                )
+            _knobs = np.asarray(_armin(np.array(
+                [_CB, -_CB, int(_S3), -int(_S3)], dtype=np.int64
+            )))
+            for _name, _lo, _hi, _local in (
+                ("BDLZ_PALLAS_COL_BLOCK", _knobs[0], -_knobs[1], _CB),
+                ("BDLZ_PALLAS_TABLE_SPLIT3", _knobs[2], -_knobs[3],
+                 int(_S3)),
+            ):
+                if int(_lo) != int(_hi):
+                    raise RuntimeError(
+                        f"{_name} differs across hosts (min {int(_lo)}, "
+                        f"max {int(_hi)}; this host {_local}); set one "
+                        "value fleet-wide"
+                    )
             _tier_code = -1  # non-hardware: kernel default everywhere
             _tier_msg = "no hardware preflight (cpu/interpret)"
             if not interpret and jax.devices()[0].platform != "cpu":
@@ -678,6 +688,7 @@ def run_sweep(
             COL_BLOCK,
             COL_BLOCK_DEFAULT,
             REDUCE_DEFAULT,
+            TABLE_SPLIT3,
         )
 
         hash_extra = dict(hash_extra or {})
@@ -693,6 +704,9 @@ def run_sweep(
                 if COL_BLOCK != COL_BLOCK_DEFAULT
                 else {}
             ),
+            # the bf16x3 table layout changes results at ~1e-12 — a
+            # resumed directory must not splice the two layouts
+            **({"table_split3": True} if TABLE_SPLIT3 else {}),
         }
     h = grid_hash(base, axes, n_y, impl, extra=hash_extra)
     if out_dir is not None:

@@ -79,7 +79,7 @@ def main() -> None:
     from bdlz_tpu.models.yields_pipeline import point_yields
     # imported up-front so a typo'd BDLZ_PALLAS_COL_BLOCK fails fast,
     # before the (minutes-long) timed sweep rather than after it
-    from bdlz_tpu.ops.kjma_pallas import col_block_row
+    from bdlz_tpu.ops.kjma_pallas import pallas_evidence_row
     from bdlz_tpu.ops.kjma_table import make_f_table
     from bdlz_tpu.parallel.mesh import batch_sharding, make_mesh
     from bdlz_tpu.parallel.sweep import build_grid, _pad_chunk
@@ -347,7 +347,7 @@ def main() -> None:
                 # explicitly-set or non-default kernel block (the
                 # collector's COL_BLOCK sweep, incl. its 8 leg); absent
                 # off the pallas path like pallas_reduce
-                **(col_block_row() if impl == "pallas" else {}),
+                **(pallas_evidence_row() if impl == "pallas" else {}),
                 # the summation tier actually benched (kernel-identity
                 # relevant: reduce/stream differ at ~1e-7); null off the
                 # pallas path

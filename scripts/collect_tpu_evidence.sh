@@ -9,7 +9,8 @@
 #      compiles have killed the relay before — docs/perf_notes.md
 #      "Memory limits")
 #   2. impl shootout: tabulated vs pallas variants incl. the fuse_exp
-#      A/B (VERDICT items 1 and 4)
+#      A/B (VERDICT items 1 and 4); later phases sweep COL_BLOCK and
+#      the bf16x3 masked-split table (pallas_evidence_row labels rows)
 #   3. accuracy audit on the chip, 1024 configs (VERDICT item 2)
 #   4. pallas profile: kernel vs prep vs gather attribution (item 8)
 #   5. full bench.py — sweep + ESDIRK metrics on TPU (items 1 and 3);
@@ -81,6 +82,10 @@ EOF
       fi
     done
     [ "$any_ok" = 1 ]' || continue
+  phase tableprec 1500 bash -c '
+    echo "--- bf16x3 masked-split table (BDLZ_PALLAS_TABLE_SPLIT3=1) ---"
+    BDLZ_PALLAS_TABLE_SPLIT3=1 timeout 700 python scripts/impl_shootout.py \
+      --points 8192 --n-y 8000 --engines pallas,pallas+fuse' || continue
   phase bench 3600 bash -c \
       'set -o pipefail; python bench.py | tee evidence/BENCH_tpu.jsonl' \
       || continue

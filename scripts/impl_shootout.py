@@ -79,7 +79,7 @@ def main() -> None:
     sharding = batch_sharding(mesh)
     table = make_f_table(base.I_p, jnp)
     grid_np = make_kjma_grid(np)
-    from bdlz_tpu.ops.kjma_pallas import col_block_row
+    from bdlz_tpu.ops.kjma_pallas import pallas_evidence_row
 
     # accuracy sample (shared across engines)
     rng = np.random.default_rng(0)
@@ -140,7 +140,7 @@ def main() -> None:
                 ),
                 # self-describing under the collector's COL_BLOCK sweep
                 # (incl. its explicit 8 leg)
-                **(col_block_row() if impl == "pallas" else {}),
+                **(pallas_evidence_row() if impl == "pallas" else {}),
             }
         except Exception as exc:  # noqa: BLE001 — report per-engine failure
             row = {"engine": engine, "platform": platform,

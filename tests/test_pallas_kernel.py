@@ -325,3 +325,61 @@ print("colblock16 OK")
     )
     assert r.returncode != 0
     assert "multiple of 8" in r.stderr
+
+
+def test_split3_masked_table_reconstruction(setup):
+    """The bf16x3 mantissa-masked split must reconstruct the f32 table
+    bit-exactly for every normal-range entry (truncating masks, no
+    rounding — unlike a naive bf16 cast), and to within 2^-133 absolute
+    for the handful of f32-subnormal underflow-tail entries."""
+    from bdlz_tpu.ops.kjma_pallas import STENCIL_ROWS
+
+    _, _, table, t4 = setup
+    t4_np = np.asarray(t4, dtype=np.float32)
+    s3 = np.asarray(
+        build_shifted_table(table, split3=True), dtype=np.float32
+    )
+    assert s3.shape == (3 * STENCIL_ROWS, t4_np.shape[1])
+    recon = (
+        s3[:STENCIL_ROWS]
+        + s3[STENCIL_ROWS:2 * STENCIL_ROWS]
+        + s3[2 * STENCIL_ROWS:]
+    )
+    # pieces are bf16-exact: casting bf16 -> f32 -> sum reproduces f32
+    normal = np.abs(t4_np) >= np.finfo(np.float32).tiny * 2.0 ** 17
+    normal |= t4_np == 0.0
+    np.testing.assert_array_equal(recon[normal], t4_np[normal])
+    resid = np.abs(recon[~normal] - t4_np[~normal])
+    assert resid.size == 0 or resid.max() <= 2.0 ** -133
+
+
+def test_split3_kernel_matches_f32_kernel(setup):
+    """The bf16x3 table layout through the same kernel entry points must
+    reproduce the f32 layout's Y_B essentially bitwise (the only
+    differences can come from the ~30 subnormal underflow-tail table
+    entries, ~1e-30 relative at worst)."""
+    base, static, table, t4 = setup
+    t4s = build_shifted_table(table, split3=True)
+    rng = np.random.default_rng(11)
+    n = 6
+    grid = build_grid(
+        base,
+        {
+            "m_chi_GeV": rng.uniform(0.3, 3.0, n),
+            "T_p_GeV": rng.uniform(50.0, 200.0, n),
+            "source_shape_sigma_y": rng.uniform(4.0, 15.0, n),
+        },
+        product=False,
+    )
+    grid = jax.tree.map(jnp.asarray, grid)
+    for fuse in (False, True):
+        for reduce in (False, True):
+            a = np.asarray(integrate_YB_pallas(
+                grid, static.chi_stats, table, t4, n_y=2048,
+                interpret=True, fuse_exp=fuse, reduce=reduce,
+            ))
+            b = np.asarray(integrate_YB_pallas(
+                grid, static.chi_stats, table, t4s, n_y=2048,
+                interpret=True, fuse_exp=fuse, reduce=reduce,
+            ))
+            np.testing.assert_allclose(b, a, rtol=1e-12)
