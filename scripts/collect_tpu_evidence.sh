@@ -49,10 +49,27 @@ EOF
 }
 
 echo "=== collector started $(date -u) ==="
+# Stop starting chip work near the round's end: the driver's own bench
+# runs on the single chip then, and concurrent heavy compiles are the
+# suspected relay killer (docs/perf_notes.md "Memory limits").
+START_S=$(date +%s)
+BUDGET_S=${BDLZ_COLLECT_BUDGET_S:-30600}   # default 8.5h of activity
+past_deadline() { [ $(( $(date +%s) - START_S )) -gt "$BUDGET_S" ]; }
+
 for attempt in 1 2 3 4 5; do
+  if past_deadline; then
+    echo "=== activity budget exhausted before recovery; exiting to keep "
+    echo "    the chip free for the driver's end-of-round bench ==="
+    exit 1
+  fi
   echo "=== waiting for relay (attempt $attempt) ==="
   wait_relay || { echo "RELAY NEVER RECOVERED"; exit 1; }
   echo "=== relay alive $(date -u) ==="
+  if past_deadline; then
+    echo "=== relay recovered past the activity budget; leaving the chip "
+    echo "    to the driver's bench ==="
+    exit 1
+  fi
 
   phase preflight 1200 python - <<'EOF' || continue
 import time
