@@ -29,7 +29,10 @@ host platform (debug only), BDLZ_BENCH_RELAY_WAIT_S (default 600 — how
 long to wait for a dead accelerator relay to recover before benching CPU;
 the JSON stamps platform/tpu_unavailable/relay_waited_s either way),
 BDLZ_BENCH_ODE_POINTS (default 1024 — grid size for the secondary stiff
-ESDIRK sweep metric, printed as its own line before the main one).
+ESDIRK sweep metric, printed as its own line before the main one),
+BDLZ_BENCH_LZ=1 (force the LZ-sweep secondary metric — per-point P
+derived from a bounce profile through the two-channel LZ kernel — on
+CPU platforms; it auto-runs on TPU).
 """
 from __future__ import annotations
 
@@ -129,27 +132,32 @@ def main() -> None:
     sharding = batch_sharding(mesh)
     table = make_f_table(base.I_p, jnp)
 
-    def make_run_chunk(impl: str, reduce=None):
+    def make_run_chunk(impl: str, reduce=None, pp=None):
         # shared engine-runner (pallas aux pairing, interpret-on-CPU,
         # memory clamp, pad + shard + evaluate) —
         # bdlz_tpu.parallel.sweep.make_chunk_runner, also used by
-        # scripts/impl_shootout.py so the two tools measure the same thing
+        # scripts/impl_shootout.py so the two tools measure the same
+        # thing; ``pp`` defaults to the bench grid (the LZ metric passes
+        # its P-derived variant)
         nonlocal chunk
         from bdlz_tpu.parallel.sweep import make_chunk_runner
 
         fuse = os.environ.get("BDLZ_BENCH_FUSE_EXP", "0") == "1"
         run_chunk, chunk = make_chunk_runner(
-            pp_all, chunk, static, mesh, sharding, table,
-            impl=impl, n_y=n_y, fuse_exp=fuse, reduce=reduce,
+            pp_all if pp is None else pp, chunk, static, mesh, sharding,
+            table, impl=impl, n_y=n_y, fuse_exp=fuse, reduce=reduce,
         )
         return run_chunk
 
-    def accuracy_gate(run_chunk):
+    def accuracy_gate(run_chunk, pp=None):
         """Max rel err of a point sample vs the NumPy reference path.
 
         The first chunk evaluation doubles as compile warm-up; any
         compile/runtime failure propagates to the caller for fallback.
+        ``pp`` must be the grid ``run_chunk`` was built over (default:
+        the bench grid).
         """
+        pp = pp_all if pp is None else pp
         rng = np.random.default_rng(0)
         sample = rng.choice(n_total, size=8, replace=False)
         # Deliberate corners beyond the random draw: the grid's flat-index
@@ -157,8 +165,8 @@ def main() -> None:
         # most relativistic one (min m/T_p), and the point whose T = m/3
         # branch seam sits closest to the percolation temperature — the
         # hard n_eq/vbar discontinuity the 1e-6 contract must survive.
-        m = np.asarray(pp_all.m_chi_GeV)
-        Tp = np.asarray(pp_all.T_p_GeV)
+        m = np.asarray(pp.m_chi_GeV)
+        Tp = np.asarray(pp.T_p_GeV)
         corners = np.array([
             0, n_total - 1,
             int(np.argmax(m / Tp)), int(np.argmin(m / Tp)),
@@ -171,7 +179,7 @@ def main() -> None:
         max_rel = 0.0
         ratios0 = np.asarray(run_chunk(0, min(chunk, n_total)))
         for i in sample:
-            pp_i = type(pp_all)(*(float(np.asarray(f)[i]) for f in pp_all))
+            pp_i = type(pp)(*(float(np.asarray(f)[i]) for f in pp))
             ref = float(point_yields(pp_i, static_gate, grid_np, np).DM_over_B)
             lo_c = (i // chunk) * chunk
             if lo_c == 0:
@@ -329,6 +337,65 @@ def main() -> None:
         except Exception as exc:  # noqa: BLE001 — secondary metric is best-effort
             print(f"[bench] esdirk metric unavailable: {exc}", file=sys.stderr)
 
+    # --- secondary metric: the LZ-sweep (BASELINE.json's metric name) ---
+    # Per-point P derived from a bounce profile through the two-channel
+    # LZ kernel (the physics the reference only stubs) feeding the same
+    # grid: total cost = host-side LZ derivation + the sharded sweep.
+    def lz_metric():
+        from bdlz_tpu.lz.profile import BounceProfile
+        from bdlz_tpu.lz.sweep_bridge import probabilities_for_points
+
+        # synthetic single-crossing profile (same family the LZ tests
+        # pin against the analytic limit): Δ crosses zero at ξ = 0
+        xi = np.linspace(-30.0, 30.0, 2001)
+        prof = BounceProfile(
+            xi=xi,
+            delta=-0.08 * np.tanh(xi / 4.0),
+            mix=np.full_like(xi, 0.02),
+        )
+        t0 = time.time()
+        P_lz = np.clip(np.asarray(probabilities_for_points(
+            prof, np.asarray(pp_all.v_w), method="local",
+        )), 0.0, 1.0)
+        t_derive = time.time() - t0
+        pp_lz = pp_all._replace(P=jnp.asarray(P_lz))
+        run_lz = make_run_chunk(impl, reduce=pallas_reduce, pp=pp_lz)
+        # warm-up + the shared spot-gate, on the SAME derived P
+        lz_rel = accuracy_gate(run_lz, pp=pp_lz)
+        t1 = time.time()
+        done = 0
+        while done < n_total:
+            hi = min(done + chunk, n_total)
+            out = run_lz(done, hi)
+            done = hi
+        out.block_until_ready()
+        lz_seconds = (time.time() - t1) + t_derive
+        per_chip_lz = round(n_total / lz_seconds / n_dev, 2)
+        print(
+            json.dumps({
+                "metric": "lz_sweep_points_per_sec_per_chip",
+                "value": per_chip_lz,
+                "unit": "param-points/sec/chip (LZ P(v_w) derivation + "
+                        "full pipeline, n_y=%d)" % n_y,
+                "n_points": n_total,
+                "lz_derive_seconds": round(t_derive, 3),
+                "seconds": round(lz_seconds, 3),
+                "rel_err_vs_reference": float(f"{lz_rel:.3e}"),
+                "impl": impl,
+            })
+        )
+        return per_chip_lz
+
+    lz_per_chip = None
+    if (
+        jax.devices()[0].platform != "cpu"
+        or os.environ.get("BDLZ_BENCH_LZ", "0") == "1"
+    ):
+        try:
+            lz_per_chip = lz_metric()
+        except Exception as exc:  # noqa: BLE001 — secondary metric is best-effort
+            print(f"[bench] lz metric unavailable: {exc}", file=sys.stderr)
+
     # main metric LAST (the driver parses the final line)
     print(
         json.dumps(
@@ -357,6 +424,7 @@ def main() -> None:
                 "tpu_unavailable": tpu_unavailable,
                 "relay_waited_s": relay_waited,
                 "esdirk_points_per_sec_per_chip": esdirk_per_chip,
+                "lz_sweep_points_per_sec_per_chip": lz_per_chip,
             }
         )
     )

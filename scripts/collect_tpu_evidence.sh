@@ -13,9 +13,10 @@
 #      the bf16x3 masked-split table (pallas_evidence_row labels rows)
 #   3. accuracy audit on the chip, 1024 configs (VERDICT item 2)
 #   4. pallas profile: kernel vs prep vs gather attribution (item 8)
-#   5. full bench.py — sweep + ESDIRK metrics on TPU (items 1 and 3);
-#      output preserved at evidence/BENCH_tpu.jsonl (one JSON doc per
-#      line — the ESDIRK metric line, then the main metric line)
+#   5. full bench.py — sweep + ESDIRK + LZ-sweep metrics on TPU (items
+#      1 and 3); output preserved at evidence/BENCH_tpu.jsonl (one JSON
+#      doc per line, secondary metric lines first — the MAIN metric is
+#      always the LAST line, same contract the driver uses)
 #
 # Logs to stdout (launcher redirects, e.g. >> /tmp/evidence.log).
 # Artifacts: /root/repo/evidence/ + ACCURACY_AUDIT.json
@@ -28,6 +29,13 @@ phase() {  # phase <name> <timeout-s> <cmd...>
   if [ -f "evidence/stamps/$name" ]; then
     echo "=== phase $name: already done, skipping ==="
     return 0
+  fi
+  if past_deadline; then
+    # never START chip work past the activity budget — the driver's
+    # end-of-round bench owns the chip then (checked per phase, not
+    # just per attempt: one attempt chains hours of phases)
+    echo "=== phase $name: past activity budget, not starting ==="
+    return 1
   fi
   echo "=== phase $name: start $(date -u +%H:%M:%S) ==="
   if timeout "$tmo" "$@"; then

@@ -24,6 +24,9 @@ def test_bench_cpu_smoke():
         # small audit-style gate population: the smoke test exercises the
         # population gate's machinery, not its full 128-point cost
         BDLZ_BENCH_GATE_POINTS="24",
+        # exercise the LZ-sweep secondary metric (TPU-default, env-forced
+        # here; derivation is instant at this grid size)
+        BDLZ_BENCH_LZ="1",
         PYTHONPATH=REPO,
     )
     out = subprocess.run(
@@ -42,4 +45,5 @@ def test_bench_cpu_smoke():
     assert d["impl"] == "tabulated"  # pallas is TPU-only by default
     assert d["rel_err_vs_reference"] <= 1e-6
     assert d["gate_points"] == 24  # the audit-style population ran
+    assert d["lz_sweep_points_per_sec_per_chip"] > 0  # LZ metric ran
     assert np.isfinite(d["value"])
