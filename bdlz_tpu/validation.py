@@ -92,6 +92,33 @@ def build_audit_population(base, n: int, seed: int = 0) -> AuditPopulation:
     return AuditPopulation(grid=grid, axes=axes, counts=counts)
 
 
+def population_max_rel(run_chunk, chunk: int, ref: np.ndarray) -> float:
+    """Max rel err of a chunk-runner over a gate population vs ``ref``.
+
+    One home for the loop both measurement tools use (``bench.py`` and
+    ``scripts/impl_shootout.py``) so their gate numbers cannot drift.
+    ``run_chunk``/``chunk`` come from ``make_chunk_runner`` built over
+    the population grid (the runner returns PADDED chunks); ``ref`` is
+    the NumPy reference from :func:`reference_ratios`.  Non-finite
+    engine output raises ValueError — the adversarial corners exist to
+    smoke out exactly that, and a NaN must surface as a gate FAILURE,
+    not leak into JSON as a bare ``NaN`` token.
+    """
+    n = int(ref.shape[0])
+    got = np.empty(n)
+    for lo in range(0, n, int(chunk)):
+        hi = min(lo + int(chunk), n)
+        got[lo:hi] = np.asarray(run_chunk(lo, hi))[: hi - lo]
+    bad = ~np.isfinite(got)
+    if bad.any():
+        raise ValueError(
+            f"{int(bad.sum())}/{n} non-finite engine outputs over the "
+            "gate population"
+        )
+    nz = ref != 0.0
+    return float(np.max(np.abs(got[nz] / ref[nz] - 1.0)))
+
+
 def reference_ratios(grid, static, n_y: "int | None" = None) -> np.ndarray:
     """DM_over_B per point on the bit-reproducible NumPy reference path.
 

@@ -205,8 +205,12 @@ def main() -> None:
     gate_ref = reference_ratios(gate_pop.grid, static, n_y=n_y)
 
     def population_gate(impl: str, reduce=None) -> float:
-        """Max rel err of the benched engine over the audit population."""
+        """Max rel err of the benched engine over the audit population.
+
+        Raises ValueError on non-finite engine output (see
+        ``validation.population_max_rel`` — shared with the shootout)."""
         from bdlz_tpu.parallel.sweep import make_chunk_runner
+        from bdlz_tpu.validation import population_max_rel
 
         pad = ((n_gate + n_dev - 1) // n_dev) * n_dev
         fuse = os.environ.get("BDLZ_BENCH_FUSE_EXP", "0") == "1"
@@ -214,13 +218,7 @@ def main() -> None:
             gate_pop.grid, pad, static, mesh, sharding, table,
             impl=impl, n_y=n_y, fuse_exp=fuse, reduce=reduce,
         )
-        got = np.empty(n_gate)
-        for lo in range(0, n_gate, chunk_pop):
-            hi = min(lo + chunk_pop, n_gate)
-            # run_pop returns the PADDED chunk (device-multiple length)
-            got[lo:hi] = np.asarray(run_pop(lo, hi))[: hi - lo]
-        nz = gate_ref != 0.0
-        return float(np.max(np.abs(got[nz] / gate_ref[nz] - 1.0)))
+        return population_max_rel(run_pop, chunk_pop, gate_ref)
 
     # Implementation selection: the pallas MXU-interpolation kernel is the
     # fast path on real TPU hardware; fall back to the pure-XLA tabulated
@@ -263,9 +261,17 @@ def main() -> None:
             print(f"[bench] pallas path unavailable ({exc}); falling back",
                   file=sys.stderr)
             impl, run_chunk = "tabulated", None
+    gate_error = None
     if run_chunk is None:
         run_chunk = make_run_chunk(impl)
-        max_rel = max(accuracy_gate(run_chunk), population_gate(impl))
+        try:
+            max_rel = max(accuracy_gate(run_chunk), population_gate(impl))
+        except ValueError as exc:
+            # non-finite gate output on the LAST-RESORT engine: report
+            # the failure in-band (null rel err + gate_error) rather
+            # than dying without the driver-parsed final line
+            max_rel, gate_error = None, str(exc)
+            print(f"[bench] accuracy gate failed: {exc}", file=sys.stderr)
 
     # --- timed sweep over the full grid ---
     t0 = time.time()
@@ -407,7 +413,10 @@ def main() -> None:
                 "n_points": n_total,
                 "n_devices": n_dev,
                 "seconds": round(seconds, 3),
-                "rel_err_vs_reference": float(f"{max_rel:.3e}"),
+                "rel_err_vs_reference": (
+                    None if max_rel is None else float(f"{max_rel:.3e}")
+                ),
+                **({"gate_error": gate_error} if gate_error else {}),
                 "gate_points": n_gate,
                 "impl": impl,
                 # self-describing when the PALLAS path ran at an

@@ -27,6 +27,12 @@ def main() -> None:
     ap.add_argument("--points", type=int, default=65536)
     ap.add_argument("--chunk", type=int, default=8192)
     ap.add_argument("--n-y", type=int, default=8000, dest="n_y")
+    ap.add_argument("--gate-points", type=int, default=64, dest="gate_points",
+                    help="Audit-style adversarial population per engine "
+                         "(bdlz_tpu.validation; broad/deep-MB/clip/seam) "
+                         "for the per-engine accuracy column — the "
+                         "fuse_exp/table-layout A/B decisions need corner "
+                         "coverage, not 8 benign samples. 0 disables.")
     ap.add_argument(
         "--engines",
         default="tabulated,pallas,pallas+stream,pallas+fuse,pallas+fuse+stream",
@@ -89,6 +95,28 @@ def main() -> None:
         pp_i = type(pp_all)(*(float(np.asarray(f)[i]) for f in pp_all))
         ref[int(i)] = float(point_yields(pp_i, static, grid_np, np).DM_over_B)
 
+    # adversarial population gate (shared reference, evaluated per
+    # engine through the SAME loop as bench.py — validation.py owns it)
+    gate_pop = gate_ref = None
+    n_gate = max(0, int(args.gate_points))
+    if n_gate > 0:
+        from bdlz_tpu.validation import build_audit_population, reference_ratios
+
+        gate_pop = build_audit_population(base, n_gate, seed=1)
+        gate_ref = reference_ratios(gate_pop.grid, static, n_y=args.n_y)
+
+    def population_rel(impl, fuse, reduce):
+        """Max rel err of this engine over the audit population
+        (raises on non-finite output — recorded as gate_error)."""
+        from bdlz_tpu.validation import population_max_rel
+
+        pad = ((n_gate + n_dev - 1) // n_dev) * n_dev
+        run_pop, chunk_pop = make_chunk_runner(
+            gate_pop.grid, pad, static, mesh, sharding, table,
+            impl=impl, n_y=args.n_y, fuse_exp=fuse, reduce=reduce,
+        )
+        return population_max_rel(run_pop, chunk_pop, gate_ref)
+
     rows = []
     for engine in args.engines.split(","):
         engine = engine.strip()
@@ -142,6 +170,16 @@ def main() -> None:
                 # (incl. its explicit 8 leg)
                 **(pallas_evidence_row() if impl == "pallas" else {}),
             }
+            if n_gate > 0:
+                # a gate failure must not erase the timed row — stamp
+                # the error beside the timing instead
+                row["gate_points"] = n_gate
+                try:
+                    row["gate_max_rel_err"] = float(
+                        "%.3e" % population_rel(impl, fuse, reduce)
+                    )
+                except Exception as gexc:  # noqa: BLE001
+                    row["gate_error"] = f"{type(gexc).__name__}: {gexc}"
         except Exception as exc:  # noqa: BLE001 — report per-engine failure
             row = {"engine": engine, "platform": platform,
                    "error": f"{type(exc).__name__}: {exc}"}
