@@ -92,6 +92,14 @@ def build_audit_population(base, n: int, seed: int = 0) -> AuditPopulation:
     return AuditPopulation(grid=grid, axes=axes, counts=counts)
 
 
+class GateFailure(ValueError):
+    """An accuracy gate could not produce a trustworthy number.
+
+    A dedicated type so callers can report gate failures in-band
+    (null rel err + message) without also swallowing unrelated
+    ValueErrors from misconfigured grids."""
+
+
 def population_max_rel(run_chunk, chunk: int, ref: np.ndarray) -> float:
     """Max rel err of a chunk-runner over a gate population vs ``ref``.
 
@@ -100,9 +108,9 @@ def population_max_rel(run_chunk, chunk: int, ref: np.ndarray) -> float:
     ``run_chunk``/``chunk`` come from ``make_chunk_runner`` built over
     the population grid (the runner returns PADDED chunks); ``ref`` is
     the NumPy reference from :func:`reference_ratios`.  Non-finite
-    engine output raises ValueError — the adversarial corners exist to
-    smoke out exactly that, and a NaN must surface as a gate FAILURE,
-    not leak into JSON as a bare ``NaN`` token.
+    engine output raises :class:`GateFailure` — the adversarial corners
+    exist to smoke out exactly that, and a NaN must surface as a gate
+    FAILURE, not leak into JSON as a bare ``NaN`` token.
     """
     n = int(ref.shape[0])
     got = np.empty(n)
@@ -111,12 +119,39 @@ def population_max_rel(run_chunk, chunk: int, ref: np.ndarray) -> float:
         got[lo:hi] = np.asarray(run_chunk(lo, hi))[: hi - lo]
     bad = ~np.isfinite(got)
     if bad.any():
-        raise ValueError(
+        raise GateFailure(
             f"{int(bad.sum())}/{n} non-finite engine outputs over the "
             "gate population"
         )
     nz = ref != 0.0
+    if not nz.any():
+        raise GateFailure(
+            "gate population reference is identically zero — nothing to "
+            "compare (empty or degenerate population?)"
+        )
     return float(np.max(np.abs(got[nz] / ref[nz] - 1.0)))
+
+
+def engine_population_max_rel(
+    pop_grid, ref: np.ndarray, static, mesh, sharding, table,
+    *, impl: str, n_y: int, fuse_exp: bool = False, reduce=None,
+) -> float:
+    """Pad, build the engine's chunk runner over the population grid,
+    and measure :func:`population_max_rel` — runner construction AND
+    the loop in one place so the bench and the shootout cannot drift.
+    """
+    import jax
+
+    from bdlz_tpu.parallel.sweep import make_chunk_runner
+
+    n = int(ref.shape[0])
+    n_dev = len(jax.devices())
+    pad = ((n + n_dev - 1) // n_dev) * n_dev
+    run_pop, chunk_pop = make_chunk_runner(
+        pop_grid, pad, static, mesh, sharding, table,
+        impl=impl, n_y=n_y, fuse_exp=fuse_exp, reduce=reduce,
+    )
+    return population_max_rel(run_pop, chunk_pop, ref)
 
 
 def reference_ratios(grid, static, n_y: "int | None" = None) -> np.ndarray:

@@ -207,18 +207,15 @@ def main() -> None:
     def population_gate(impl: str, reduce=None) -> float:
         """Max rel err of the benched engine over the audit population.
 
-        Raises ValueError on non-finite engine output (see
-        ``validation.population_max_rel`` — shared with the shootout)."""
-        from bdlz_tpu.parallel.sweep import make_chunk_runner
-        from bdlz_tpu.validation import population_max_rel
+        Raises ``validation.GateFailure`` on non-finite engine output
+        (runner construction + loop shared with the shootout)."""
+        from bdlz_tpu.validation import engine_population_max_rel
 
-        pad = ((n_gate + n_dev - 1) // n_dev) * n_dev
         fuse = os.environ.get("BDLZ_BENCH_FUSE_EXP", "0") == "1"
-        run_pop, chunk_pop = make_chunk_runner(
-            gate_pop.grid, pad, static, mesh, sharding, table,
+        return engine_population_max_rel(
+            gate_pop.grid, gate_ref, static, mesh, sharding, table,
             impl=impl, n_y=n_y, fuse_exp=fuse, reduce=reduce,
         )
-        return population_max_rel(run_pop, chunk_pop, gate_ref)
 
     # Implementation selection: the pallas MXU-interpolation kernel is the
     # fast path on real TPU hardware; fall back to the pure-XLA tabulated
@@ -263,13 +260,17 @@ def main() -> None:
             impl, run_chunk = "tabulated", None
     gate_error = None
     if run_chunk is None:
+        from bdlz_tpu.validation import GateFailure
+
         run_chunk = make_run_chunk(impl)
         try:
             max_rel = max(accuracy_gate(run_chunk), population_gate(impl))
-        except ValueError as exc:
+        except GateFailure as exc:
             # non-finite gate output on the LAST-RESORT engine: report
             # the failure in-band (null rel err + gate_error) rather
-            # than dying without the driver-parsed final line
+            # than dying without the driver-parsed final line.  Only the
+            # dedicated type — a misconfigured grid should still die
+            # loudly, not emit a normal-looking metric line.
             max_rel, gate_error = None, str(exc)
             print(f"[bench] accuracy gate failed: {exc}", file=sys.stderr)
 

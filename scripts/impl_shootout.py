@@ -108,14 +108,12 @@ def main() -> None:
     def population_rel(impl, fuse, reduce):
         """Max rel err of this engine over the audit population
         (raises on non-finite output — recorded as gate_error)."""
-        from bdlz_tpu.validation import population_max_rel
+        from bdlz_tpu.validation import engine_population_max_rel
 
-        pad = ((n_gate + n_dev - 1) // n_dev) * n_dev
-        run_pop, chunk_pop = make_chunk_runner(
-            gate_pop.grid, pad, static, mesh, sharding, table,
+        return engine_population_max_rel(
+            gate_pop.grid, gate_ref, static, mesh, sharding, table,
             impl=impl, n_y=args.n_y, fuse_exp=fuse, reduce=reduce,
         )
-        return population_max_rel(run_pop, chunk_pop, gate_ref)
 
     rows = []
     for engine in args.engines.split(","):
@@ -186,16 +184,22 @@ def main() -> None:
         rows.append(row)
         print(json.dumps(row), flush=True)
 
-    print("\n| engine | pts/s/chip | rel err | seconds |")
-    print("|---|---|---|---|")
+    print("\n| engine | pts/s/chip | rel err | gate rel err | seconds |")
+    print("|---|---|---|---|---|")
     for r in rows:
         if "error" in r:
-            print(f"| {r['engine']} | FAILED: {r['error'][:60]} | — | — |")
+            print(f"| {r['engine']} | FAILED: {r['error'][:60]} | — | — | — |")
         else:
             err = r["max_rel_err_vs_reference"]
+            if "gate_error" in r:
+                gate = f"FAILED: {r['gate_error'][:40]}"
+            elif "gate_max_rel_err" in r:
+                gate = format(r["gate_max_rel_err"], ".2e")
+            else:
+                gate = "n/a"
             print(f"| {r['engine']} | {r['points_per_sec_per_chip']} "
                   f"| {'n/a' if err is None else format(err, '.2e')} "
-                  f"| {r['seconds']} |")
+                  f"| {gate} | {r['seconds']} |")
 
     # Exit status reflects data quality so callers (the evidence
     # collector's phase gates) can distinguish "timed rows collected"

@@ -1,0 +1,54 @@
+"""Worker for the divergent-kernel-knob fleet test.
+
+Launched twice by ``tests/test_multihost.py::test_divergent_kernel_knob_
+raises_fleetwide`` as ``python _mp_knob_worker.py <port> <process_id>``
+with DIFFERENT ``BDLZ_PALLAS_COL_BLOCK`` values per process.  Both
+processes must raise the fleet-uniformity RuntimeError from the sweep's
+startup agreement — one host raising while the other proceeds into a
+chunk collective would deadlock (which the parent's timeout converts
+into a failure).
+"""
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+
+def main() -> None:
+    port, pid = sys.argv[1], int(sys.argv[2])
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 2)
+    jax.config.update("jax_enable_x64", True)
+
+    from bdlz_tpu.parallel.multihost import init_multihost
+
+    assert init_multihost(f"localhost:{port}", 2, pid) is True
+
+    import numpy as np
+
+    from bdlz_tpu.config import config_from_dict, static_choices_from_config
+    from bdlz_tpu.parallel import make_mesh, run_sweep
+
+    base = config_from_dict({
+        "regime": "nonthermal", "P_chi_to_B": 0.149,
+        "Y_chi_init": 4.90e-10,
+    })
+    static = static_choices_from_config(base)
+    axes = {"m_chi_GeV": np.geomspace(0.5, 2.0, 4).tolist()}
+    try:
+        run_sweep(
+            base, axes, static, mesh=make_mesh(shape=(4, 1)),
+            chunk_size=4, n_y=2000, impl="pallas", interpret=True,
+        )
+    except RuntimeError as exc:
+        assert "BDLZ_PALLAS_COL_BLOCK differs across hosts" in str(exc), exc
+        print(f"worker {pid} KNOB-MISMATCH-RAISED")
+        return
+    raise AssertionError("divergent knob did not raise")
+
+
+if __name__ == "__main__":
+    main()

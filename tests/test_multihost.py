@@ -185,3 +185,41 @@ def test_two_process_mcmc(tmp_path):
     assert seg_files == [
         "manifest.json", "seg_00000.npz", "seg_00001.npz", "seg_00002.npz",
     ]
+
+
+def test_divergent_kernel_knob_raises_fleetwide(tmp_path):
+    """A per-host BDLZ_PALLAS_COL_BLOCK divergence must raise the
+    startup-agreement RuntimeError on BOTH processes (r4: the knob keys
+    the kernel's numerics and the grid hash; one host raising while the
+    other entered a chunk collective would deadlock — the parent's
+    timeout converts that into a failure)."""
+    port = _free_port()
+    worker = os.path.join(os.path.dirname(__file__), "_mp_knob_worker.py")
+
+    base_env = dict(os.environ)
+    base_env["PALLAS_AXON_POOL_IPS"] = ""
+    for k in ("XLA_FLAGS", "JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES",
+              "JAX_PROCESS_ID"):
+        base_env.pop(k, None)
+
+    procs = []
+    for pid, cb in ((0, "8"), (1, "16")):
+        env = dict(base_env)
+        env["BDLZ_PALLAS_COL_BLOCK"] = cb
+        procs.append(subprocess.Popen(
+            [sys.executable, worker, str(port), str(pid)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True,
+        ))
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=420)
+            outs.append((p.returncode, out, err))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for rc, out, err in outs:
+        assert rc == 0, f"worker failed (rc={rc}):\n{out}\n{err}"
+        assert "KNOB-MISMATCH-RAISED" in out
