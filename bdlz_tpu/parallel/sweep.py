@@ -29,7 +29,7 @@ from typing import Any, Dict, Mapping, Optional, Sequence
 import numpy as np
 
 from bdlz_tpu.config import Config, PointParams, StaticChoices, point_params_from_config
-from bdlz_tpu.constants import GEV_TO_KG, M_PROTON_KG
+from bdlz_tpu.constants import GEV_TO_KG
 
 #: Config-key → PointParams-field mapping for sweep axes (JSON-schema names
 #: on the left, the internal dynamic-parameter names on the right).
@@ -236,7 +236,7 @@ def make_sweep_step(
     if mesh is None:
         return jax.jit(batched)
 
-    from bdlz_tpu.parallel.mesh import batch_sharding, replicated_sharding
+    from bdlz_tpu.parallel.mesh import batch_sharding
 
     return jax.jit(
         batched,

@@ -17,7 +17,6 @@ Statistics strings follow the reference convention: anything starting with
 """
 from __future__ import annotations
 
-import math
 from typing import Any
 
 from bdlz_tpu.constants import MPL_GEV, PI, ZETA3

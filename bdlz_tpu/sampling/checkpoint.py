@@ -22,7 +22,7 @@ from __future__ import annotations
 import hashlib
 import json
 import os
-from typing import Callable, NamedTuple, Optional
+from typing import Callable, NamedTuple
 
 import numpy as np
 
@@ -92,7 +92,7 @@ def run_ensemble_checkpointed(
     import jax.numpy as jnp
 
     from bdlz_tpu.parallel.multihost import gather_to_host, is_coordinator
-    from bdlz_tpu.sampling.ensemble import EnsembleState, run_ensemble
+    from bdlz_tpu.sampling.ensemble import run_ensemble
 
     coordinator = is_coordinator()
 

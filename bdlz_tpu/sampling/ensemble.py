@@ -14,7 +14,7 @@ propose Y = X_j + z (X_k − X_j), accept with log-probability
 """
 from __future__ import annotations
 
-from typing import Callable, NamedTuple, Optional
+from typing import Callable, NamedTuple
 
 import jax
 import jax.numpy as jnp

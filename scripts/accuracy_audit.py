@@ -136,7 +136,6 @@ def main() -> None:
     if platform != "cpu":
         from bdlz_tpu.ops.kjma_pallas import (
             build_shifted_table,
-            integrate_YB_pallas,
             pallas_preflight,
             point_yields_pallas,
         )

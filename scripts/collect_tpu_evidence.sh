@@ -95,6 +95,8 @@ EOF
       || continue
   phase audit 3600 python scripts/accuracy_audit.py --points 1024 || continue
   phase profile 1800 python scripts/pallas_profile.py --points 8192 || continue
+  phase profile-split3 1800 env BDLZ_PALLAS_TABLE_SPLIT3=1 \
+      python scripts/pallas_profile.py --points 8192 || continue
   phase colblock 2400 bash -c '
     any_ok=0
     for cb in 8 16 32; do

@@ -122,9 +122,15 @@ def main() -> None:
 
     rows = []
 
+    from bdlz_tpu.ops.kjma_pallas import pallas_evidence_row
+
     def report(name, seconds):
         row = {"stage": name, "seconds": round(seconds, 4),
-               "points_per_sec": round(P / seconds, 1), "platform": platform}
+               "points_per_sec": round(P / seconds, 1), "platform": platform,
+               # label kernel-variant legs (the collector's split3 /
+               # COL_BLOCK phases) so rows are attributable without
+               # parsing the surrounding log banners
+               **pallas_evidence_row()}
         rows.append(row)
         print(json.dumps(row), flush=True)
 
