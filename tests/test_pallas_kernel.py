@@ -383,3 +383,36 @@ def test_split3_kernel_matches_f32_kernel(setup):
                 interpret=True, fuse_exp=fuse, reduce=reduce,
             ))
             np.testing.assert_allclose(b, a, rtol=1e-12)
+
+
+def test_row_select_contraction_precision_pinned():
+    """The f32-layout one-hot dot must stage with Precision.HIGHEST.
+
+    Load-bearing for hardware only: Mosaic's DEFAULT contract precision
+    may demote f32 operands to one bf16 MXU pass (~4e-3 rel err), but
+    CPU dots are exact at any setting — a regression here would pass
+    every interpret-mode accuracy test and only fail on the chip, so
+    the pin is asserted at the jaxpr level.  The bf16x3 layout's dots
+    intentionally stay at DEFAULT (single pass per exact piece).
+    """
+    from bdlz_tpu.ops import kjma_pallas as kp
+    from bdlz_tpu.ops.kjma_pallas import LANES, ROWS, STENCIL_ROWS
+
+    subl = jax.lax.broadcasted_iota(jnp.int32, (ROWS, LANES), 0)
+    i1t = jnp.ones((8, LANES), jnp.int32)
+    st = jnp.zeros((8, LANES), jnp.float32)
+
+    def precisions(t4t):
+        jaxpr = jax.make_jaxpr(
+            lambda a, b, c, d: kp._interp_column(a, b, c, d, 0)
+        )(t4t, subl, i1t, st)
+        return [e.params.get("precision") for e in jaxpr.jaxpr.eqns
+                if e.primitive.name == "dot_general"]
+
+    f32_prec = precisions(jnp.zeros((STENCIL_ROWS, ROWS), jnp.float32))
+    assert f32_prec == [(jax.lax.Precision.HIGHEST,) * 2], f32_prec
+
+    s3_prec = precisions(jnp.zeros((3 * STENCIL_ROWS, ROWS), jnp.bfloat16))
+    assert len(s3_prec) == 3, s3_prec
+    assert all(p is None or p == (jax.lax.Precision.DEFAULT,) * 2
+               for p in s3_prec), s3_prec
