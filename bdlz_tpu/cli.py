@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import argparse
 import math
+import sys
 from typing import Optional
 
 import numpy as np
@@ -122,9 +123,14 @@ def resolve_P(
         if reference_shaped:
             P_ext, ext_mod = try_external_P_from_profile(profile_csv, cfg.v_w)
             if P_ext is not None:
+                # attribution goes to stderr: in this invocation shape the
+                # reference's maybe_P prints exactly one stdout line
+                # (reference :317-328), and stdout byte parity is the
+                # contract (ADVICE r4)
                 print(
                     f"[info] external LZ module {ext_mod!r} provided P "
-                    "(reference dynamic-import hook)"
+                    "(reference dynamic-import hook)",
+                    file=sys.stderr,
                 )
                 print(f"[info] Using P_chi_to_B from profile: {P_ext:.6g}")
                 return float(P_ext)

@@ -309,10 +309,16 @@ def _clamp_chunk_to_memory(chunk_size: int, n_y: int, mesh, impl: str) -> int:
 
 # Wire codes for the fleet tier agreement (allreduce_min over hosts):
 # ordered so that min() picks the most conservative outcome.  -2 = local
-# preflight failed entirely (fails the whole fleet together); -1 = no
-# hardware preflight (cpu/interpret: kernel default, fleet-uniform);
-# 0 = streaming tier; 1 = in-kernel Kahan reduction tier.
-_TIER_CODE = {None: -1, False: 0, True: 1}
+# preflight failed entirely (fails the whole fleet together); 0 =
+# streaming tier (hardware-proven downgrade); 1 = in-kernel Kahan
+# reduction tier (hardware-proven); 2 = no hardware preflight
+# (cpu/interpret resolves to the kernel default).  "No preflight" sits
+# ABOVE both hardware tiers (ADVICE r4): if a fleet ever mixed
+# preflighted and non-preflighted processes, min() must pick the
+# hardware-proven tier, never the unproven default.  (Today the mix is
+# unreachable — jax.devices()[0].platform is fleet-global — but the
+# encoding should not contradict its own invariant.)
+_TIER_CODE = {False: 0, True: 1, None: 2}
 _TIER_FROM_CODE = {code: tier for tier, code in _TIER_CODE.items()}
 _TIER_FAILED = -2
 
@@ -596,7 +602,7 @@ def run_sweep(
                         f"max {int(_hi)}; this host {_local}); set one "
                         "value fleet-wide"
                     )
-            _tier_code = -1  # non-hardware: kernel default everywhere
+            _tier_code = _TIER_CODE[None]  # non-hardware: kernel default
             _tier_msg = "no hardware preflight (cpu/interpret)"
             if not interpret and jax.devices()[0].platform != "cpu":
                 # Hardware preflight at the sweep's OWN shapes (lowering
@@ -630,7 +636,7 @@ def run_sweep(
                 )
             pallas_reduce = _TIER_FROM_CODE[_tier_code]
             _agreed_ok, _agreed_msg = 1, "validated by local resolution"
-            if _local_code > 0 and _tier_code != _local_code:
+            if _local_code > _tier_code:
                 # Another host downgraded the fleet to a tier this host's
                 # resolver short-circuited past without preflighting —
                 # validate it here so a mid-sweep Mosaic failure cannot

@@ -129,6 +129,27 @@ def population_max_rel(run_chunk, chunk: int, ref: np.ndarray) -> float:
             "gate population reference is identically zero — nothing to "
             "compare (empty or degenerate population?)"
         )
+    # ref==0 points can't contribute a relative error, but silently
+    # dropping them would let an engine emit a large finite value at a
+    # zero-reference point and still pass (ADVICE r4).  Hold them to an
+    # absolute tolerance scaled to the population's magnitude instead.
+    n_zero = int(n - nz.sum())
+    if n_zero:
+        abs_tol = 1e-6 * float(np.max(np.abs(ref)))
+        worst = float(np.max(np.abs(got[~nz])))
+        if worst > abs_tol:
+            raise GateFailure(
+                f"engine output {worst:.3e} at a zero-reference point "
+                f"exceeds the absolute tolerance {abs_tol:.3e} "
+                f"({n_zero}/{n} ref==0 points)"
+            )
+        import sys
+
+        print(
+            f"[gate] {n_zero}/{n} ref==0 points held to |got| <= "
+            f"{abs_tol:.3e} (max {worst:.3e}); excluded from max-rel",
+            file=sys.stderr, flush=True,
+        )
     return float(np.max(np.abs(got[nz] / ref[nz] - 1.0)))
 
 

@@ -73,13 +73,13 @@ def test_template_extensions_opt_in(tmp_path):
     assert load_config(str(path)) == Config()
 
 
-@pytest.mark.skipif(
-    not __import__("pathlib").Path("/root/reference").exists(),
-    reason="reference snapshot not mounted",
-)
 def test_template_byte_parity_with_reference_script(tmp_path):
-    """Run the actual reference --write-template; ours must produce the
-    byte-identical file and stdout (reference :309-312, :356-357)."""
+    """--write-template must produce the byte-identical file and stdout
+    (reference :309-312, :356-357) — compared against the pinned fixture
+    by default (tests/fixtures/reference_parity/), so the default suite
+    never executes the untrusted snapshot (ADVICE r4); set
+    BDLZ_RUN_REFERENCE_SUBPROCESS=1 to also run the live reference and
+    re-certify the fixture."""
     import os
     import pathlib
     import subprocess
@@ -88,11 +88,18 @@ def test_template_byte_parity_with_reference_script(tmp_path):
     env = {k: v for k, v in os.environ.items()
            if not k.startswith(("JAX_", "XLA_"))}
     repo_root = pathlib.Path(__file__).resolve().parents[1]
-    outputs = {}
-    for tag, script in (
-        ("ref", "/root/reference/first_principles_yields.py"),
-        ("ours", str(repo_root / "first_principles_yields.py")),
-    ):
+    fix_dir = pathlib.Path(__file__).resolve().parent / "fixtures" / "reference_parity"
+    expected = ((fix_dir / "template.stdout.txt").read_text(),
+                (fix_dir / "template.json").read_bytes())
+
+    scripts = [("ours", str(repo_root / "first_principles_yields.py"))]
+    if os.environ.get("BDLZ_RUN_REFERENCE_SUBPROCESS") == "1":
+        assert pathlib.Path("/root/reference").exists(), (
+            "BDLZ_RUN_REFERENCE_SUBPROCESS=1 but /root/reference is not "
+            "mounted — live re-certification cannot run"
+        )
+        scripts.append(("ref", "/root/reference/first_principles_yields.py"))
+    for tag, script in scripts:
         d = tmp_path / tag
         d.mkdir()
         r = subprocess.run(
@@ -101,8 +108,7 @@ def test_template_byte_parity_with_reference_script(tmp_path):
             cwd=d, capture_output=True, text=True, env=env, timeout=300,
         )
         assert r.returncode == 0, r.stderr
-        outputs[tag] = (r.stdout, (d / "t.json").read_bytes())
-    assert outputs["ours"] == outputs["ref"]
+        assert (r.stdout, (d / "t.json").read_bytes()) == expected, tag
 
 
 def test_regime_auto_rejected_on_quadrature_path():

@@ -125,10 +125,12 @@ class TestResolvePIntegration:
                 return 0.321
         """)
         P = resolve_P(_cfg(), "prof.csv")
-        out = capsys.readouterr().out
+        captured = capsys.readouterr()
         assert P == pytest.approx(0.321)
-        assert "Using P_chi_to_B from profile: 0.321" in out
-        assert "transport_from_profile" in out
+        # stdout carries EXACTLY the reference's single maybe_P line
+        # (byte parity, ADVICE r4); the module attribution goes to stderr
+        assert captured.out == "[info] Using P_chi_to_B from profile: 0.321\n"
+        assert "transport_from_profile" in captured.err
 
     def test_explicit_estimator_skips_hook(self, modpath, tmp_path, capsys):
         # Documented divergence: --lz-method selects the in-repo kernel.

@@ -1,6 +1,12 @@
 """Golden-output tests (SURVEY §4.1): the NumPy backend must reproduce the
-archived benchmark numbers, and — when the reference snapshot is mounted —
-match the actual reference script's stdout and yields_out.json byte-for-byte.
+archived benchmark numbers and match the reference script's stdout and
+yields_out.json byte-for-byte.
+
+The reference outputs are pinned as checked-in fixtures under
+``tests/fixtures/reference_parity/`` (captured once from the snapshot), so
+the default suite never EXECUTES the untrusted ``/root/reference`` script
+(ADVICE r4).  Set ``BDLZ_RUN_REFERENCE_SUBPROCESS=1`` to additionally run
+the live reference and re-verify the fixtures against it.
 """
 import json
 import os
@@ -26,6 +32,31 @@ GOLDEN_RATIO = 5.6889263349
 
 REFERENCE_DIR = pathlib.Path("/root/reference")
 REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
+FIXTURE_DIR = pathlib.Path(__file__).resolve().parent / "fixtures" / "reference_parity"
+
+#: Opt-in for executing the untrusted reference snapshot as a subprocess
+#: (off by default — the pinned fixtures carry the parity contract).
+RUN_REFERENCE = os.environ.get("BDLZ_RUN_REFERENCE_SUBPROCESS") == "1"
+if RUN_REFERENCE and not REFERENCE_DIR.exists():
+    # fail loudly rather than silently degrading to fixture-only: the
+    # operator asked for live re-certification
+    raise RuntimeError(
+        "BDLZ_RUN_REFERENCE_SUBPROCESS=1 but /root/reference is not "
+        "mounted — live re-certification cannot run"
+    )
+
+
+def _run_pipeline(script, config_path, cwd):
+    """Run a yields pipeline script with --diagnostics; return (stdout, out_dict)."""
+    env = {k: v for k, v in os.environ.items() if not k.startswith(("JAX_", "XLA_"))}
+    r = subprocess.run(
+        [sys.executable, str(script), "--config", str(config_path),
+         "--diagnostics"],
+        cwd=cwd, capture_output=True, text=True, env=env, timeout=300,
+    )
+    assert r.returncode == 0, (script, r.stderr)
+    out = json.loads((pathlib.Path(cwd) / "yields_out.json").read_text())
+    return r.stdout, out
 
 
 def test_numpy_backend_reproduces_archived_numbers(benchmark_config_path):
@@ -42,36 +73,35 @@ def test_numpy_backend_reproduces_archived_numbers(benchmark_config_path):
     assert float(result.rho_DM_kg_m3) == pytest.approx(2.399e-27, rel=1e-3)
 
 
-@pytest.mark.skipif(not REFERENCE_DIR.exists(), reason="reference snapshot not mounted")
 def test_bit_parity_with_reference_script(benchmark_config_path, tmp_path):
-    """Run the actual reference pipeline and our CLI side by side; stdout
-    and yields_out.json must match byte-for-byte on the NumPy backend."""
-    env = {k: v for k, v in os.environ.items() if not k.startswith(("JAX_", "XLA_"))}
-
-    ref_dir = tmp_path / "ref"
-    ref_dir.mkdir()
-    ref = subprocess.run(
-        [sys.executable, str(REFERENCE_DIR / "first_principles_yields.py"),
-         "--config", benchmark_config_path, "--diagnostics"],
-        cwd=ref_dir, capture_output=True, text=True, env=env, timeout=300,
-    )
-    assert ref.returncode == 0, ref.stderr
-
+    """Our CLI's stdout and yields_out.json must match the reference
+    byte-for-byte on the NumPy backend — compared against the pinned
+    fixture by default; against the live reference script too under
+    BDLZ_RUN_REFERENCE_SUBPROCESS=1."""
     ours_dir = tmp_path / "ours"
     ours_dir.mkdir()
-    ours = subprocess.run(
-        [sys.executable, str(REPO_ROOT / "first_principles_yields.py"),
-         "--config", benchmark_config_path, "--diagnostics"],
-        cwd=ours_dir, capture_output=True, text=True, env=env, timeout=300,
+    our_stdout, our_out = _run_pipeline(
+        REPO_ROOT / "first_principles_yields.py", benchmark_config_path,
+        ours_dir,
     )
-    assert ours.returncode == 0, ours.stderr
 
-    assert ours.stdout == ref.stdout
+    fix_stdout = (FIXTURE_DIR / "benchmark.stdout.txt").read_text()
+    fix_out = json.loads((FIXTURE_DIR / "benchmark.yields_out.json").read_text())
+    assert our_stdout == fix_stdout
+    assert our_out["final"] == fix_out["final"]
+    assert our_out["inputs"] == fix_out["inputs"]
 
-    ref_out = json.loads((ref_dir / "yields_out.json").read_text())
-    our_out = json.loads((ours_dir / "yields_out.json").read_text())
-    assert our_out["final"] == ref_out["final"]
-    assert our_out["inputs"] == ref_out["inputs"]
+    if RUN_REFERENCE:
+        ref_dir = tmp_path / "ref"
+        ref_dir.mkdir()
+        ref_stdout, ref_out = _run_pipeline(
+            REFERENCE_DIR / "first_principles_yields.py",
+            benchmark_config_path, ref_dir,
+        )
+        # live reference also re-certifies the fixture isn't stale
+        assert ref_stdout == fix_stdout
+        assert ref_out["final"] == fix_out["final"]
+        assert ref_out["inputs"] == fix_out["inputs"]
 
 
 #: Non-default parameter points for the broadened parity sweep: each
@@ -99,32 +129,31 @@ PARITY_VARIANTS = {
 }
 
 
-@pytest.mark.skipif(not REFERENCE_DIR.exists(), reason="reference snapshot not mounted")
 @pytest.mark.parametrize("name", sorted(PARITY_VARIANTS))
 def test_bit_parity_across_config_variants(name, tmp_path):
-    """Byte parity with the actual reference script must hold across the
-    pipeline's branches, not just at the archived benchmark point."""
-    env = {k: v for k, v in os.environ.items() if not k.startswith(("JAX_", "XLA_"))}
+    """Byte parity with the reference must hold across the pipeline's
+    branches, not just at the archived benchmark point — fixtures by
+    default, live reference under BDLZ_RUN_REFERENCE_SUBPROCESS=1."""
     cfg_path = tmp_path / "cfg.json"
     cfg_path.write_text(json.dumps({"regime": "nonthermal",
                                     **PARITY_VARIANTS[name]}))
 
-    dirs = {}
-    for label, script in (
-        ("ref", REFERENCE_DIR / "first_principles_yields.py"),
-        ("ours", REPO_ROOT / "first_principles_yields.py"),
-    ):
-        d = tmp_path / label
-        d.mkdir()
-        r = subprocess.run(
-            [sys.executable, str(script), "--config", str(cfg_path),
-             "--diagnostics"],
-            cwd=d, capture_output=True, text=True, env=env, timeout=300,
-        )
-        assert r.returncode == 0, (label, r.stderr)
-        dirs[label] = (d, r.stdout)
+    ours_dir = tmp_path / "ours"
+    ours_dir.mkdir()
+    our_stdout, our_out = _run_pipeline(
+        REPO_ROOT / "first_principles_yields.py", cfg_path, ours_dir,
+    )
 
-    assert dirs["ours"][1] == dirs["ref"][1]
-    ref_out = json.loads((dirs["ref"][0] / "yields_out.json").read_text())
-    our_out = json.loads((dirs["ours"][0] / "yields_out.json").read_text())
-    assert our_out == ref_out
+    fix_stdout = (FIXTURE_DIR / f"{name}.stdout.txt").read_text()
+    fix_out = json.loads((FIXTURE_DIR / f"{name}.yields_out.json").read_text())
+    assert our_stdout == fix_stdout
+    assert our_out == fix_out
+
+    if RUN_REFERENCE:
+        ref_dir = tmp_path / "ref"
+        ref_dir.mkdir()
+        ref_stdout, ref_out = _run_pipeline(
+            REFERENCE_DIR / "first_principles_yields.py", cfg_path, ref_dir,
+        )
+        assert ref_stdout == fix_stdout
+        assert ref_out == fix_out

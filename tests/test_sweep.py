@@ -302,6 +302,29 @@ def test_pallas_tier_resolver_degrades(monkeypatch):
     assert tier3 is None
 
 
+def test_tier_wire_codes_min_is_conservative():
+    """The fleet-agreement encoding's invariant: min() over any mix of
+    wire codes picks the most conservative outcome.  In particular 'no
+    hardware preflight' (kernel default) must sort ABOVE both
+    hardware-proven tiers, so a hypothetical mixed fleet lands on the
+    proven tier, never the unproven default (ADVICE r4)."""
+    from bdlz_tpu.parallel.sweep import (
+        _TIER_CODE, _TIER_FAILED, _TIER_FROM_CODE,
+    )
+
+    assert _TIER_FAILED < min(_TIER_CODE.values())
+    assert _TIER_CODE[None] > _TIER_CODE[True] > _TIER_CODE[False]
+    # round-trip
+    for tier, code in _TIER_CODE.items():
+        assert _TIER_FROM_CODE[code] is tier
+    # mixed fleets: hardware-proven beats no-preflight; streaming beats
+    # reduction; failure beats everything
+    assert _TIER_FROM_CODE[min(_TIER_CODE[None], _TIER_CODE[True])] is True
+    assert _TIER_FROM_CODE[min(_TIER_CODE[None], _TIER_CODE[False])] is False
+    assert _TIER_FROM_CODE[min(_TIER_CODE[True], _TIER_CODE[False])] is False
+    assert min(_TIER_FAILED, *_TIER_CODE.values()) == _TIER_FAILED
+
+
 def test_resume_invalidated_by_pallas_knob_change(base_cfg, mesh8, tmp_path):
     """Pallas kernel knobs (fuse_exp; the in-kernel reduce default) join
     the resume identity: results differ at ~1e-7 between kernel variants,

@@ -1,0 +1,61 @@
+"""Unit tests for the shared accuracy-gate loop (bdlz_tpu/validation.py).
+
+The gate is the one place both measurement tools (bench.py and
+scripts/impl_shootout.py) compute their max-rel-err number, so its corner
+behavior — non-finite outputs, zero-reference points (ADVICE r4) — is
+pinned here directly with synthetic chunk runners.
+"""
+import numpy as np
+import pytest
+
+from bdlz_tpu.validation import GateFailure, population_max_rel
+
+
+def _runner(values):
+    values = np.asarray(values, dtype=float)
+
+    def run_chunk(lo, hi):
+        return values[lo:hi]
+
+    return run_chunk
+
+
+def test_max_rel_over_plain_population():
+    ref = np.array([1.0, 2.0, -4.0])
+    got = ref * np.array([1.0, 1.0 + 3e-7, 1.0 - 1e-6])
+    rel = population_max_rel(_runner(got), 2, ref)
+    assert rel == pytest.approx(1e-6, rel=1e-6)
+
+
+def test_nonfinite_engine_output_raises():
+    ref = np.ones(4)
+    got = np.array([1.0, np.nan, 1.0, np.inf])
+    with pytest.raises(GateFailure, match="2/4 non-finite"):
+        population_max_rel(_runner(got), 4, ref)
+
+
+def test_all_zero_reference_raises():
+    with pytest.raises(GateFailure, match="identically zero"):
+        population_max_rel(_runner(np.zeros(3)), 3, np.zeros(3))
+
+
+def test_ref_zero_points_held_to_abs_tol(capsys):
+    """ref==0 points are excluded from max-rel but bounded by an absolute
+    tolerance scaled to the population magnitude (1e-6 * max|ref|); the
+    exclusion count is logged to stderr, keeping stdout JSON-clean."""
+    ref = np.array([10.0, 0.0, -5.0, 0.0])
+    got = np.array([10.0, 5e-6, -5.0 * (1 + 2e-7), -4e-6])
+    rel = population_max_rel(_runner(got), 2, ref)
+    assert rel == pytest.approx(2e-7, rel=1e-6)
+    captured = capsys.readouterr()
+    assert captured.out == ""
+    assert "2/4 ref==0 points" in captured.err
+
+
+def test_ref_zero_point_with_large_engine_value_fails():
+    """A large finite engine value at a zero-reference point must FAIL the
+    gate, not be silently dropped (ADVICE r4)."""
+    ref = np.array([10.0, 0.0, -5.0])
+    got = np.array([10.0, 0.5, -5.0])
+    with pytest.raises(GateFailure, match="zero-reference point"):
+        population_max_rel(_runner(got), 3, ref)
