@@ -35,7 +35,8 @@ class ProfileError(ValueError):
 
 def _read_csv(path: str):
     """(column_names, data[rows, cols]) — native C++ parser when available
-    (bdlz_tpu.native, ~40× faster on large profiles), NumPy otherwise."""
+    (bdlz_tpu.native, ~6× faster on million-row profiles — measured in
+    scripts/lz_scale_bench.py), NumPy otherwise."""
     try:
         from bdlz_tpu.native import NativeParseError, read_csv_native
 

@@ -1,0 +1,137 @@
+#!/usr/bin/env python3
+"""Design-scale LZ ingestion + kernel benchmark (VERDICT r4 ask #7).
+
+Real bounce-solver profiles run to millions of ξ-samples (paper §6.1/§10);
+this records that the framework's full profile→P path completes with
+bounded memory at that scale, and what it costs:
+
+  1. write a ≥1e6-row profile CSV;
+  2. parse it (native C++ parser; ``--numpy-compare`` adds the NumPy
+     fallback's time on the same file for the speedup ratio);
+  3. coherent transfer-matrix P for a speed batch over all ~1e6 segments
+     (memory-bounded speed chunking, BDLZ_LZ_SPEED_CHUNK_BYTES);
+  4. a coherent P(v_w) table build at ``--table-n`` nodes through the
+     same chunked path (the MCMC's in-jit bridge).
+
+Prints one JSON line per phase (peak RSS included). CPU-safe: forces the
+host platform unless --tpu is passed (the kernel is pure VPU work; the
+relay-outage environment makes CPU the dependable default here).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import resource
+import sys
+import time
+
+# runnable as `python scripts/lz_scale_bench.py` from anywhere even
+# though bdlz_tpu is not pip-installed (sys.path[0] is scripts/)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def rss_mb() -> int:
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss // 1024
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=1_000_001)
+    ap.add_argument("--speeds", type=int, default=64)
+    ap.add_argument("--table-n", type=int, default=256)
+    ap.add_argument("--numpy-compare", action="store_true",
+                    help="also time the NumPy CSV fallback (slow)")
+    ap.add_argument("--tpu", action="store_true",
+                    help="let jax pick the accelerator (default: force CPU)")
+    args = ap.parse_args()
+
+    import numpy as np
+
+    if not args.tpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+
+    from bdlz_tpu.lz.profile import BounceProfile, load_profile_csv
+    from bdlz_tpu.lz.sweep_bridge import (
+        make_P_of_vw_table,
+        probabilities_for_points,
+    )
+
+    n = int(args.rows)
+    xi = np.linspace(-300.0, 300.0, n)
+    delta = -0.08 * np.tanh(xi / 4.0)
+    mix = np.full(n, 0.02)
+
+    import tempfile
+
+    with tempfile.NamedTemporaryFile("w", suffix=".csv", delete=False) as f:
+        path = f.name
+        f.write("xi,delta,m_mix\n")
+        np.savetxt(f, np.column_stack([xi, delta, mix]), delimiter=",")
+
+    # --- parse ---
+    t0 = time.time()
+    prof = load_profile_csv(path)
+    t_native = time.time() - t0
+    row = {
+        "phase": "parse", "rows": n, "native_seconds": round(t_native, 3),
+        "rss_mb": rss_mb(),
+    }
+    if args.numpy_compare:
+        from bdlz_tpu.lz import profile as profile_mod
+
+        real_read = profile_mod._read_csv
+
+        def numpy_read(p):
+            data = np.genfromtxt(p, delimiter=",", names=True, dtype=float)
+            names = list(data.dtype.names)
+            return names, np.column_stack([data[c] for c in names])
+
+        profile_mod._read_csv = numpy_read
+        try:
+            t0 = time.time()
+            prof_np = profile_mod.load_profile_csv(path)
+            t_numpy = time.time() - t0
+        finally:
+            profile_mod._read_csv = real_read
+        np.testing.assert_allclose(prof_np.xi, prof.xi, rtol=1e-15)
+        row["numpy_seconds"] = round(t_numpy, 3)
+        row["native_speedup"] = round(t_numpy / t_native, 1)
+    print(json.dumps(row), flush=True)
+
+    prof = BounceProfile(xi=prof.xi, delta=prof.delta, mix=prof.mix)
+
+    # --- coherent kernel over the full profile ---
+    v = np.linspace(0.05, 0.9, int(args.speeds))
+    t0 = time.time()
+    P = probabilities_for_points(prof, v, method="coherent")
+    t_coh = time.time() - t0
+    print(json.dumps({
+        "phase": "coherent", "segments": n - 1, "speeds": len(v),
+        "seconds": round(t_coh, 2),
+        "speeds_per_sec": round(len(v) / t_coh, 2),
+        "finite": bool(np.isfinite(P).all()),
+        "P_range": [float(P.min()), float(P.max())],
+        "rss_mb": rss_mb(),
+    }), flush=True)
+
+    # --- P(v_w) table build (the MCMC bridge) ---
+    t0 = time.time()
+    table = make_P_of_vw_table(prof, "coherent", 0.05, 0.9, n=args.table_n)
+    t_tab = time.time() - t0
+    vals = np.asarray(table.values)
+    print(json.dumps({
+        "phase": "ptable", "segments": n - 1, "nodes": int(args.table_n),
+        "seconds": round(t_tab, 2),
+        "finite": bool(np.isfinite(vals).all()),
+        "rss_mb": rss_mb(),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
