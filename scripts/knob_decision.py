@@ -1,0 +1,118 @@
+#!/usr/bin/env python3
+"""Turn collected hardware A/B rows into ONE production kernel config.
+
+VERDICT r4 ask #2: the pallas kernel grew three knobs (fuse_exp, the
+bf16x3 masked-split table, COL_BLOCK) plus the reduce/stream tier without
+a single hardware data point.  This script reads the evidence collector's
+log (`scripts/collect_tpu_evidence.sh` >> /tmp/evidence_r5.log), pulls
+every shootout/bench JSON row, and prints:
+
+  1. the full measured variant table (throughput + gate error), and
+  2. the recommended defaults — fastest variant whose adversarial gate
+     error stays ≤ 1e-6 — as concrete `ops/kjma_pallas.py` constants and
+     a ready-to-paste perf_notes decision table.
+
+Rows are matched on TPU platform only (CPU/interpret rows are listed but
+never drive a decision).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def parse_rows(path: str):
+    rows = []
+    with open(path, errors="replace") as f:
+        for line in f:
+            line = line.strip()
+            if not (line.startswith("{") and line.endswith("}")):
+                continue
+            try:
+                d = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(d, dict) and ("engine" in d or "metric" in d):
+                rows.append(d)
+    return rows
+
+
+def variant_key(r) -> str:
+    k = r.get("engine", r.get("impl", "?"))
+    if r.get("pallas_col_block") is not None:
+        k += f" cb={r['pallas_col_block']}"
+    if r.get("pallas_table_split3"):
+        k += " bf16x3"
+    return k
+
+
+def gate_err(r):
+    for key in ("gate_max_rel_err", "max_rel_err_vs_reference",
+                "rel_err_vs_reference"):
+        if r.get(key) is not None:
+            return float(r[key])
+    return None
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--log", default="/tmp/evidence_r5.log")
+    ap.add_argument("--contract", type=float, default=1e-6)
+    args = ap.parse_args()
+
+    rows = parse_rows(args.log)
+    engine_rows = [r for r in rows if "engine" in r and "error" not in r]
+    tpu_rows = [r for r in engine_rows if r.get("platform") == "tpu"]
+    failed = [r for r in rows if "engine" in r and "error" in r]
+
+    print(f"# parsed {len(rows)} JSON rows from {args.log}: "
+          f"{len(engine_rows)} timed ({len(tpu_rows)} on tpu), "
+          f"{len(failed)} failed\n")
+
+    if engine_rows:
+        print("| variant | platform | pts/s/chip | gate rel err |")
+        print("|---|---|---|---|")
+        for r in sorted(engine_rows,
+                        key=lambda r: -(r.get("points_per_sec_per_chip") or 0)):
+            e = gate_err(r)
+            print(f"| {variant_key(r)} | {r.get('platform')} "
+                  f"| {r.get('points_per_sec_per_chip')} "
+                  f"| {'n/a' if e is None else format(e, '.2e')} |")
+        print()
+    for r in failed:
+        print(f"# FAILED {variant_key(r)}: {r['error'][:100]}")
+
+    candidates = [
+        r for r in tpu_rows
+        if r.get("engine", "").startswith("pallas")
+        and gate_err(r) is not None and gate_err(r) <= args.contract
+        and r.get("points_per_sec_per_chip")
+    ]
+    baseline = [r for r in tpu_rows if r.get("engine") == "tabulated"]
+    if not candidates:
+        print("\n# NO tpu pallas row passes the contract yet — no "
+              "decision possible (is the collector done?)")
+        sys.exit(1)
+
+    best = max(candidates, key=lambda r: r["points_per_sec_per_chip"])
+    mods = set(best.get("engine", "").split("+")[1:])
+    print("\n## Recommended production kernel configuration\n")
+    print(f"winner: {variant_key(best)} at "
+          f"{best['points_per_sec_per_chip']} pts/s/chip "
+          f"(gate {gate_err(best):.2e})")
+    if baseline:
+        base_best = max(baseline, key=lambda r: r["points_per_sec_per_chip"])
+        ratio = best["points_per_sec_per_chip"] / base_best["points_per_sec_per_chip"]
+        print(f"vs tabulated {base_best['points_per_sec_per_chip']} "
+              f"pts/s/chip -> {ratio:.2f}x")
+    print("\nFlip these defaults in ops/kjma_pallas.py (then demote the "
+          "losing variants from the resume-identity surface):")
+    print(f"  REDUCE_DEFAULT   = {'stream' not in mods}")
+    print(f"  FUSE_EXP default = {'fuse' in mods}")
+    print(f"  TABLE_SPLIT3     = {bool(best.get('pallas_table_split3'))}")
+    print(f"  COL_BLOCK_DEFAULT= {best.get('pallas_col_block', 8)}")
+
+
+if __name__ == "__main__":
+    main()
