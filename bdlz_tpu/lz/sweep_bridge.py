@@ -329,6 +329,14 @@ def make_P_of_vw_gamma_table(
     from bdlz_tpu.lz.kernel import _segment_hamiltonians, make_P_of_speed
 
     a, b, dxi = _segment_hamiltonians(profile, jnp)
+    # cap the speed chunk by the same leaf-memory budget as the 1-D path:
+    # the Bloch tree stages (padded_segments, 3, 3) f64 maps PER SPEED,
+    # so the fixed 512 default would peak ~38 GB on a 1e6-segment profile
+    n_seg = int(np.asarray(a).shape[0])
+    padded_seg = 1 << max(n_seg - 1, 1).bit_length()
+    budget = int(os.environ.get("BDLZ_LZ_SPEED_CHUNK_BYTES", 1 << 30))
+    speed_chunk = max(1, min(int(speed_chunk),
+                             budget // max(padded_seg * 8 * 9, 1)))
 
     @jax.jit
     def P_chunk(v_chunk, g):

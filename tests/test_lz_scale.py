@@ -79,6 +79,36 @@ def test_speed_chunking_matches_single_shot():
     np.testing.assert_array_equal(P_deph, P_deph_one)
 
 
+def test_table2d_speed_chunk_budget_matches_default():
+    """The 2-D P(v_w, Γ_φ) build caps its speed chunk by the same leaf
+    budget as the 1-D path; a budget forcing per-speed chunks reproduces
+    the default build bitwise."""
+    from bdlz_tpu.lz.sweep_bridge import make_P_of_vw_gamma_table
+
+    xi = np.linspace(-30.0, 30.0, 2001)
+    prof = BounceProfile(
+        xi=xi, delta=-0.08 * np.tanh(xi / 4.0), mix=np.full(2001, 0.02)
+    )
+    env = dict(os.environ)
+    try:
+        os.environ["BDLZ_LZ_SPEED_CHUNK_BYTES"] = str(1 << 40)
+        t_big = make_P_of_vw_gamma_table(
+            prof, 0.1, 0.9, 0.0, 0.2, n_v=8, n_g=8
+        )
+        # 2000 segments -> padded 2048; 2048*8*9 B/speed -> budget of
+        # exactly 3 speeds per chunk
+        os.environ["BDLZ_LZ_SPEED_CHUNK_BYTES"] = str(2048 * 8 * 9 * 3)
+        t_small = make_P_of_vw_gamma_table(
+            prof, 0.1, 0.9, 0.0, 0.2, n_v=8, n_g=8
+        )
+    finally:
+        os.environ.clear()
+        os.environ.update(env)
+    np.testing.assert_array_equal(
+        np.asarray(t_small.values), np.asarray(t_big.values)
+    )
+
+
 def test_ptable_build_at_1e6_segments(big_profile, monkeypatch):
     """The MCMC's P(v_w) table build runs the chunked path end to end at
     design scale (small node count keeps the test fast; the table-node
