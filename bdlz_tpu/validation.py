@@ -175,6 +175,79 @@ def engine_population_max_rel(
     return population_max_rel(run_pop, chunk_pop, ref)
 
 
+def _reference_code_fingerprint() -> str:
+    """Hash of the source of every module the NumPy reference path runs.
+
+    Cache keys must invalidate when the reference implementation itself
+    changes — a stale cached "reference" would make the accuracy gate
+    compare an engine against an older version of the truth.
+    """
+    import hashlib
+    import inspect
+
+    import bdlz_tpu.constants
+    import bdlz_tpu.models.yields_pipeline
+    import bdlz_tpu.ops.kjma_table
+    import bdlz_tpu.physics.percolation
+    import bdlz_tpu.physics.source
+    import bdlz_tpu.physics.thermo
+    import bdlz_tpu.solvers.quadrature
+
+    h = hashlib.sha256()
+    for mod in (
+        bdlz_tpu.constants, bdlz_tpu.models.yields_pipeline,
+        bdlz_tpu.ops.kjma_table, bdlz_tpu.physics.percolation,
+        bdlz_tpu.physics.source, bdlz_tpu.physics.thermo,
+        bdlz_tpu.solvers.quadrature,
+    ):
+        h.update(inspect.getsource(mod).encode())
+    return h.hexdigest()[:16]
+
+
+def reference_ratios_cached(
+    grid, static, n_y: "int | None" = None, cache_dir: "str | None" = None,
+) -> np.ndarray:
+    """:func:`reference_ratios` with an on-disk cache.
+
+    The scalar NumPy reference loop costs minutes on big populations
+    (the bench's 128-config gate; the audit's 1024) and its output is
+    bit-deterministic, so measurement tools re-running in one session —
+    in particular the evidence collector's phases sharing a single
+    hardware window — should not re-pay it.  Keyed by the population
+    bytes, the static choices, n_y, AND a fingerprint of the reference
+    path's source (a code change invalidates the cache).  Set
+    ``BDLZ_REF_CACHE_DIR=''`` to disable.
+    """
+    import hashlib
+    import os
+    import tempfile
+
+    cache_dir = (
+        os.environ.get("BDLZ_REF_CACHE_DIR", "/tmp/bdlz_refcache")
+        if cache_dir is None else cache_dir
+    )
+    if not cache_dir:
+        return reference_ratios(grid, static, n_y=n_y)
+    h = hashlib.sha256()
+    for f in grid:
+        h.update(np.ascontiguousarray(np.asarray(f, dtype=np.float64)).tobytes())
+    h.update(repr((tuple(static), n_y)).encode())
+    h.update(_reference_code_fingerprint().encode())
+    path = os.path.join(cache_dir, f"ref_{h.hexdigest()[:24]}.npy")
+    n = int(np.asarray(grid.m_chi_GeV).shape[0])
+    if os.path.exists(path):
+        out = np.load(path)
+        if out.shape == (n,):
+            return out
+    out = reference_ratios(grid, static, n_y=n_y)
+    os.makedirs(cache_dir, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=cache_dir, suffix=".npy")
+    os.close(fd)
+    np.save(tmp, out)
+    os.replace(tmp, path)  # atomic: concurrent tools never read half a file
+    return out
+
+
 def reference_ratios(grid, static, n_y: "int | None" = None) -> np.ndarray:
     """DM_over_B per point on the bit-reproducible NumPy reference path.
 

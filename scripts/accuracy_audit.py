@@ -75,7 +75,10 @@ def main() -> None:
     # Shared population builder (bdlz_tpu.validation): the bench's
     # on-hardware gate draws from the same design, so this artifact and
     # the benched-engine gate cannot drift apart.
-    from bdlz_tpu.validation import build_audit_population, reference_ratios
+    from bdlz_tpu.validation import (
+        build_audit_population,
+        reference_ratios_cached,
+    )
 
     pop = build_audit_population(base, n, seed=args.seed)
     grid = pop.grid
@@ -87,7 +90,7 @@ def main() -> None:
     t0 = time.time()
     # n_y aligned with the JAX leg: the artifact must measure backend
     # error at equal discretization, not y-grid truncation
-    ref = reference_ratios(grid, static, n_y=args.n_y)
+    ref = reference_ratios_cached(grid, static, n_y=args.n_y)
     t_ref = time.time() - t0
 
     # --- JAX path (tabulated engine, the bench's fallback/default) ------

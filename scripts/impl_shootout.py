@@ -100,10 +100,13 @@ def main() -> None:
     gate_pop = gate_ref = None
     n_gate = max(0, int(args.gate_points))
     if n_gate > 0:
-        from bdlz_tpu.validation import build_audit_population, reference_ratios
+        from bdlz_tpu.validation import (
+            build_audit_population,
+            reference_ratios_cached,
+        )
 
         gate_pop = build_audit_population(base, n_gate, seed=1)
-        gate_ref = reference_ratios(gate_pop.grid, static, n_y=args.n_y)
+        gate_ref = reference_ratios_cached(gate_pop.grid, static, n_y=args.n_y)
 
     def population_rel(impl, fuse, reduce):
         """Max rel err of this engine over the audit population

@@ -52,6 +52,47 @@ def test_ref_zero_points_held_to_abs_tol(capsys):
     assert "2/4 ref==0 points" in captured.err
 
 
+def test_reference_ratios_cache_roundtrip(tmp_path):
+    """The on-disk reference cache returns bit-identical values on a hit,
+    and the key includes the population, n_y, and the reference source
+    fingerprint (a different population must miss)."""
+    from bdlz_tpu.config import config_from_dict, static_choices_from_config
+    from bdlz_tpu.validation import (
+        build_audit_population,
+        reference_ratios,
+        reference_ratios_cached,
+    )
+
+    base = config_from_dict({
+        "regime": "nonthermal", "P_chi_to_B": 0.149,
+        "Y_chi_init": 4.9e-10, "incident_flux_scale": 1.07e-9,
+    })
+    static = static_choices_from_config(base)
+    pop = build_audit_population(base, 6, seed=3)
+    cache = str(tmp_path / "refcache")
+
+    direct = reference_ratios(pop.grid, static, n_y=400)
+    first = reference_ratios_cached(pop.grid, static, n_y=400, cache_dir=cache)
+    np.testing.assert_array_equal(first, direct)
+    files = list((tmp_path / "refcache").glob("ref_*.npy"))
+    assert len(files) == 1
+    # poison the cached file: a hit must come from disk, not recompute
+    np.save(files[0], direct + 1.0)
+    poisoned = reference_ratios_cached(
+        pop.grid, static, n_y=400, cache_dir=cache
+    )
+    np.testing.assert_array_equal(poisoned, direct + 1.0)
+    # different n_y -> different key -> fresh compute, second file
+    fresh = reference_ratios_cached(pop.grid, static, n_y=300, cache_dir=cache)
+    assert len(list((tmp_path / "refcache").glob("ref_*.npy"))) == 2
+    np.testing.assert_array_equal(
+        fresh, reference_ratios(pop.grid, static, n_y=300)
+    )
+    # empty cache_dir disables caching entirely
+    off = reference_ratios_cached(pop.grid, static, n_y=400, cache_dir="")
+    np.testing.assert_array_equal(off, direct)
+
+
 def test_ref_zero_point_with_large_engine_value_fails():
     """A large finite engine value at a zero-reference point must FAIL the
     gate, not be silently dropped (ADVICE r4)."""
