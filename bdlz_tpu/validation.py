@@ -206,6 +206,7 @@ def _reference_code_fingerprint() -> str:
 
 def reference_ratios_cached(
     grid, static, n_y: "int | None" = None, cache_dir: "str | None" = None,
+    stats: "dict | None" = None,
 ) -> np.ndarray:
     """:func:`reference_ratios` with an on-disk cache.
 
@@ -217,16 +218,37 @@ def reference_ratios_cached(
     bytes, the static choices, n_y, AND a fingerprint of the reference
     path's source (a code change invalidates the cache).  Set
     ``BDLZ_REF_CACHE_DIR=''`` to disable.
+
+    The default directory is per-user (0700, uid-suffixed under the
+    system temp dir) and an existing directory not owned by this uid is
+    refused — the cache IS the accuracy gate's ground truth, so a
+    world-writable shared path would let another local user substitute
+    it.  ``stats``, when given, records ``{"cache_hit": bool}`` so
+    evidence artifacts can stamp whether their reference timing measured
+    a recompute or a disk read.
     """
     import hashlib
     import os
     import tempfile
 
-    cache_dir = (
-        os.environ.get("BDLZ_REF_CACHE_DIR", "/tmp/bdlz_refcache")
-        if cache_dir is None else cache_dir
-    )
+    if cache_dir is None:
+        cache_dir = os.environ.get(
+            "BDLZ_REF_CACHE_DIR",
+            os.path.join(tempfile.gettempdir(),
+                         f"bdlz_refcache-{os.getuid()}"),
+        )
+    if stats is not None:
+        stats["cache_hit"] = False
     if not cache_dir:
+        return reference_ratios(grid, static, n_y=n_y)
+    os.makedirs(cache_dir, mode=0o700, exist_ok=True)
+    st = os.stat(cache_dir)
+    if st.st_uid != os.getuid():
+        import sys
+
+        print(f"[refcache] {cache_dir} is owned by uid {st.st_uid}, not "
+              f"{os.getuid()}; refusing to trust it (caching disabled)",
+              file=sys.stderr)
         return reference_ratios(grid, static, n_y=n_y)
     h = hashlib.sha256()
     for f in grid:
@@ -238,9 +260,10 @@ def reference_ratios_cached(
     if os.path.exists(path):
         out = np.load(path)
         if out.shape == (n,):
+            if stats is not None:
+                stats["cache_hit"] = True
             return out
     out = reference_ratios(grid, static, n_y=n_y)
-    os.makedirs(cache_dir, exist_ok=True)
     fd, tmp = tempfile.mkstemp(dir=cache_dir, suffix=".npy")
     os.close(fd)
     np.save(tmp, out)

@@ -83,10 +83,15 @@ def main() -> None:
     for r in failed:
         print(f"# FAILED {variant_key(r)}: {r['error'][:100]}")
 
+    # decisions require the ADVERSARIAL population gate specifically: a
+    # row whose gate failed (gate_error) or never ran must not be crowned
+    # via the weak in-grid spot sample's number
     candidates = [
         r for r in tpu_rows
         if r.get("engine", "").startswith("pallas")
-        and gate_err(r) is not None and gate_err(r) <= args.contract
+        and "gate_error" not in r
+        and r.get("gate_max_rel_err") is not None
+        and float(r["gate_max_rel_err"]) <= args.contract
         and r.get("points_per_sec_per_chip")
     ]
     baseline = [r for r in tpu_rows if r.get("engine") == "tabulated"]

@@ -56,7 +56,7 @@ def main() -> None:
 
     jax.config.update("jax_enable_x64", True)
 
-    from bdlz_tpu.lz.profile import BounceProfile, load_profile_csv
+    from bdlz_tpu.lz.profile import load_profile_csv
     from bdlz_tpu.lz.sweep_bridge import (
         make_P_of_vw_table,
         probabilities_for_points,
@@ -67,6 +67,7 @@ def main() -> None:
     delta = -0.08 * np.tanh(xi / 4.0)
     mix = np.full(n, 0.02)
 
+    import os as _os
     import tempfile
 
     with tempfile.NamedTemporaryFile("w", suffix=".csv", delete=False) as f:
@@ -75,36 +76,37 @@ def main() -> None:
         np.savetxt(f, np.column_stack([xi, delta, mix]), delimiter=",")
 
     # --- parse ---
-    t0 = time.time()
-    prof = load_profile_csv(path)
-    t_native = time.time() - t0
-    row = {
-        "phase": "parse", "rows": n, "native_seconds": round(t_native, 3),
-        "rss_mb": rss_mb(),
-    }
-    if args.numpy_compare:
-        from bdlz_tpu.lz import profile as profile_mod
+    try:
+        t0 = time.time()
+        prof = load_profile_csv(path)
+        t_native = time.time() - t0
+        row = {
+            "phase": "parse", "rows": n, "native_seconds": round(t_native, 3),
+            "rss_mb": rss_mb(),
+        }
+        if args.numpy_compare:
+            from bdlz_tpu.lz import profile as profile_mod
 
-        real_read = profile_mod._read_csv
+            real_read = profile_mod._read_csv
 
-        def numpy_read(p):
-            data = np.genfromtxt(p, delimiter=",", names=True, dtype=float)
-            names = list(data.dtype.names)
-            return names, np.column_stack([data[c] for c in names])
+            def numpy_read(p):
+                data = np.genfromtxt(p, delimiter=",", names=True, dtype=float)
+                names = list(data.dtype.names)
+                return names, np.column_stack([data[c] for c in names])
 
-        profile_mod._read_csv = numpy_read
-        try:
-            t0 = time.time()
-            prof_np = profile_mod.load_profile_csv(path)
-            t_numpy = time.time() - t0
-        finally:
-            profile_mod._read_csv = real_read
-        np.testing.assert_allclose(prof_np.xi, prof.xi, rtol=1e-15)
-        row["numpy_seconds"] = round(t_numpy, 3)
-        row["native_speedup"] = round(t_numpy / t_native, 1)
+            profile_mod._read_csv = numpy_read
+            try:
+                t0 = time.time()
+                prof_np = profile_mod.load_profile_csv(path)
+                t_numpy = time.time() - t0
+            finally:
+                profile_mod._read_csv = real_read
+            np.testing.assert_allclose(prof_np.xi, prof.xi, rtol=1e-15)
+            row["numpy_seconds"] = round(t_numpy, 3)
+            row["native_speedup"] = round(t_numpy / t_native, 1)
+    finally:
+        _os.unlink(path)  # ~70 MB per run — don't accumulate in /tmp
     print(json.dumps(row), flush=True)
-
-    prof = BounceProfile(xi=prof.xi, delta=prof.delta, mix=prof.mix)
 
     # --- coherent kernel over the full profile ---
     v = np.linspace(0.05, 0.9, int(args.speeds))

@@ -5,6 +5,8 @@ scripts/impl_shootout.py) compute their max-rel-err number, so its corner
 behavior — non-finite outputs, zero-reference points (ADVICE r4) — is
 pinned here directly with synthetic chunk runners.
 """
+import os
+
 import numpy as np
 import pytest
 
@@ -91,6 +93,18 @@ def test_reference_ratios_cache_roundtrip(tmp_path):
     # empty cache_dir disables caching entirely
     off = reference_ratios_cached(pop.grid, static, n_y=400, cache_dir="")
     np.testing.assert_array_equal(off, direct)
+    # a cache dir owned by another uid is refused (the cache is the
+    # gate's ground truth); falls back to recompute
+    if os.getuid() == 0:
+        foreign = tmp_path / "foreign"
+        foreign.mkdir()
+        os.chown(foreign, 12345, 12345)
+        np.save(foreign / "poison.npy", direct + 9.0)
+        got = reference_ratios_cached(
+            pop.grid, static, n_y=400, cache_dir=str(foreign)
+        )
+        np.testing.assert_array_equal(got, direct)
+        assert not list(foreign.glob("ref_*.npy"))  # nothing written there
 
 
 def test_ref_zero_point_with_large_engine_value_fails():
