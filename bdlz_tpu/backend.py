@@ -45,6 +45,31 @@ def get_namespace(backend: str) -> Any:
     return numpy
 
 
+def ensure_x64() -> None:
+    """Enable float64 on the JAX path (idempotent).
+
+    The single sanctioned home for this config write: bdlz-lint rule R5
+    pins ``jax.config.update`` to this module (and tests/conftest.py), so
+    modules that need the x64 contract call this instead of touching the
+    global config themselves.
+    """
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+
+
+def set_debug_nans(enable: bool = True) -> None:
+    """Toggle ``jax_debug_nans`` — any NaN produced under jit raises.
+
+    The runtime half of the sanitizer layer (:mod:`bdlz_tpu.sanitize`)
+    and the sweep CLI's ``--debug-nans`` both route through here so the
+    global-config write stays inside the R5 allowlist.
+    """
+    import jax
+
+    jax.config.update("jax_debug_nans", bool(enable))
+
+
 def jax_numpy() -> Any:
     """Import and return ``jax.numpy`` with float64 enabled.
 

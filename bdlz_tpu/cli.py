@@ -13,7 +13,7 @@ import math
 import sys
 from typing import Optional
 
-import numpy as np
+import numpy as np  # host-side use only; jitted paths go through the backend.py xp seam (bdlz-lint R1 audit)
 
 from bdlz_tpu import backend as backend_mod
 from bdlz_tpu.config import (
@@ -184,6 +184,14 @@ def run_point(cfg: Config, P_used: float, backend: str) -> YieldsResult:
         if backend_mod.is_jax_backend(backend):
             import jax
 
+            from bdlz_tpu import sanitize
+
+            if sanitize.is_enabled():
+                # eager evaluation so every layer-boundary checkpoint sees
+                # concrete arrays (jax_debug_nans still covers primitives)
+                result = jax.device_get(point_yields(pp, static, grid, xp))
+                sanitize.check_tree(sanitize.BOUNDARY_SOLVER, result)
+                return result
             fn = jax.jit(point_yields, static_argnums=(1, 3))
             return jax.device_get(fn(pp, static, grid, xp))
         return point_yields(pp, static, grid, xp)
@@ -296,6 +304,14 @@ def main(argv: Optional[list] = None) -> None:
                     dest="lz_gamma_phi",
                     help="Diabatic-basis dephasing rate for --lz-method "
                          "dephased (framework addition).")
+    ap.add_argument("--sanitize", action="store_true",
+                    help="Runtime sanitizer (framework addition): "
+                         "jax_debug_nans on the JAX path, finiteness "
+                         "assertions at the L1->L2->L3->L4 layer boundaries, "
+                         "and a float64 dtype-drift check. The JAX path "
+                         "evaluates eagerly (un-jitted) so every boundary "
+                         "is concrete; default runs are byte-for-byte "
+                         "unaffected.")
     ap.add_argument("--planck", action="store_true",
                     help="Print the Planck comparison block: settling factor "
                          "f_settle and effective probability P_eff (paper "
@@ -325,12 +341,23 @@ def main(argv: Optional[list] = None) -> None:
     cfg = load_config(args.config)
     backend = args.backend or cfg.backend
     cfg = validate(cfg, backend=backend)
+    if args.sanitize:
+        from bdlz_tpu import sanitize
+
+        # pure-NumPy runs skip the jax_debug_nans arm (no JAX start-up)
+        sanitize.enable(jax_nans=backend_mod.is_jax_backend(backend))
     P_used = resolve_P(
         cfg, args.profile_csv, momentum_average=args.lz_momentum_average,
         lz_method=args.lz_method, lz_gamma_phi=args.lz_gamma_phi,
     )
 
     result = run_point(cfg, P_used, backend)
+    if args.sanitize:
+        from bdlz_tpu import sanitize
+
+        # the output boundary: every path (quadrature, Radau, ESDIRK)
+        # lands here with concrete host values
+        sanitize.check_tree(sanitize.BOUNDARY_SOLVER, result)
 
     print_results(result)
     write_yields_out("yields_out.json", cfg, P_used, result)

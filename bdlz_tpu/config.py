@@ -295,7 +295,7 @@ def resolve_Y_chi_init(cfg: Config) -> float:
         return float(cfg.Y_chi_init)
     if cfg.n_chi_at_Tp_GeV3 is not None:
         from bdlz_tpu.physics.thermo import entropy_density
-        import numpy as np
+        import numpy as np  # host-side helper (bdlz-lint R1 audit)
 
         s_p = entropy_density(cfg.T_p_GeV, cfg.g_star_s, np)
         return float(cfg.n_chi_at_Tp_GeV3) / max(s_p, 1e-300)

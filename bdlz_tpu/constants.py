@@ -16,6 +16,10 @@ PI: float = math.pi
 #: Planck mass in GeV entering H = 1.66 sqrt(g*) T^2 / M_Pl.
 MPL_GEV: float = 1.220890e19
 
+#: Radiation-domination Hubble prefactor: H = HUBBLE_COEFF sqrt(g*) T^2 / M_Pl
+#: (the sqrt(8 pi^3/90) ~ 1.66 convention of the reference, :84).
+HUBBLE_COEFF: float = 1.66
+
 #: Present-day entropy density, cm^-3 and m^-3.
 S0_CM3: float = 2891.0
 S0_M3: float = S0_CM3 * 1e6

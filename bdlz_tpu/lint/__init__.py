@@ -1,0 +1,20 @@
+"""bdlz-lint — JAX-aware static analysis for the dual-backend contract.
+
+The package must stay bit-reproducible on the NumPy backend while being
+jit/pjit-safe on the TPU path, and the regressions that break that are
+silent: host ``np.`` calls leaking into jitted code, Python branches on
+tracers, host syncs in hot paths, magic-number drift in the physics
+layer, stray global JAX config writes, and jitted entry points missing
+their static/donate declarations. This package turns each class into a
+lintable rule (R1–R6, see :mod:`bdlz_tpu.lint.rules`) over stdlib
+``ast`` — no third-party dependencies — with per-line suppression
+(``# bdlz-lint: disable=R4``) and a JSON mode for tooling:
+
+    python -m bdlz_tpu.lint bdlz_tpu/ --format json
+
+Tier-1 pins ``bdlz_tpu/`` at zero unsuppressed findings
+(``tests/test_lint.py``); the runtime counterpart of this static pass is
+the ``--sanitize`` flag on the CLIs (:mod:`bdlz_tpu.sanitize`).
+"""
+from bdlz_tpu.lint.analyzer import LintReport, lint_paths, lint_source  # noqa: F401
+from bdlz_tpu.lint.rules import RULES, Finding, Rule  # noqa: F401

@@ -1,0 +1,70 @@
+"""CLI for bdlz-lint.
+
+    python -m bdlz_tpu.lint [paths ...] [--format text|json] [--rules R1,R2]
+
+Exit status: 0 when every finding is suppressed (or none exist), 1 when
+unsuppressed findings remain, 2 on usage errors. The JSON mode emits the
+full report (findings, suppressions, per-rule counts) for tooling;
+`scripts/lint.sh` chains it with ruff as the repo's one lint command.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Optional
+
+from bdlz_tpu.lint.analyzer import lint_paths
+from bdlz_tpu.lint.rules import RULES
+
+
+def main(argv: Optional[list] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m bdlz_tpu.lint",
+        description="JAX-aware static analysis for the bdlz_tpu "
+        "dual-backend contract (rules R1-R6)",
+    )
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="Files or directories to lint (default: bdlz_tpu/)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--rules", default=None,
+                    help="Comma-separated subset of rule ids (default: all)")
+    ap.add_argument("--show-suppressed", action="store_true",
+                    help="Also print suppressed findings in text mode "
+                         "(JSON mode always carries them)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="Print the rule table and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rid, rule in RULES.items():
+            print(f"{rid}  {rule.title}\n    fix: {rule.hint}")
+        return 0
+
+    rules = None
+    if args.rules:
+        rules = [r.strip() for r in args.rules.split(",") if r.strip()]
+        unknown = [r for r in rules if r not in RULES]
+        if unknown:
+            print(f"unknown rule id(s): {', '.join(unknown)}", file=sys.stderr)
+            return 2
+
+    paths = args.paths or ["bdlz_tpu"]
+    report = lint_paths(paths, rules=rules)
+
+    if args.format == "json":
+        print(json.dumps(report.to_dict(), indent=2))
+    else:
+        shown = report.findings if args.show_suppressed else report.active
+        for f in shown:
+            print(f.render())
+        print(
+            f"bdlz-lint: {len(report.active)} finding(s), "
+            f"{len(report.suppressed)} suppressed, "
+            f"{report.files_scanned} file(s) scanned"
+        )
+    return 1 if report.active else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,878 @@
+"""The bdlz-lint AST pass: collect, resolve, reach, report.
+
+Pipeline (stdlib ``ast`` only):
+
+1. **Collect** — parse every ``.py`` file, record imports/aliases,
+   function definitions (nested included), the calls each makes, and
+   every *trace site* (``jax.jit`` / ``pjit`` / ``pmap`` / ``vmap`` /
+   ``shard_map`` / ``lax.scan|cond|while_loop|...`` — as a call with a
+   function argument, or as a decorator, including the
+   ``partial(jax.jit, ...)`` form).
+2. **Resolve** — build a best-effort intra-repo call graph: bare names
+   resolve within the module (innermost scope first), attribute calls
+   resolve through ``import``/``from``-import aliases to functions of
+   other linted modules. Unresolvable calls (methods on objects,
+   dynamic dispatch) are conservatively dropped.
+3. **Reach** — BFS from the trace-site targets; every function reachable
+   through the graph, plus every function nested inside a reachable one,
+   is *traced context* for R1/R2/R3.
+4. **Report** — walk each module once more emitting findings, then mark
+   suppressions: a finding on a physical line carrying
+   ``# bdlz-lint: disable=R1[,R2...]`` (or ``disable=all``) is kept in
+   the report but does not count toward the exit status.
+
+The reachability analysis is deliberately heuristic (no type inference,
+no cross-module attribute chasing beyond one hop); rules are tuned so
+that a violation-free tree stays quiet and genuine leaks of each class
+are caught — tests/test_lint.py pins both directions.
+"""
+from __future__ import annotations
+
+import ast
+import io
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from bdlz_tpu.lint.rules import RULES, Finding
+
+#: Wrappers whose function argument enters traced (jit/vmap/scan) context.
+TRACE_WRAPPERS = {
+    "jax.jit",
+    "jax.pjit",
+    "jax.pmap",
+    "jax.vmap",
+    "jax.shard_map",
+    "jax.experimental.shard_map.shard_map",
+    "jax.experimental.pjit.pjit",
+    "jax.lax.scan",
+    "jax.lax.cond",
+    "jax.lax.switch",
+    "jax.lax.while_loop",
+    "jax.lax.fori_loop",
+    "jax.lax.associative_scan",
+    "jax.lax.map",
+}
+
+#: The subset of TRACE_WRAPPERS that compile an *entry point* (R6 scope).
+JIT_WRAPPERS = {"jax.jit", "jax.pjit", "jax.pmap", "jax.experimental.pjit.pjit"}
+
+#: Parameter names that are structural by repo convention: branch tests
+#: touching only these are host-side control flow, not tracer leaks (R2),
+#: and a jit site leaving one of the R6 subset non-static recompiles per
+#: value (R6).
+STATIC_PARAM_NAMES = {
+    "xp",
+    "static",
+    "chi_stats",
+    "stats",
+    "method",
+    "regime",
+    "impl",
+    "n_y",
+    "nz",
+    "n_mu",
+    "n_k",
+    "n_v",
+    "n_g",
+    "max_steps",
+    "deplete",
+    "interpret",
+    "fuse_exp",
+    "reduce",
+    "mesh",
+}
+
+#: R6 only hints on the names that are *always* structural in this repo.
+R6_HINT_NAMES = {"xp", "static", "chi_stats", "stats", "method", "regime",
+                 "impl", "n_y", "nz"}
+
+#: Directories whose modules hold hot-path code (R3 scope).
+HOT_DIRS = ("physics", "lz", "solvers", "ops")
+
+#: Modules allowed to call jax.config.update (R5).
+CONFIG_OWNERS = ("backend.py", "conftest.py")
+
+_SUPPRESS_RE = re.compile(r"bdlz-lint:\s*disable=([A-Za-z0-9_,\s]+)")
+
+
+# ---------------------------------------------------------------------------
+# collection
+
+
+@dataclass
+class FunctionInfo:
+    module: "ModuleInfo"
+    qualname: str
+    name: str
+    node: ast.AST
+    params: List[str]
+    parent: Optional["FunctionInfo"] = None
+    calls: List[Tuple] = field(default_factory=list)  # resolution requests
+
+    @property
+    def key(self) -> Tuple[str, str]:
+        return (self.module.modname, self.qualname)
+
+
+@dataclass
+class TraceSite:
+    module: "ModuleInfo"
+    wrapper: str
+    line: int
+    col: int
+    target_name: Optional[str]  # bare name of the traced function, if any
+    scope: Optional[FunctionInfo]  # enclosing function at the site
+    static_positions: Set[int] = field(default_factory=set)
+    static_names: Set[str] = field(default_factory=set)
+    has_static: bool = False
+    has_donate: bool = False
+    decorated: Optional[FunctionInfo] = None  # decorator form
+
+
+class ModuleInfo:
+    def __init__(self, path: str, modname: str, source: str) -> None:
+        self.path = path
+        self.modname = modname
+        self.source = source
+        self.tree = ast.parse(source, filename=path)
+        self.basename = os.path.basename(path)
+        # local name -> canonical dotted module ("numpy", "jax.numpy", ...)
+        self.import_alias: Dict[str, str] = {}
+        # local name -> (module, attr) for `from module import attr as name`
+        self.from_alias: Dict[str, Tuple[str, str]] = {}
+        self.functions: Dict[str, FunctionInfo] = {}
+        self.by_name: Dict[str, List[FunctionInfo]] = {}
+        self.trace_sites: List[TraceSite] = []
+        self.suppressions = _collect_suppressions(source)
+
+    def in_hot_dir(self) -> bool:
+        parts = self.path.replace("\\", "/").split("/")
+        return any(d in parts for d in HOT_DIRS)
+
+    def in_physics_dir(self) -> bool:
+        return "physics" in self.path.replace("\\", "/").split("/")
+
+
+def _collect_suppressions(source: str) -> Dict[int, Set[str]]:
+    """Map physical line -> set of suppressed rule ids (or {"all"})."""
+    out: Dict[int, Set[str]] = {}
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _SUPPRESS_RE.search(tok.string)
+            if not m:
+                continue
+            ids = {s.strip() for s in m.group(1).split(",") if s.strip()}
+            out.setdefault(tok.start[0], set()).update(
+                {"all"} if "all" in ids else ids
+            )
+    except (tokenize.TokenError, SyntaxError):  # pragma: no cover
+        pass  # ast.parse already succeeded; degrade to no-suppressions
+    return out
+
+
+def _attr_chain(node: ast.AST) -> Optional[List[str]]:
+    """``a.b.c`` -> ["a", "b", "c"]; None for non-name-rooted chains."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return parts[::-1]
+    return None
+
+
+class _Collector(ast.NodeVisitor):
+    """First pass over one module: functions, aliases, calls, trace sites."""
+
+    def __init__(self, mod: ModuleInfo) -> None:
+        self.mod = mod
+        self.stack: List[FunctionInfo] = []
+
+    # -- imports / aliases ------------------------------------------------
+    def visit_Import(self, node: ast.Import) -> None:
+        for alias in node.names:
+            self.mod.import_alias[alias.asname or alias.name.split(".")[0]] = (
+                alias.name
+            )
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        if node.module and node.level == 0:
+            for alias in node.names:
+                self.mod.from_alias[alias.asname or alias.name] = (
+                    node.module,
+                    alias.name,
+                )
+        elif node.level:  # relative import: resolve against this module
+            base = self.mod.modname.rsplit(".", node.level)[0]
+            target = f"{base}.{node.module}" if node.module else base
+            for alias in node.names:
+                self.mod.from_alias[alias.asname or alias.name] = (
+                    target,
+                    alias.name,
+                )
+        self.generic_visit(node)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        # simple aliasing: `shard_map = jax.shard_map`
+        chain = _attr_chain(node.value)
+        if chain is not None and len(node.targets) == 1 and isinstance(
+            node.targets[0], ast.Name
+        ):
+            canon = self._canonical(chain)
+            if canon:
+                self.mod.from_alias[node.targets[0].id] = tuple(
+                    canon.rsplit(".", 1)
+                ) if "." in canon else (canon, "")
+        self.generic_visit(node)
+
+    # -- functions --------------------------------------------------------
+    def _visit_func(self, node) -> None:
+        qual = ".".join([f.name for f in self.stack] + [node.name])
+        a = node.args
+        params = (
+            [p.arg for p in getattr(a, "posonlyargs", [])]
+            + [p.arg for p in a.args]
+            + [p.arg for p in a.kwonlyargs]
+        )
+        info = FunctionInfo(
+            module=self.mod,
+            qualname=qual,
+            name=node.name,
+            node=node,
+            params=params,
+            parent=self.stack[-1] if self.stack else None,
+        )
+        self.mod.functions[qual] = info
+        self.mod.by_name.setdefault(node.name, []).append(info)
+        for dec in node.decorator_list:
+            self._maybe_trace_decorator(dec, info)
+        self.stack.append(info)
+        for child in node.body:
+            self.visit(child)
+        self.stack.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    # -- calls ------------------------------------------------------------
+    def _canonical(self, chain: List[str]) -> Optional[str]:
+        """Resolve a name chain through this module's import aliases."""
+        root = chain[0]
+        if root in self.mod.import_alias:
+            return ".".join([self.mod.import_alias[root]] + chain[1:])
+        if root in self.mod.from_alias:
+            module, attr = self.mod.from_alias[root]
+            base = f"{module}.{attr}" if attr else module
+            return ".".join([base] + chain[1:])
+        return None
+
+    def visit_Call(self, node: ast.Call) -> None:
+        scope = self.stack[-1] if self.stack else None
+        chain = _attr_chain(node.func)
+        canon = self._canonical(chain) if chain else None
+
+        # from-imported bare names (`from jax import jit`) resolve through
+        # _canonical to their dotted form, so one membership test covers
+        # both the attribute and bare-name spellings
+        if canon in TRACE_WRAPPERS:
+            self._record_trace_call(node, canon, scope)
+        if scope is not None and chain is not None:
+            scope.calls.append(("chain", chain, node.func.lineno))
+        self.generic_visit(node)
+
+    def _jit_target_name(self, node: ast.AST) -> Optional[str]:
+        """Peel nested wrappers: jit(vmap(f)) -> "f"."""
+        for _ in range(4):
+            if isinstance(node, ast.Name):
+                return node.id
+            if isinstance(node, ast.Call):
+                chain = _attr_chain(node.func)
+                canon = self._canonical(chain) if chain else None
+                if canon in TRACE_WRAPPERS or canon == "functools.partial":
+                    if node.args:
+                        node = node.args[0]
+                        continue
+                return None
+            return None
+        return None
+
+    def _record_trace_call(
+        self, node: ast.Call, wrapper: str, scope: Optional[FunctionInfo]
+    ) -> None:
+        site = TraceSite(
+            module=self.mod,
+            wrapper=wrapper,
+            line=node.lineno,
+            col=node.col_offset,
+            target_name=self._jit_target_name(node.args[0])
+            if node.args
+            else None,
+            scope=scope,
+        )
+        self._read_jit_kwargs(node, site)
+        self.mod.trace_sites.append(site)
+
+    def _read_jit_kwargs(self, call: ast.Call, site: TraceSite) -> None:
+        for kw in call.keywords:
+            if kw.arg in ("static_argnums", "static_argnames"):
+                site.has_static = True
+                for v in _literal_elems(kw.value):
+                    if isinstance(v, int):
+                        site.static_positions.add(v)
+                    elif isinstance(v, str):
+                        site.static_names.add(v)
+            elif kw.arg in ("donate_argnums", "donate_argnames"):
+                site.has_donate = True
+
+    def _maybe_trace_decorator(self, dec: ast.AST, info: FunctionInfo) -> None:
+        """@jax.jit, @partial(jax.jit, ...) and @jax.jit(...) forms."""
+        call = dec if isinstance(dec, ast.Call) else None
+        base = call.func if call else dec
+        chain = _attr_chain(base)
+        canon = self._canonical(chain) if chain else None
+        if canon == "functools.partial" and call and call.args:
+            inner_chain = _attr_chain(call.args[0])
+            inner = self._canonical(inner_chain) if inner_chain else None
+            if inner in TRACE_WRAPPERS:
+                site = TraceSite(
+                    module=self.mod,
+                    wrapper=inner,
+                    line=dec.lineno,
+                    col=dec.col_offset,
+                    target_name=info.name,
+                    scope=info.parent,
+                    decorated=info,
+                )
+                self._read_jit_kwargs(call, site)
+                self.mod.trace_sites.append(site)
+        elif canon in TRACE_WRAPPERS:
+            site = TraceSite(
+                module=self.mod,
+                wrapper=canon,
+                line=dec.lineno,
+                col=dec.col_offset,
+                target_name=info.name,
+                scope=info.parent,
+                decorated=info,
+            )
+            if call:
+                self._read_jit_kwargs(call, site)
+            self.mod.trace_sites.append(site)
+
+
+def _literal_elems(node: ast.AST):
+    """Ints/strings out of a literal, tuple/list of literals, or nothing."""
+    if isinstance(node, ast.Constant):
+        yield node.value
+    elif isinstance(node, (ast.Tuple, ast.List)):
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant):
+                yield elt.value
+
+
+# ---------------------------------------------------------------------------
+# resolution + reachability
+
+
+class Project:
+    def __init__(self, modules: List[ModuleInfo]) -> None:
+        self.modules = modules
+        self.by_modname = {m.modname: m for m in modules}
+
+    def resolve_bare(
+        self, mod: ModuleInfo, name: str, scope: Optional[FunctionInfo]
+    ) -> Optional[FunctionInfo]:
+        """A bare name: innermost matching def, else module level, else
+        a from-import of another linted module's function."""
+        candidates = mod.by_name.get(name, [])
+        s = scope
+        while s is not None:
+            for c in candidates:
+                if c.parent is s:
+                    return c
+            s = s.parent
+        for c in candidates:
+            if c.parent is None:
+                return c
+        if name in mod.from_alias:
+            module, attr = mod.from_alias[name]
+            target = self.by_modname.get(module)
+            if target is None and attr:
+                # `from pkg import submodule` style
+                target = self.by_modname.get(f"{module}.{attr}")
+                if target is not None:
+                    return None  # module alias, not a function
+            if target is not None and attr:
+                for c in target.by_name.get(attr, []):
+                    if c.parent is None:
+                        return c
+        return None
+
+    def resolve_chain(
+        self, mod: ModuleInfo, chain: List[str], scope: Optional[FunctionInfo]
+    ) -> Optional[FunctionInfo]:
+        if len(chain) == 1:
+            return self.resolve_bare(mod, chain[0], scope)
+        root = chain[0]
+        target_mod: Optional[ModuleInfo] = None
+        if root in mod.import_alias:
+            target_mod = self.by_modname.get(mod.import_alias[root])
+        elif root in mod.from_alias:
+            module, attr = mod.from_alias[root]
+            dotted = f"{module}.{attr}" if attr else module
+            target_mod = self.by_modname.get(dotted)
+        if target_mod is not None and len(chain) == 2:
+            for c in target_mod.by_name.get(chain[1], []):
+                if c.parent is None:
+                    return c
+        return None
+
+    def reachable_from_trace_sites(self) -> Set[Tuple[str, str]]:
+        roots: List[FunctionInfo] = []
+        for mod in self.modules:
+            for site in mod.trace_sites:
+                if site.decorated is not None:
+                    roots.append(site.decorated)
+                elif site.target_name:
+                    fn = self.resolve_bare(mod, site.target_name, site.scope)
+                    if fn is not None:
+                        roots.append(fn)
+        seen: Set[Tuple[str, str]] = set()
+        queue = list(roots)
+        while queue:
+            fn = queue.pop()
+            if fn.key in seen:
+                continue
+            seen.add(fn.key)
+            # nested defs of traced functions run traced too
+            for other in fn.module.functions.values():
+                if other.parent is fn and other.key not in seen:
+                    queue.append(other)
+            for kind, data, _line in fn.calls:
+                callee = self.resolve_chain(fn.module, data, fn)
+                if callee is not None and callee.key not in seen:
+                    queue.append(callee)
+        return seen
+
+
+# ---------------------------------------------------------------------------
+# rule pass
+
+
+class _RulePass(ast.NodeVisitor):
+    def __init__(
+        self,
+        project: Project,
+        mod: ModuleInfo,
+        reachable: Set[Tuple[str, str]],
+        findings: List[Finding],
+        selected: Set[str],
+    ) -> None:
+        self.project = project
+        self.mod = mod
+        self.reachable = reachable
+        self.findings = findings
+        self.selected = selected
+        self.stack: List[FunctionInfo] = []
+
+    # -- helpers ----------------------------------------------------------
+    def _emit(self, rule: str, node: ast.AST, message: str) -> None:
+        if rule not in self.selected:
+            return
+        self.findings.append(
+            Finding(
+                path=self.mod.path,
+                line=node.lineno,
+                col=node.col_offset,
+                rule=rule,
+                message=message,
+            )
+        )
+
+    @property
+    def fn(self) -> Optional[FunctionInfo]:
+        return self.stack[-1] if self.stack else None
+
+    def _in_traced(self) -> bool:
+        return self.fn is not None and self.fn.key in self.reachable
+
+    def _np_root(self, chain: List[str]) -> Optional[str]:
+        """The canonical numpy/scipy module a chain is rooted in, if any."""
+        root = chain[0]
+        dotted = None
+        if root in self.mod.import_alias:
+            dotted = self.mod.import_alias[root]
+        elif root in self.mod.from_alias:
+            module, attr = self.mod.from_alias[root]
+            dotted = f"{module}.{attr}" if attr else module
+        if dotted and (
+            dotted == "numpy"
+            or dotted.startswith("numpy.")
+            or dotted == "scipy"
+            or dotted.startswith("scipy.")
+        ):
+            return dotted
+        return None
+
+    def _array_ns_root(self, chain: List[str]) -> bool:
+        """True if a chain is rooted in an array namespace (xp/jnp/lax/np)."""
+        root = chain[0]
+        if root == "xp":
+            return True
+        if self._np_root(chain):
+            return True
+        dotted = None
+        if root in self.mod.import_alias:
+            dotted = self.mod.import_alias[root]
+        elif root in self.mod.from_alias:
+            module, attr = self.mod.from_alias[root]
+            dotted = f"{module}.{attr}" if attr else module
+        return dotted in ("jax.numpy", "jax.lax", "jax") if dotted else False
+
+    def _traced_params(self) -> Set[str]:
+        """Parameter names of the enclosing function assumed tracer-valued."""
+        fn = self.fn
+        if fn is None:
+            return set()
+        return {p for p in fn.params if p not in STATIC_PARAM_NAMES}
+
+    # -- traversal --------------------------------------------------------
+    def _visit_func(self, node) -> None:
+        qual = ".".join([f.name for f in self.stack] + [node.name])
+        info = self.mod.functions.get(qual)
+        self.stack.append(info)
+        for dec in node.decorator_list:
+            self.visit(dec)
+        for child in node.body:
+            self.visit(child)
+        self.stack.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    def visit_Call(self, node: ast.Call) -> None:
+        chain = _attr_chain(node.func)
+
+        # R5 — global config writes
+        if chain is not None and self.mod.basename not in CONFIG_OWNERS:
+            canon = None
+            root = chain[0]
+            if root in self.mod.import_alias:
+                canon = ".".join([self.mod.import_alias[root]] + chain[1:])
+            elif root in self.mod.from_alias:
+                module, attr = self.mod.from_alias[root]
+                canon = ".".join(
+                    [f"{module}.{attr}" if attr else module] + chain[1:]
+                )
+            if canon == "jax.config.update":
+                self._emit(
+                    "R5",
+                    node,
+                    "jax.config.update() outside backend.py/conftest.py",
+                )
+
+        in_traced = self._in_traced()
+
+        # R1 — host numpy/scipy in traced context
+        if in_traced and chain is not None and self.mod.basename != "backend.py":
+            np_mod = self._np_root(chain)
+            if np_mod is not None and len(chain) > 1:
+                self._emit(
+                    "R1",
+                    node,
+                    f"`{'.'.join(chain)}` ({np_mod}) called in "
+                    "jit-reachable code",
+                )
+
+        # R3 — host syncs in hot paths
+        hot_scope = in_traced or (
+            self.mod.in_hot_dir()
+            and self.fn is not None
+            and "xp" in self.fn.params
+        )
+        if hot_scope:
+            if isinstance(node.func, ast.Attribute) and node.func.attr in (
+                "item",
+                "block_until_ready",
+            ):
+                self._emit(
+                    "R3", node, f".{node.func.attr}() forces a host sync"
+                )
+            elif (
+                in_traced
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "float"
+                and node.func.id not in self.mod.from_alias
+            ):
+                self._emit(
+                    "R3", node, "float() materializes a device value on host"
+                )
+            elif (
+                not in_traced
+                and chain is not None
+                and len(chain) == 2
+                and chain[1] == "asarray"
+                and self._np_root(chain)
+            ):
+                self._emit(
+                    "R3",
+                    node,
+                    f"`{'.'.join(chain)}` pulls device arrays to host in a "
+                    "hot-path module",
+                )
+
+        self.generic_visit(node)
+
+    @staticmethod
+    def _walk_value_exprs(node: ast.AST):
+        """ast.walk that skips static-metadata subtrees (.shape/.ndim/...).
+
+        ``xs.shape[0] > 1`` is host control flow even when ``xs`` is a
+        tracer — shapes, dtypes and ranks are trace-static — so names
+        under these attributes must not count as tracer-valued.
+        """
+        if isinstance(node, ast.Attribute) and node.attr in (
+            "shape",
+            "ndim",
+            "dtype",
+            "size",
+        ):
+            return
+        yield node
+        for child in ast.iter_child_nodes(node):
+            yield from _RulePass._walk_value_exprs(child)
+
+    def _test_is_tracer_valued(self, test: ast.AST) -> Optional[str]:
+        """Why a branch test looks tracer-valued, or None if it doesn't."""
+        traced = self._traced_params()
+        for sub in ast.walk(test):
+            if isinstance(sub, ast.Compare) and any(
+                isinstance(op, (ast.Is, ast.IsNot)) for op in sub.ops
+            ):
+                return None  # `is None` / identity checks are trace-safe
+            if isinstance(sub, ast.Call):
+                chain = _attr_chain(sub.func)
+                if chain is not None and len(chain) > 1 and self._array_ns_root(
+                    chain
+                ):
+                    return f"array-namespace call `{'.'.join(chain)}` in test"
+                if isinstance(sub.func, ast.Name) and sub.func.id in (
+                    "isinstance",
+                    "hasattr",
+                    "len",
+                    "callable",
+                ):
+                    return None
+        for sub in ast.walk(test):
+            if isinstance(sub, ast.Compare):
+                for side in [sub.left] + list(sub.comparators):
+                    for leaf in self._walk_value_exprs(side):
+                        if (
+                            isinstance(leaf, ast.Name)
+                            and leaf.id in traced
+                        ):
+                            return (
+                                f"comparison on parameter `{leaf.id}` "
+                                "(tracer-valued under jit)"
+                            )
+            if isinstance(sub, ast.Name) and sub is test and sub.id in traced:
+                return f"truth test on parameter `{sub.id}`"
+        return None
+
+    def _check_branch(self, node: ast.AST, test: ast.AST, kind: str) -> None:
+        if not self._in_traced():
+            return
+        why = self._test_is_tracer_valued(test)
+        if why:
+            self._emit(
+                "R2",
+                node,
+                f"Python `{kind}` in jit-reachable code: {why}",
+            )
+
+    def visit_If(self, node: ast.If) -> None:
+        self._check_branch(node, node.test, "if")
+        self.generic_visit(node)
+
+    def visit_While(self, node: ast.While) -> None:
+        self._check_branch(node, node.test, "while")
+        self.generic_visit(node)
+
+    def visit_Assert(self, node: ast.Assert) -> None:
+        self._check_branch(node, node.test, "assert")
+        self.generic_visit(node)
+
+    def visit_Constant(self, node: ast.Constant) -> None:
+        # R4 — magic floats in physics modules
+        if (
+            self.mod.in_physics_dir()
+            and isinstance(node.value, float)
+            and _significant_digits(node.value) > 2
+        ):
+            self._emit(
+                "R4",
+                node,
+                f"bare float literal {node.value!r} in a physics module",
+            )
+        self.generic_visit(node)
+
+
+def _significant_digits(value: float) -> int:
+    """Decimal significant digits of a float's shortest repr mantissa.
+
+    Guard-rail values (0.5, 1e-30, 50.0) have <=2; physical constants
+    (1.66, 106.75, 2891.0) have more — that asymmetry is the rule.
+    """
+    mantissa = repr(abs(value)).split("e")[0].split("E")[0]
+    return len(mantissa.replace(".", "").strip("0"))
+
+
+def _emit_r6(project: Project, mod: ModuleInfo, findings: List[Finding],
+             selected: Set[str]) -> None:
+    if "R6" not in selected:
+        return
+    for site in mod.trace_sites:
+        if site.wrapper not in JIT_WRAPPERS:
+            continue
+        target = site.decorated
+        if target is None and site.target_name:
+            target = project.resolve_bare(mod, site.target_name, site.scope)
+        if target is None:
+            continue
+        covered = set(site.static_names)
+        for pos in site.static_positions:
+            if 0 <= pos < len(target.params):
+                covered.add(target.params[pos])
+        missing = [
+            p
+            for p in target.params
+            if p in R6_HINT_NAMES and p not in covered
+        ]
+        if missing:
+            findings.append(
+                Finding(
+                    path=mod.path,
+                    line=site.line,
+                    col=site.col,
+                    rule="R6",
+                    message=(
+                        f"jit of `{target.name}` leaves structural "
+                        f"parameter(s) {', '.join(missing)} non-static"
+                    ),
+                )
+            )
+
+
+# ---------------------------------------------------------------------------
+# driver
+
+
+@dataclass
+class LintReport:
+    findings: List[Finding]
+    files_scanned: int
+
+    @property
+    def active(self) -> List[Finding]:
+        return [f for f in self.findings if not f.suppressed]
+
+    @property
+    def suppressed(self) -> List[Finding]:
+        return [f for f in self.findings if f.suppressed]
+
+    def to_dict(self) -> dict:
+        counts: Dict[str, int] = {}
+        for f in self.active:
+            counts[f.rule] = counts.get(f.rule, 0) + 1
+        return {
+            "files_scanned": self.files_scanned,
+            "n_findings": len(self.active),
+            "n_suppressed": len(self.suppressed),
+            "counts_by_rule": counts,
+            "findings": [f.to_dict() for f in self.findings],
+            "rules": {
+                rid: {"title": r.title, "hint": r.hint}
+                for rid, r in RULES.items()
+            },
+        }
+
+
+def _iter_py_files(paths: Sequence[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, _dirs, files in os.walk(p):
+                if "__pycache__" in root:
+                    continue
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        out.append(os.path.join(root, f))
+        elif p.endswith(".py"):
+            out.append(p)
+    return out
+
+
+def _modname_for(path: str) -> str:
+    rel = os.path.normpath(path).replace("\\", "/")
+    rel = rel[:-3] if rel.endswith(".py") else rel
+    parts = [p for p in rel.split("/") if p not in (".", "")]
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    # anchor at the package root if the file lives inside one
+    if "bdlz_tpu" in parts:
+        parts = parts[parts.index("bdlz_tpu"):]
+    return ".".join(parts)
+
+
+def lint_paths(
+    paths: Sequence[str], rules: Optional[Sequence[str]] = None
+) -> LintReport:
+    """Lint files/directories; returns every finding (suppressed included)."""
+    selected = set(rules) if rules else set(RULES)
+    modules: List[ModuleInfo] = []
+    for path in _iter_py_files(paths):
+        with open(path, "r", encoding="utf-8") as fh:
+            source = fh.read()
+        mod = ModuleInfo(path, _modname_for(path), source)
+        _Collector(mod).visit(mod.tree)
+        modules.append(mod)
+    return _run(modules, selected)
+
+
+def lint_source(source: str, path: str = "<memory>",
+                rules: Optional[Sequence[str]] = None) -> LintReport:
+    """Lint one in-memory source blob (test/tooling convenience)."""
+    selected = set(rules) if rules else set(RULES)
+    mod = ModuleInfo(path, _modname_for(path), source)
+    _Collector(mod).visit(mod.tree)
+    return _run([mod], selected)
+
+
+def _run(modules: List[ModuleInfo], selected: Set[str]) -> LintReport:
+    project = Project(modules)
+    reachable = project.reachable_from_trace_sites()
+    findings: List[Finding] = []
+    for mod in modules:
+        _RulePass(project, mod, reachable, findings, selected).visit(mod.tree)
+        _emit_r6(project, mod, findings, selected)
+    for f in findings:
+        rules_off = modules_suppressions(project, f)
+        if "all" in rules_off or f.rule in rules_off:
+            f.suppressed = True
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return LintReport(findings=findings, files_scanned=len(modules))
+
+
+def modules_suppressions(project: Project, f: Finding) -> Set[str]:
+    for mod in project.modules:
+        if mod.path == f.path:
+            return mod.suppressions.get(f.line, set())
+    return set()

@@ -1,0 +1,97 @@
+"""Rule registry and finding type for bdlz-lint.
+
+Each rule captures one class of silent dual-backend regression; the
+analyzer (:mod:`bdlz_tpu.lint.analyzer`) decides *where* a rule applies
+(jit-reachability, directory scope), this module owns *what* each rule
+means and how a finding renders.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class Rule:
+    """One lint rule: stable id, what it catches, and how to fix it."""
+
+    id: str
+    title: str
+    hint: str
+
+
+_RULE_LIST = (
+    Rule(
+        "R1",
+        "host numpy/scipy call reachable from jit-compiled code",
+        "route arrays through the backend.py xp seam "
+        "(backend.get_namespace) — or suppress if the call provably runs "
+        "at trace time on static values",
+    ),
+    Rule(
+        "R2",
+        "Python if/while/assert on a tracer-valued expression",
+        "use xp.where / jax.lax.cond / lax.while_loop, or hoist the "
+        "predicate to a static argument",
+    ),
+    Rule(
+        "R3",
+        "host-sync call inside a hot path",
+        ".item()/float()/np.asarray/.block_until_ready() force a device "
+        "round-trip; keep values on device until the layer boundary",
+    ),
+    Rule(
+        "R4",
+        "bare float literal in a physics module",
+        "name it in constants.py — the bit-identical contract needs every "
+        "magic number to have exactly one home",
+    ),
+    Rule(
+        "R5",
+        "jax.config.update outside backend.py/conftest.py",
+        "global JAX config has one owner: call the bdlz_tpu.backend "
+        "helpers (ensure_x64 / set_debug_nans) instead",
+    ),
+    Rule(
+        "R6",
+        "jitted entry point missing static_argnums/static_argnames",
+        "structural parameters (xp, static, chi_stats, n_y, ...) must be "
+        "declared static or every distinct value recompiles; consider "
+        "donate_argnums for large input buffers",
+    ),
+)
+
+RULES = {r.id: r for r in _RULE_LIST}
+
+
+@dataclass
+class Finding:
+    """One lint finding, suppressed or not, at a file:line:col location."""
+
+    path: str
+    line: int
+    col: int
+    rule: str
+    message: str
+    suppressed: bool = False
+
+    @property
+    def hint(self) -> str:
+        return RULES[self.rule].hint
+
+    def render(self) -> str:
+        tag = " [suppressed]" if self.suppressed else ""
+        return (
+            f"{self.path}:{self.line}:{self.col}: {self.rule} "
+            f"{self.message}{tag}\n    hint: {self.hint}"
+        )
+
+    def to_dict(self) -> dict:
+        return {
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "rule": self.rule,
+            "message": self.message,
+            "hint": self.hint,
+            "suppressed": self.suppressed,
+        }

@@ -32,7 +32,7 @@ from __future__ import annotations
 
 from typing import Union
 
-import numpy as np
+import numpy as np  # host-side use only; jitted paths go through the backend.py xp seam (bdlz-lint R1 audit)
 
 from bdlz_tpu.lz.profile import BounceProfile, Crossings, find_crossings, load_profile_csv
 
@@ -165,7 +165,7 @@ def propagate_quaternion(a, b, dxi, v, xp):
     qs = _su2_quaternions(a, b, tau, xp)
     return _ordered_tree_product(
         qs, lambda q1, q2: _quat_compose(q1, q2, xp),
-        np.array([1.0, 0.0, 0.0, 0.0]), xp,
+        xp.asarray([1.0, 0.0, 0.0, 0.0]), xp,
     )
 
 

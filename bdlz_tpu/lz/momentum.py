@@ -39,7 +39,7 @@ from __future__ import annotations
 
 from typing import Tuple, Union
 
-import numpy as np
+import numpy as np  # host-side use only; jitted paths go through the backend.py xp seam (bdlz-lint R1 audit)
 
 from bdlz_tpu.lz.kernel import _segment_hamiltonians
 from bdlz_tpu.lz.profile import BounceProfile, load_profile_csv

@@ -30,7 +30,7 @@ from __future__ import annotations
 import os
 from typing import Any, NamedTuple, Union
 
-import numpy as np
+import numpy as np  # host-side use only; jitted paths go through the backend.py xp seam (bdlz-lint R1 audit)
 
 from bdlz_tpu.lz.kernel import local_lambdas
 from bdlz_tpu.lz.profile import BounceProfile, find_crossings, load_profile_csv
@@ -332,7 +332,7 @@ def make_P_of_vw_gamma_table(
     # cap the speed chunk by the same leaf-memory budget as the 1-D path:
     # the Bloch tree stages (padded_segments, 3, 3) f64 maps PER SPEED,
     # so the fixed 512 default would peak ~38 GB on a 1e6-segment profile
-    n_seg = int(np.asarray(a).shape[0])
+    n_seg = int(np.asarray(a).shape[0])  # bdlz-lint: disable=R3 — host-side table build
     padded_seg = 1 << max(n_seg - 1, 1).bit_length()
     budget = int(os.environ.get("BDLZ_LZ_SPEED_CHUNK_BYTES", 1 << 30))
     speed_chunk = max(1, min(int(speed_chunk),
@@ -349,7 +349,7 @@ def make_P_of_vw_gamma_table(
     for j, g in enumerate(gs):
         for lo in range(0, n_v, int(speed_chunk)):
             sl = slice(lo, min(lo + int(speed_chunk), n_v))
-            vals[sl, j] = np.asarray(
+            vals[sl, j] = np.asarray(  # bdlz-lint: disable=R3 — one gather per chunk is the design
                 P_chunk(jnp.asarray(vs[sl]), jnp.asarray(float(g)))
             )
     vals = np.clip(vals, 0.0, 1.0)

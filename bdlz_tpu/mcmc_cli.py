@@ -18,7 +18,7 @@ import argparse
 import json
 import sys
 
-import numpy as np
+import numpy as np  # host-side use only; jitted paths go through the backend.py xp seam (bdlz-lint R1 audit)
 
 
 def parse_param(spec: str):
@@ -44,6 +44,11 @@ def main(argv=None) -> None:
                          "building the mesh (run one identical invocation "
                          "per host; chain/summary files are written by the "
                          "coordinator)")
+    ap.add_argument("--sanitize", action="store_true",
+                    help="Runtime sanitizer: jax_debug_nans under the "
+                         "likelihood plus finiteness + float64 dtype-drift "
+                         "checks on the gathered chain at the "
+                         "sampler->output boundary")
     ap.add_argument("--out", default=None, help="Write the chain to this .npz")
     ap.add_argument("--checkpoint-dir", default=None,
                     help="Flush chain segments here incrementally; an "
@@ -96,7 +101,13 @@ def main(argv=None) -> None:
 
     import jax
 
-    jax.config.update("jax_enable_x64", True)
+    from bdlz_tpu.backend import ensure_x64
+
+    ensure_x64()
+    if args.sanitize:
+        from bdlz_tpu import sanitize
+
+        sanitize.enable(jax_nans=True)
     import jax.numpy as jnp
 
     from bdlz_tpu.config import load_config, static_choices_from_config, validate
@@ -298,6 +309,17 @@ def main(argv=None) -> None:
         # global arrays in multi-process runs; identity single-process
         full_chain, full_logp = gather_to_host((run.chain, run.logp_chain))
         acceptance = float(run.acceptance)
+
+    if args.sanitize:
+        from bdlz_tpu import sanitize
+
+        # sampler -> output boundary: walker positions must stay finite
+        # f64 (logp may legitimately be -inf outside the prior box)
+        sanitize.checkpoint("L4:sampler -> output (mcmc)", chain=full_chain)
+        sanitize.check_tree(
+            "L4:sampler -> output (mcmc)", {"logp": full_logp},
+            allow_nan=True,
+        )
 
     from bdlz_tpu.sampling.diagnostics import integrated_autocorr_time, split_rhat
 

@@ -12,7 +12,7 @@ import os
 import subprocess
 from typing import Optional, Tuple
 
-import numpy as np
+import numpy as np  # host-side use only; jitted paths go through the backend.py xp seam (bdlz-lint R1 audit)
 
 _PKG_DIR = os.path.dirname(os.path.abspath(__file__))
 _LIB_PATH = os.path.join(_PKG_DIR, "lib", "libbdlz_io.so")

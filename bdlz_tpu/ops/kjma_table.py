@@ -73,7 +73,7 @@ def make_f_table(
             host = make_f_table(
                 float(I_p), _np, n=n,
                 grid=None if grid is None
-                else KJMAGrid(*(_np.asarray(a) for a in grid)),
+                else KJMAGrid(*(_np.asarray(a) for a in grid)),  # bdlz-lint: disable=R3 — deliberate host build (accuracy-audit drift attribution)
             )
             return KJMATable(
                 y0=host.y0, inv_dy=host.inv_dy,

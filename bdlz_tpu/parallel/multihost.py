@@ -145,7 +145,7 @@ def gather_to_host(tree):
     ``np.asarray`` raises RuntimeError; ``process_allgather(tiled=True)``
     replicates it and hands back the full array on each host.
     """
-    import numpy as np
+    import numpy as np  # host-side gather/bcast buffers (bdlz-lint R1 audit)
 
     import jax
 
@@ -169,7 +169,7 @@ def allreduce_min(arr):
     coordinator-wins broadcast could force a tier some host's own
     preflight just proved fails there.
     """
-    import numpy as np
+    import numpy as np  # host-side gather/bcast buffers (bdlz-lint R1 audit)
 
     import jax
 
@@ -196,7 +196,7 @@ def broadcast_from_coordinator(arr):
     every caller — callers pass fixed-size plan arrays (e.g. one row per
     sweep chunk), never variable-length data.
     """
-    import numpy as np
+    import numpy as np  # host-side gather/bcast buffers (bdlz-lint R1 audit)
 
     import jax
 

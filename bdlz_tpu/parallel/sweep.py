@@ -26,7 +26,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, Mapping, Optional, Sequence
 
-import numpy as np
+import numpy as np  # host-side use only; jitted paths go through the backend.py xp seam (bdlz-lint R1 audit)
 
 from bdlz_tpu.config import Config, PointParams, StaticChoices, point_params_from_config
 from bdlz_tpu.constants import GEV_TO_KG
@@ -149,7 +149,9 @@ def make_sweep_step(
     """
     import jax
 
-    jax.config.update("jax_enable_x64", True)
+    from bdlz_tpu.backend import ensure_x64
+
+    ensure_x64()
     import jax.numpy as jnp
 
     from bdlz_tpu.models.yields_pipeline import point_yields, point_yields_fast
@@ -179,6 +181,18 @@ def make_sweep_step(
         except AttributeError:  # pragma: no cover
             from jax.experimental.shard_map import shard_map
 
+        # the replication-check kwarg was renamed check_rep -> check_vma
+        # across JAX releases; disable whichever this version spells
+        import inspect
+
+        _sm_params = inspect.signature(shard_map).parameters
+        if "check_vma" in _sm_params:
+            _check_kwargs = {"check_vma": False}
+        elif "check_rep" in _sm_params:  # jax <= 0.5
+            _check_kwargs = {"check_rep": False}
+        else:  # pragma: no cover
+            _check_kwargs = {}
+
         spec = P(tuple(mesh.axis_names))
         sharded = shard_map(
             batched,
@@ -186,7 +200,7 @@ def make_sweep_step(
             in_specs=(jax.tree.map(lambda _: spec, PointParams(*PointParams._fields)),
                       P()),
             out_specs=spec,
-            check_vma=False,
+            **_check_kwargs,
         )
         return jax.jit(sharded)
 

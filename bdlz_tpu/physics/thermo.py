@@ -19,7 +19,7 @@ from __future__ import annotations
 
 from typing import Any
 
-from bdlz_tpu.constants import MPL_GEV, PI, ZETA3
+from bdlz_tpu.constants import HUBBLE_COEFF, MPL_GEV, PI, ZETA3
 
 Array = Any
 
@@ -41,7 +41,7 @@ def hubble_rate(T: Array, g_star: Array, xp) -> Array:
 
     Paper Eq. 2; reference `first_principles_yields.py:84-85`.
     """
-    return 1.66 * xp.sqrt(g_star) * T * T / MPL_GEV
+    return HUBBLE_COEFF * xp.sqrt(g_star) * T * T / MPL_GEV
 
 
 def entropy_density(T: Array, g_star_s: Array, xp) -> Array:

@@ -24,7 +24,7 @@ import json
 import os
 from typing import Callable, NamedTuple
 
-import numpy as np
+import numpy as np  # host-side use only; jitted paths go through the backend.py xp seam (bdlz-lint R1 audit)
 
 
 def _load_segment(seg_file):

@@ -16,7 +16,7 @@ Both are host-side numpy (diagnostics, not hot path).
 """
 from __future__ import annotations
 
-import numpy as np
+import numpy as np  # host-side use only; jitted paths go through the backend.py xp seam (bdlz-lint R1 audit)
 
 
 def _next_pow_two(n: int) -> int:

@@ -19,7 +19,9 @@ from typing import Callable, NamedTuple
 import jax
 import jax.numpy as jnp
 
-jax.config.update("jax_enable_x64", True)
+from bdlz_tpu.backend import ensure_x64
+
+ensure_x64()
 
 
 class EnsembleState(NamedTuple):

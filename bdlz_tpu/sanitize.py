@@ -1,0 +1,141 @@
+"""Runtime sanitizer layer (the ``--sanitize`` flag on the CLIs).
+
+The static pass (:mod:`bdlz_tpu.lint`) catches structural regressions;
+this module catches the *numerical* ones at run time:
+
+* ``jax_debug_nans`` on the JAX path (any NaN produced under jit raises
+  with a traceback), enabled through the backend.py config seam;
+* finiteness assertions at the layer boundaries of the yields pipeline —
+  L1 thermo → L2 percolation → L3 source → L4 solver → output — so a NaN
+  names the layer that produced it instead of surfacing as a NaN in
+  ``yields_out.json`` three layers later;
+* a dtype-drift check asserting the float64 contract end-to-end on both
+  backends (a stray float32 literal silently erodes the 1e-6 accuracy
+  contract long before it becomes visibly wrong).
+
+Disabled (the default), every hook is a dict-lookup no-op, so the
+bit-reproducible NumPy path and the jitted TPU path are byte-for-byte
+unchanged — ``tests/test_sanitize.py`` pins that. Enabled, concrete
+(host-visible) values are checked; traced values are skipped (they have
+no data yet), which is why the single-point CLI evaluates the pipeline
+eagerly under ``--sanitize``: every boundary then sees concrete arrays,
+and ``jax_debug_nans`` still covers the primitive level.
+"""
+from __future__ import annotations
+
+from typing import Any, Iterable, Tuple
+
+import numpy as np  # the sanitizer IS the host boundary (bdlz-lint R1 audit)
+
+#: The canonical layer-boundary names (ARCHITECTURE.md layer map).
+BOUNDARY_THERMO = "L1:thermo -> L2:percolation"
+BOUNDARY_PERCOLATION = "L2:percolation -> L3:source"
+BOUNDARY_SOURCE = "L3:source -> L4:solver"
+BOUNDARY_SOLVER = "L4:solver -> output"
+
+_STATE = {"enabled": False}
+
+
+class SanitizerError(RuntimeError):
+    """A finiteness or dtype violation, tagged with its layer boundary."""
+
+    def __init__(self, boundary: str, name: str, detail: str) -> None:
+        self.boundary = boundary
+        self.name = name
+        super().__init__(
+            f"sanitizer tripped at layer boundary [{boundary}]: "
+            f"quantity {name!r} {detail}"
+        )
+
+
+def enable(jax_nans: bool = True) -> None:
+    """Arm the sanitizer; optionally also arm ``jax_debug_nans``.
+
+    ``jax_nans=False`` keeps pure-NumPy runs from paying JAX start-up.
+    """
+    _STATE["enabled"] = True
+    if jax_nans:
+        from bdlz_tpu.backend import set_debug_nans
+
+        set_debug_nans(True)
+
+
+def disable() -> None:
+    """Disarm every check (does not touch ``jax_debug_nans``)."""
+    _STATE["enabled"] = False
+
+
+def is_enabled() -> bool:
+    return _STATE["enabled"]
+
+
+def _host_view(value: Any):
+    """A host ndarray view of ``value``, or None for traced/abstract values."""
+    try:
+        return np.asarray(value)  # bdlz-lint: disable=R1,R3 — the sanitizer's job is this host sync
+    except Exception:
+        return None  # tracers carry no data; jax_debug_nans covers them
+
+
+def _check_leaf(boundary: str, name: str, value: Any, allow_nan: bool) -> None:
+    """The one home of the dtype + finiteness contract for one quantity."""
+    arr = _host_view(value)
+    if arr is None:
+        return
+    if arr.dtype.kind == "f" and arr.dtype != np.float64:
+        raise SanitizerError(
+            boundary,
+            name,
+            f"drifted to dtype {arr.dtype} (float64 contract)",
+        )
+    # concrete host arrays only (the tracer guard above): the sanitizer's
+    # host-side finiteness scan is its whole purpose
+    if (  # bdlz-lint: disable=R2 — concrete host array, not a tracer
+        not allow_nan
+        and arr.dtype.kind in "fc"
+        and not np.all(np.isfinite(arr))  # bdlz-lint: disable=R1
+    ):
+        n_bad = int(np.size(arr) - np.count_nonzero(np.isfinite(arr)))  # bdlz-lint: disable=R1
+        raise SanitizerError(
+            boundary,
+            name,
+            f"contains {n_bad} non-finite element(s) "
+            f"(shape {arr.shape}, dtype {arr.dtype})",
+        )
+
+
+def checkpoint(boundary: str, **named: Any) -> None:
+    """Assert every named quantity is finite f64 at a layer boundary.
+
+    No-op unless :func:`enable` ran. Called between the pipeline layers
+    (see :mod:`bdlz_tpu.solvers.quadrature`) and at the CLI output
+    boundary; under tracing it degrades to a no-op per value.
+    """
+    if not _STATE["enabled"]:
+        return
+    for name, value in named.items():
+        _check_leaf(boundary, name, value, allow_nan=False)
+
+
+def check_tree(boundary: str, tree: Any, allow_nan: bool = False) -> None:
+    """Checkpoint every leaf of a NamedTuple/dict/sequence of arrays.
+
+    ``allow_nan=True`` keeps the dtype-drift check but skips finiteness —
+    the sweep engine reports failed points as in-band NaN by design.
+    """
+    if not _STATE["enabled"]:
+        return
+    for name, leaf in _named_leaves(tree):
+        _check_leaf(boundary, name, leaf, allow_nan)
+
+
+def _named_leaves(tree: Any) -> Iterable[Tuple[str, Any]]:
+    if hasattr(tree, "_asdict"):
+        yield from tree._asdict().items()
+    elif isinstance(tree, dict):
+        yield from tree.items()
+    elif isinstance(tree, (list, tuple)):
+        for i, leaf in enumerate(tree):
+            yield f"[{i}]", leaf
+    else:
+        yield "value", tree

@@ -25,7 +25,7 @@ from __future__ import annotations
 import warnings
 from typing import Any, Callable, NamedTuple, Optional, Tuple
 
-import numpy as np
+import numpy as np  # host-side use only; jitted paths go through the backend.py xp seam (bdlz-lint R1 audit)
 
 from bdlz_tpu.config import PointParams
 from bdlz_tpu.physics.percolation import KJMAGrid, area_over_volume, y_of_T

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 from typing import Any
 
+from bdlz_tpu import sanitize
 from bdlz_tpu.config import PointParams
 from bdlz_tpu.physics.percolation import KJMAGrid, area_over_volume, y_of_T
 from bdlz_tpu.physics.source import source_window
@@ -75,13 +76,17 @@ def integrate_YB_quadrature(
         * n_chi_equilibrium(Ts, pp.m_chi_GeV, pp.g_chi, chi_stats, xp)
         * mean_speed_chi(Ts, pp.m_chi_GeV, xp)
     )
+    sanitize.checkpoint(sanitize.BOUNDARY_THERMO, T=Ts, H=Hs, s=ss, J_chi=Js)
     Av = area_over_volume(
         ys, pp.I_p, pp.beta_over_H, pp.T_p_GeV, pp.v_w, pp.g_star, grid, xp
     )
+    sanitize.checkpoint(sanitize.BOUNDARY_PERCOLATION, A_over_V=Av)
     SB = pp.P * Js * Av * source_window(ys, pp.sigma_y, xp)
+    sanitize.checkpoint(sanitize.BOUNDARY_SOURCE, S_B=SB)
 
     integrand = SB / (ss * Hs * Ts) * xp.abs(dTdy)
     YB = xp.trapezoid(integrand, ys)
+    sanitize.checkpoint(sanitize.BOUNDARY_SOLVER, Y_B=YB)
     return xp.where(y_hi > y_lo, YB, 0.0)
 
 
@@ -116,10 +121,13 @@ def yb_integrand_tabulated(ys: Array, pp: PointParams, chi_stats: str, table, xp
         * n_chi_equilibrium(Ts, pp.m_chi_GeV, pp.g_chi, chi_stats, xp)
         * mean_speed_chi(Ts, pp.m_chi_GeV, xp)
     )
+    sanitize.checkpoint(sanitize.BOUNDARY_THERMO, T=Ts, H=Hs, s=ss, J_chi=Js)
     Av = area_over_volume_tabulated(
         ys, pp.beta_over_H, pp.T_p_GeV, pp.v_w, pp.g_star, table, xp
     )
+    sanitize.checkpoint(sanitize.BOUNDARY_PERCOLATION, A_over_V=Av)
     SB = pp.P * Js * Av * source_window(ys, pp.sigma_y, xp)
+    sanitize.checkpoint(sanitize.BOUNDARY_SOURCE, S_B=SB)
     return SB / (ss * Hs * Ts) * xp.abs(dTdy)
 
 
@@ -164,8 +172,8 @@ def integrand_stream_probe(pp: PointParams, static, table, xp, n_y: int = 8000):
     import numpy as _np
 
     mismatch = _np.max(
-        _np.abs(_np.asarray(recombined) - _np.asarray(integrand))
-    ) / max(float(_np.max(_np.abs(_np.asarray(integrand)))), 1e-300)
+        _np.abs(_np.asarray(recombined) - _np.asarray(integrand))  # bdlz-lint: disable=R3 — audit-only consistency guard
+    ) / max(float(_np.max(_np.abs(_np.asarray(integrand)))), 1e-300)  # bdlz-lint: disable=R3
     if mismatch > 1e-12:
         raise RuntimeError(
             f"probe stages diverged from yb_integrand_tabulated by "
@@ -205,4 +213,5 @@ def integrate_YB_quadrature_tabulated(
     ys = xp.linspace(y_lo, y_hi, n_y)
     integrand = yb_integrand_tabulated(ys, pp, chi_stats, table, xp)
     YB = xp.trapezoid(integrand, ys)
+    sanitize.checkpoint(sanitize.BOUNDARY_SOLVER, Y_B=YB)
     return xp.where(y_hi > y_lo, YB, 0.0)

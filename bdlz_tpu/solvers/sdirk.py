@@ -36,11 +36,12 @@ from typing import Callable, NamedTuple, Tuple
 import jax
 import jax.numpy as jnp
 
+from bdlz_tpu.backend import ensure_x64
 from bdlz_tpu.config import PointParams, StaticChoices
 from bdlz_tpu.physics.percolation import KJMAGrid
 from bdlz_tpu.solvers.boltzmann import make_rhs
 
-jax.config.update("jax_enable_x64", True)
+ensure_x64()
 
 #: Kvaernø(4,2,3) diagonal coefficient.
 _GAMMA = 0.4358665215084589994160194511935568425

@@ -20,7 +20,7 @@ import argparse
 import json
 from typing import Dict
 
-import numpy as np
+import numpy as np  # host-side use only; jitted paths go through the backend.py xp seam (bdlz-lint R1 audit)
 
 
 def parse_axis(spec: str):
@@ -52,6 +52,14 @@ def main(argv=None) -> None:
                     help="Capture a jax.profiler trace per chunk into this dir")
     ap.add_argument("--debug-nans", action="store_true",
                     help="Raise on any NaN produced under jit (sanitizer mode)")
+    ap.add_argument("--sanitize", action="store_true",
+                    help="Runtime sanitizer: float64 dtype-drift check on "
+                         "the sweep outputs at the L4->output boundary. "
+                         "Failed points stay in-band NaN by design, so "
+                         "finiteness is n_failed's job here; combine with "
+                         "--debug-nans to instead abort at the first "
+                         "NaN-producing primitive (which includes designed "
+                         "failed-point NaNs)")
     ap.add_argument("--impl", default="tabulated",
                     choices=("tabulated", "pallas", "direct", "esdirk"),
                     help="Per-point engine: tabulated (XLA fast path), pallas "
@@ -107,7 +115,16 @@ def main(argv=None) -> None:
 
     import jax
 
-    jax.config.update("jax_enable_x64", True)
+    from bdlz_tpu.backend import ensure_x64
+
+    ensure_x64()
+    if args.sanitize:
+        from bdlz_tpu import sanitize
+
+        # no jax_debug_nans arm here: the sweep engine reports failed
+        # points as in-band NaN by design, and debug-nans would abort on
+        # the first one — that stricter mode stays opt-in (--debug-nans)
+        sanitize.enable(jax_nans=False)
     if args.debug_nans:
         from bdlz_tpu.utils.profiling import enable_nan_debugging
 
@@ -144,6 +161,16 @@ def main(argv=None) -> None:
         lz_profile=args.lz_profile, lz_method=args.lz_method,
         lz_gamma_phi=args.lz_gamma_phi,
     )
+
+    if args.sanitize:
+        from bdlz_tpu import sanitize
+
+        # L4 -> output boundary: dtype drift is a hard error; failed
+        # points are reported as in-band NaN by design, so finiteness is
+        # res.n_failed's job, not the sanitizer's
+        sanitize.check_tree(
+            "L4:solver -> output (sweep)", res.outputs, allow_nan=True
+        )
 
     ratios = res.outputs["DM_over_B"]
     finite = np.isfinite(ratios)

@@ -59,7 +59,10 @@ def ensure_live_backend(label: str = "bdlz", force_cpu: bool = False) -> bool:
     if force_cpu:
         import jax
 
-        jax.config.update("jax_platforms", "cpu")
+        # backend.py itself depends on this guard (jax_numpy probes the
+        # relay before the first backend touch), so the platform pin
+        # cannot route through the backend helpers without a cycle.
+        jax.config.update("jax_platforms", "cpu")  # bdlz-lint: disable=R5
     return force_cpu
 
 

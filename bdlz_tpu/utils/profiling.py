@@ -23,6 +23,6 @@ def enable_nan_debugging(enable: bool = True) -> None:
     """NaN-checking mode — the numerical analog of a sanitizer (SURVEY §5):
     the reference papers over edge cases with floors (1e-30…1e-300); this
     makes any NaN produced under jit raise with a traceback instead."""
-    import jax
+    from bdlz_tpu.backend import set_debug_nans
 
-    jax.config.update("jax_debug_nans", enable)
+    set_debug_nans(enable)

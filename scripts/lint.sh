@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# The repo's one lint command: ruff (pycodestyle/pyflakes baseline, config
+# in pyproject.toml) + bdlz-lint (the JAX-aware R1-R6 pass over bdlz_tpu/).
+# Exit 0 only when both passes are clean; a missing ruff binary downgrades
+# the style baseline to a warning (this container doesn't ship it) rather
+# than masking the bdlz-lint result.
+set -u
+cd "$(dirname "$0")/.."
+
+rc=0
+
+if command -v ruff >/dev/null 2>&1; then
+    echo "[lint] ruff check ."
+    ruff check . || rc=1
+else
+    echo "[lint] ruff not installed; skipping the style baseline" \
+         "(pip install ruff to enable)" >&2
+fi
+
+echo "[lint] python -m bdlz_tpu.lint bdlz_tpu/"
+python -m bdlz_tpu.lint bdlz_tpu/ || rc=1
+
+exit $rc

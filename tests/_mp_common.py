@@ -1,0 +1,55 @@
+"""Shared plumbing for the 2-process ``jax.distributed`` tests.
+
+One home for the JAX-version quirks the multihost workers and their
+parent tests all hit, so the next quirk is fixed once:
+
+* ``force_local_device_count`` — the XLA_FLAGS override that must run
+  BEFORE the worker's first ``import jax`` (old JAX has no
+  ``jax_num_cpu_devices`` config option, and the flag inherited from the
+  parent pytest process pins 8 devices, not the worker's 2);
+* ``pin_worker_platform`` — the in-process config pin (host CPU, x64,
+  and the device count again on JAX versions that support it);
+* ``assert_worker_ok`` — the parent-side result check, including the
+  capability skip for JAX builds whose CPU backend has no multiprocess
+  collectives (the 2-process path cannot run there at all).
+"""
+import os
+import re
+
+
+def force_local_device_count(n: int) -> None:
+    """Pin XLA's virtual host-CPU device count; call before ``import jax``."""
+    flags = re.sub(
+        r"--xla_force_host_platform_device_count=\d+",
+        "",
+        os.environ.get("XLA_FLAGS", ""),
+    )
+    os.environ["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={n}"
+    ).strip()
+
+
+def pin_worker_platform(jax, n_devices: int) -> None:
+    """In-process config (not env vars) is the reliable pin in this
+    container; must happen before any backend touch."""
+    jax.config.update("jax_platforms", "cpu")
+    # only newer JAX has the config option; older releases got the count
+    # from force_local_device_count() before jax was imported
+    if hasattr(jax.config, "jax_num_cpu_devices"):
+        jax.config.update("jax_num_cpu_devices", n_devices)
+    jax.config.update("jax_enable_x64", True)
+
+
+def assert_worker_ok(rc: int, out: str, err: str) -> None:
+    import pytest
+
+    if rc != 0 and "Multiprocess computations aren't implemented" in (
+        out + err
+    ):
+        # this JAX build's CPU backend has no multiprocess collectives:
+        # the 2-process path cannot run here at all
+        pytest.skip(
+            "JAX CPU backend lacks multiprocess collectives in this "
+            "environment"
+        )
+    assert rc == 0, f"worker failed (rc={rc}):\n{out}\n{err}"

@@ -14,6 +14,12 @@ import sys
 
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
+from _mp_common import force_local_device_count, pin_worker_platform
+
+# must run before the first `import jax` (overrides the parent pytest
+# process's 8-device flag)
+force_local_device_count(2)
+
 
 def main() -> None:
     port, pid, out_dir = sys.argv[1], int(sys.argv[2]), sys.argv[3]
@@ -22,9 +28,7 @@ def main() -> None:
 
     # In-process config (not env vars) is the reliable way to pin the CPU
     # platform in this container; must happen before any backend touch.
-    jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 2)
-    jax.config.update("jax_enable_x64", True)
+    pin_worker_platform(jax, 2)
 
     from bdlz_tpu.parallel.multihost import init_multihost
 

@@ -32,7 +32,11 @@ import jax
 # alive when the axon relay is down (observed: a dead relay makes ANY
 # jax.devices() call hang if axon is still in the platform list).
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+# 'jax_num_cpu_devices' only exists in newer JAX (>= 0.5); older releases
+# get the 8 virtual devices from the XLA_FLAGS fallback set above, which
+# must be in the environment before the first `import jax`.
+if hasattr(jax.config, "jax_num_cpu_devices"):
+    jax.config.update("jax_num_cpu_devices", 8)
 jax.config.update("jax_enable_x64", True)
 assert jax.devices()[0].platform == "cpu", "tests must run on host CPU"
 

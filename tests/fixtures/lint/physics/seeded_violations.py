@@ -1,0 +1,28 @@
+"""bdlz-lint test fixture: exactly one seeded violation per rule R1-R6.
+
+Lives under a ``physics/`` directory on purpose — that puts it in scope
+for the directory-scoped rules (R3 hot paths, R4 magic floats). Never
+imported; parsed by the analyzer only (tests/test_lint.py).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# R5: global config write outside backend.py/conftest.py
+jax.config.update("jax_enable_x64", True)
+
+
+def hot_kernel(x, n_y):
+    # R2: Python branch on the traced parameter `x`
+    if x > 0.0:
+        x = x + 1.0
+    # R1: host numpy call inside jit-reachable code
+    y = np.asarray(x)
+    # R3: host sync inside a hot path
+    z = float(x)
+    # R4: magic float in a physics module (belongs in constants.py)
+    return jnp.sin(y) * 1.6603 + z
+
+
+# R6: jitted entry point leaves the structural parameter n_y non-static
+compiled = jax.jit(hot_kernel)

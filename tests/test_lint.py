@@ -1,0 +1,106 @@
+"""Tier-1 pins for bdlz-lint (the JAX-aware static-analysis pass).
+
+Two directions, both load-bearing:
+
+* the package itself must stay at ZERO unsuppressed findings — every
+  rule-class regression (host np in jit, tracer branches, host syncs,
+  magic floats, stray config writes, missing static_argnums) becomes a
+  CI failure from now on;
+* the analyzer must actually catch each class: a fixture with one
+  seeded violation per rule must trip all six.
+"""
+import json
+import pathlib
+import subprocess
+import sys
+
+from bdlz_tpu.lint import RULES, lint_paths, lint_source
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
+PACKAGE = REPO_ROOT / "bdlz_tpu"
+FIXTURE = (
+    REPO_ROOT / "tests" / "fixtures" / "lint" / "physics"
+    / "seeded_violations.py"
+)
+
+
+def _run_cli(*argv: str) -> subprocess.CompletedProcess:
+    return subprocess.run(
+        [sys.executable, "-m", "bdlz_tpu.lint", *argv],
+        cwd=REPO_ROOT,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+
+
+def test_package_has_zero_unsuppressed_findings():
+    report = lint_paths([str(PACKAGE)])
+    assert report.files_scanned > 40
+    offenders = "\n".join(f.render() for f in report.active)
+    assert not report.active, f"unsuppressed bdlz-lint findings:\n{offenders}"
+
+
+def test_cli_exits_zero_on_package():
+    proc = _run_cli("bdlz_tpu")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_fixture_trips_every_rule():
+    report = lint_paths([str(FIXTURE)])
+    tripped = {f.rule for f in report.active}
+    assert tripped == set(RULES), (
+        f"expected all of {sorted(RULES)}, got {sorted(tripped)}"
+    )
+
+
+def test_cli_exits_nonzero_on_fixture_with_json_report():
+    proc = _run_cli(str(FIXTURE), "--format", "json")
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload["n_findings"] == 6
+    assert set(payload["counts_by_rule"]) == set(RULES)
+    assert all(
+        {"path", "line", "col", "rule", "message", "hint", "suppressed"}
+        <= set(f)
+        for f in payload["findings"]
+    )
+
+
+def test_per_line_suppression_syntax():
+    source = FIXTURE.read_text()
+    suppressed = source.replace(
+        "y = np.asarray(x)",
+        "y = np.asarray(x)  # bdlz-lint: disable=R1",
+    )
+    report = lint_source(suppressed, path="physics/seeded_variant.py")
+    assert {f.rule for f in report.active} == set(RULES) - {"R1"}
+    assert [f.rule for f in report.suppressed] == ["R1"]
+
+    all_off = "\n".join(
+        line + "  # bdlz-lint: disable=all" for line in source.splitlines()
+    )
+    report = lint_source(all_off, path="physics/seeded_variant.py")
+    assert not report.active
+    assert len(report.suppressed) == 6
+
+
+def test_rule_subset_selection():
+    proc = _run_cli(str(FIXTURE), "--rules", "R5", "--format", "json")
+    assert proc.returncode == 1
+    payload = json.loads(proc.stdout)
+    assert set(payload["counts_by_rule"]) == {"R5"}
+
+
+def test_shape_metadata_branches_are_not_tracer_branches():
+    # xs.shape[0] is trace-static: looping on it is host control flow
+    source = (
+        "import jax\n"
+        "def body(xs):\n"
+        "    while xs.shape[0] > 1:\n"
+        "        xs = xs.reshape((-1, 2) + xs.shape[1:])[:, 0]\n"
+        "    return xs\n"
+        "run = jax.jit(body)\n"
+    )
+    report = lint_source(source, path="ops/tree_product.py")
+    assert not [f for f in report.active if f.rule == "R2"]

@@ -11,6 +11,8 @@ import sys
 import numpy as np
 import pytest
 
+from _mp_common import assert_worker_ok
+
 from bdlz_tpu.parallel import (
     batch_sharding,
     init_multihost,
@@ -114,7 +116,7 @@ def test_two_process_sweep(tmp_path):
             if p.poll() is None:
                 p.kill()
     for rc, out, err in outs:
-        assert rc == 0, f"worker failed (rc={rc}):\n{out}\n{err}"
+        assert_worker_ok(rc, out, err)
         assert "OK" in out
 
     # Both processes saw the identical gathered result, and it matches a
@@ -172,7 +174,7 @@ def test_two_process_mcmc(tmp_path):
             if p.poll() is None:
                 p.kill()
     for rc, out, err in outs:
-        assert rc == 0, f"worker failed (rc={rc}):\n{out}\n{err}"
+        assert_worker_ok(rc, out, err)
         assert "OK" in out
 
     # both processes gathered the identical global chain
@@ -221,5 +223,5 @@ def test_divergent_kernel_knob_raises_fleetwide(tmp_path):
             if p.poll() is None:
                 p.kill()
     for rc, out, err in outs:
-        assert rc == 0, f"worker failed (rc={rc}):\n{out}\n{err}"
+        assert_worker_ok(rc, out, err)
         assert "KNOB-MISMATCH-RAISED" in out

@@ -1,0 +1,154 @@
+"""Runtime-sanitizer layer: NaN localization, dtype drift, and the
+byte-for-byte no-op contract of the default path.
+
+The headline case is the ISSUE's: a config that drives
+``n_chi_equilibrium`` into NaN territory (negative percolation
+temperature — ``validate()`` trusts T_p exactly as the reference does,
+and ``T**1.5`` at T<0 is NaN in the selected Maxwell-Boltzmann branch)
+must (a) raise under ``--sanitize`` with the offending layer boundary
+named, and (b) run byte-for-byte unchanged without it — the NaN is
+silently where-masked into a garbage DM/B ratio, which is exactly the
+failure class the sanitizer exists to catch.
+"""
+import json
+import pathlib
+
+import numpy as np
+import pytest
+
+from bdlz_tpu import sanitize
+from bdlz_tpu.cli import main as cli_main
+from bdlz_tpu.sanitize import SanitizerError
+
+_BASE_CFG = {
+    "regime": "nonthermal",
+    "m_chi_GeV": 0.95,
+    "g_chi": 2,
+    "chi_stats": "fermion",
+    "sigma_v_chi_GeV_m2": 0.0,
+    "T_p_GeV": 100.0,
+    "beta_over_H": 100.0,
+    "v_w": 0.30,
+    "I_p": 0.34,
+    "g_star": 106.75,
+    "g_star_s": 106.75,
+    "P_chi_to_B": 0.14925839040304145,
+    "source_shape_sigma_y": 9.0,
+    "Gamma_wash_over_H": 0.0,
+    "incident_flux_scale": 1.07e-9,
+    "deplete_DM_from_source": False,
+    "T_max_over_Tp": 5.0,
+    "T_min_over_Tp": 0.001,
+    "Y_chi_init": 4.90e-10,
+    "n_chi_at_Tp_GeV3": None,
+}
+
+
+@pytest.fixture(autouse=True)
+def _sanitizer_off_after():
+    yield
+    sanitize.disable()
+
+
+def _write_cfg(tmp_path: pathlib.Path, name: str, **overrides) -> str:
+    cfg = dict(_BASE_CFG, **overrides)
+    path = tmp_path / name
+    path.write_text(json.dumps(cfg, indent=2))
+    return str(path)
+
+
+def _run_cli(monkeypatch, tmp_path, capsys, argv):
+    monkeypatch.chdir(tmp_path)
+    cli_main(argv)
+    out = capsys.readouterr().out
+    return out, (tmp_path / "yields_out.json").read_bytes()
+
+
+def test_nan_config_trips_sanitizer_with_boundary_named(tmp_path, monkeypatch):
+    cfg = _write_cfg(tmp_path, "nan.json", T_p_GeV=-100.0)
+    monkeypatch.chdir(tmp_path)
+    with pytest.raises(SanitizerError) as exc_info:
+        cli_main(["--config", cfg, "--sanitize"])
+    message = str(exc_info.value)
+    assert sanitize.BOUNDARY_THERMO in message  # the offending boundary
+    assert "J_chi" in message                   # ... and quantity
+    assert exc_info.value.boundary == sanitize.BOUNDARY_THERMO
+
+
+def test_nan_config_without_flag_is_byte_identical(
+    tmp_path, monkeypatch, capsys
+):
+    """No --sanitize => no behavioral delta, even after an arm/disarm
+    cycle has exercised the sanitizer machinery in-process."""
+    cfg = _write_cfg(tmp_path, "nan.json", T_p_GeV=-100.0)
+    argv = ["--config", cfg]
+
+    out_before, json_before = _run_cli(monkeypatch, tmp_path, capsys, argv)
+
+    sanitize.enable(jax_nans=False)
+    sanitize.disable()
+
+    out_after, json_after = _run_cli(monkeypatch, tmp_path, capsys, argv)
+
+    assert out_before == out_after
+    assert json_before == json_after
+    # and the run really did mask the NaN into the archived outputs —
+    # the silent failure class the sanitizer exists for
+    assert "=== Results (today) ===" in out_before
+
+
+def test_clean_config_passes_sanitized_and_matches_default(
+    tmp_path, monkeypatch, capsys
+):
+    cfg = _write_cfg(tmp_path, "ok.json")
+    out_plain, json_plain = _run_cli(
+        monkeypatch, tmp_path, capsys, ["--config", cfg]
+    )
+    sanitize.disable()  # the --sanitize run below re-arms it itself
+    out_san, json_san = _run_cli(
+        monkeypatch, tmp_path, capsys, ["--config", cfg, "--sanitize"]
+    )
+    assert out_plain == out_san
+    assert json_plain == json_san
+
+
+def test_checkpoint_flags_dtype_drift():
+    sanitize.enable(jax_nans=False)
+    with pytest.raises(SanitizerError) as exc_info:
+        sanitize.checkpoint(
+            sanitize.BOUNDARY_SOLVER, Y_B=np.ones(4, dtype=np.float32)
+        )
+    assert "float32" in str(exc_info.value)
+    assert "Y_B" in str(exc_info.value)
+
+
+def test_checkpoint_is_noop_when_disabled():
+    sanitize.disable()
+    sanitize.checkpoint(
+        sanitize.BOUNDARY_SOLVER,
+        Y_B=np.array([np.nan]),
+        bad_dtype=np.ones(2, dtype=np.float32),
+    )  # must not raise
+
+
+def test_check_tree_named_tuple_and_allow_nan():
+    from bdlz_tpu.models.yields_pipeline import YieldsResult
+
+    sanitize.enable(jax_nans=False)
+    good = YieldsResult(*(np.float64(v) for v in (1.0, 2.0, 3.0, 4.0, 5.0)))
+    sanitize.check_tree(sanitize.BOUNDARY_SOLVER, good)
+
+    bad = good._replace(Y_B=np.float64(np.nan))
+    with pytest.raises(SanitizerError) as exc_info:
+        sanitize.check_tree(sanitize.BOUNDARY_SOLVER, bad)
+    assert "Y_B" in str(exc_info.value)
+
+    # allow_nan keeps only the dtype contract (sweep outputs carry
+    # in-band NaN for failed points by design)
+    sanitize.check_tree(sanitize.BOUNDARY_SOLVER, bad, allow_nan=True)
+    with pytest.raises(SanitizerError):
+        sanitize.check_tree(
+            sanitize.BOUNDARY_SOLVER,
+            bad._replace(Y_chi=np.ones(2, dtype=np.float32)),
+            allow_nan=True,
+        )
