@@ -110,6 +110,19 @@ class Config:
     # accuracy/steps tradeoff table in docs/perf_notes.md.
     ode_rtol: float = 1e-8
     ode_atol: float = 1e-17
+    # Stiff-engine acceleration knobs (solvers/batching.py).  None means
+    # "engine decides": the repacked batch engine (the sweep default)
+    # turns them ON, the bit-pinned lockstep/per-point paths keep them
+    # OFF so existing golden results are byte-identical.  Explicit
+    # True/False overrides both engines.
+    #   ode_auto_h0       — Hairer–Wanner automatic initial-step selection
+    #   ode_pi_controller — PI (proportional–integral) step-size control
+    #   ode_tabulated_av  — evaluate A/V through the F(y) table instead of
+    #                       the per-step (n_z,) z-integral (~2e-11 rel
+    #                       shift; requires uniform I_p across the batch)
+    ode_auto_h0: Optional[bool] = None
+    ode_pi_controller: Optional[bool] = None
+    ode_tabulated_av: Optional[bool] = None
 
 
 def default_config() -> Dict[str, Any]:
@@ -157,6 +170,11 @@ def write_template(path: str, include_extensions: bool = False) -> None:
 #: identity: they change numerical results, so a future change to their
 #: *defaults* must also invalidate old checkpoints (omit-at-default
 #: would silently splice results computed at two different settings).
+#: The tri-state engine knobs (ode_auto_h0/ode_pi_controller/
+#: ode_tabulated_av) are NOT listed here because their None default is
+#: resolved per-engine — the sweep layer folds the RESOLVED values into
+#: its manifest hash instead (run_sweep's esdirk hash_extra), which pins
+#: the same invariant without invalidating every non-stiff directory.
 RESULT_AFFECTING_EXTENSIONS = ("ode_method", "ode_rtol", "ode_atol")
 
 
@@ -243,6 +261,10 @@ def validate(cfg: Config, backend: Optional[str] = None) -> Config:
         )
     if not (cfg.ode_rtol > 0.0 and cfg.ode_atol > 0.0):
         raise ConfigError("ode_rtol and ode_atol must be positive")
+    for k in ("ode_auto_h0", "ode_pi_controller", "ode_tabulated_av"):
+        v = getattr(cfg, k)
+        if v is not None and not isinstance(v, bool):
+            raise ConfigError(f"{k} must be true, false, or null, got {v!r}")
     return cfg
 
 
@@ -283,6 +305,12 @@ class StaticChoices(NamedTuple):
     ode_method: str = "sdirk4"
     ode_rtol: float = 1e-8
     ode_atol: float = 1e-17
+    # None = per-engine default (see Config): lockstep/per-point paths
+    # resolve None -> False (bit-pinned), the repacked batch engine
+    # resolves None -> True.
+    ode_auto_h0: Optional[bool] = None
+    ode_pi_controller: Optional[bool] = None
+    ode_tabulated_av: Optional[bool] = None
 
 
 def resolve_Y_chi_init(cfg: Config) -> float:
@@ -335,4 +363,7 @@ def static_choices_from_config(cfg: Config) -> StaticChoices:
         ode_method=cfg.ode_method,
         ode_rtol=float(cfg.ode_rtol),
         ode_atol=float(cfg.ode_atol),
+        ode_auto_h0=cfg.ode_auto_h0,
+        ode_pi_controller=cfg.ode_pi_controller,
+        ode_tabulated_av=cfg.ode_tabulated_av,
     )

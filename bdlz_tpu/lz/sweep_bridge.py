@@ -37,6 +37,13 @@ from bdlz_tpu.lz.profile import BounceProfile, find_crossings, load_profile_csv
 
 VALID_METHODS = ("local", "coherent", "local-momentum", "dephased")
 
+#: Trace-count telemetry: incremented each time a jitted inner function's
+#: Python body actually runs (i.e. on compilation, not on cached calls).
+#: Tests pin the one-compile contracts with it — e.g. the 2-D table
+#: build's ragged tail chunk must be padded to the common shape, not
+#: traced as a second program.
+TRACE_COUNTS: "dict[str, int]" = {"P_chunk_2d": 0}
+
 
 def profile_fingerprint(profile: Union[str, BounceProfile]) -> str:
     """Stable identity of a profile for sweep-manifest hashing."""
@@ -336,22 +343,35 @@ def make_P_of_vw_gamma_table(
     padded_seg = 1 << max(n_seg - 1, 1).bit_length()
     budget = int(os.environ.get("BDLZ_LZ_SPEED_CHUNK_BYTES", 1 << 30))
     speed_chunk = max(1, min(int(speed_chunk),
-                             budget // max(padded_seg * 8 * 9, 1)))
+                             budget // max(padded_seg * 8 * 9, 1),
+                             n_v))  # never pad a short table UP to the chunk
 
     @jax.jit
     def P_chunk(v_chunk, g):
         # make_P_of_speed is gamma-closure-based; rebuild inside the jit so
         # g stays a traced argument (one compile per chunk SHAPE, not per Γ)
+        TRACE_COUNTS["P_chunk_2d"] += 1  # Python body runs only on trace
         P_of_speed = make_P_of_speed("dephased", a, b, dxi, g, jnp)
         return jax.vmap(P_of_speed)(v_chunk)
 
+    # Ragged tail chunks are padded to the common chunk shape with the
+    # last speed (mirroring probabilities_for_points) so the jitted
+    # program compiles ONCE even when speed_chunk does not divide n_v —
+    # the tail's second compile cost ~the whole first chunk's on long
+    # profiles.  One-compile contract pinned via TRACE_COUNTS in tests.
+    speed_chunk = int(speed_chunk)
     vals = np.empty((n_v, n_g))
     for j, g in enumerate(gs):
-        for lo in range(0, n_v, int(speed_chunk)):
-            sl = slice(lo, min(lo + int(speed_chunk), n_v))
-            vals[sl, j] = np.asarray(  # bdlz-lint: disable=R3 — one gather per chunk is the design
-                P_chunk(jnp.asarray(vs[sl]), jnp.asarray(float(g)))
-            )
+        for lo in range(0, n_v, speed_chunk):
+            hi = min(lo + speed_chunk, n_v)
+            sp = vs[lo:hi]
+            if hi - lo < speed_chunk:
+                sp = np.concatenate(
+                    [sp, np.broadcast_to(vs[-1], (speed_chunk - (hi - lo),))]
+                )
+            vals[lo:hi, j] = np.asarray(  # bdlz-lint: disable=R3 — one gather per chunk is the design
+                P_chunk(jnp.asarray(sp), jnp.asarray(float(g)))
+            )[: hi - lo]
     vals = np.clip(vals, 0.0, 1.0)
     return PTable2D(
         u0=1.0 / v_hi,

@@ -135,17 +135,32 @@ def make_sweep_step(
     interpret: bool = False,
     fuse_exp: bool = False,
     reduce: "bool | None" = None,
+    esdirk_stats_sink=None,
+    esdirk_knobs: "dict | None" = None,
 ):
     """Compile the per-chunk step: batched pipeline, batch sharded over the mesh.
 
     Returns ``step(pp_chunk, aux) -> YieldsResult`` of arrays, where ``aux``
     is the F-table (``impl="tabulated"``), the raw KJMA z-grid
-    (``impl="direct"``), or ``(table, shifted_table)`` (``impl="pallas"`` —
-    the MXU interpolation kernel, the fastest path on real TPU hardware).
-    With a mesh, inputs are expected batch-sharded (see ``shard_chunk``);
-    XLA compiles a pure SPMD program with no collectives; the pallas step
-    is wrapped in ``shard_map`` so each device runs the kernel on its own
-    batch shard.
+    (``impl="direct"`` and both stiff engines), or ``(table,
+    shifted_table)`` (``impl="pallas"`` — the MXU interpolation kernel,
+    the fastest path on real TPU hardware).  With a mesh, inputs are
+    expected batch-sharded (see ``shard_chunk``); XLA compiles a pure
+    SPMD program with no collectives; the pallas step is wrapped in
+    ``shard_map`` so each device runs the kernel on its own batch shard.
+
+    The stiff regime has two strategies: ``impl="esdirk"`` is the
+    rounds-based lane-repacking engine (``solvers/batching.py`` — the
+    default; host-orchestrated, so the returned step is a plain callable
+    rather than a jitted function), ``impl="esdirk_lockstep"`` the
+    legacy single-program vmapped loop kept for A/B and for
+    multi-controller runs (host compaction needs addressable lanes).
+    ``esdirk_stats_sink`` (repacking engine only) receives each chunk's
+    :class:`~bdlz_tpu.utils.profiling.CompactionStats`;
+    ``esdirk_knobs`` pins one engine-knob resolution across all chunks
+    (``run_sweep`` resolves over the FULL grid so chunk boundaries never
+    change which RHS kernel runs — the resolution is part of the resume
+    hash).
     """
     import jax
 
@@ -158,6 +173,14 @@ def make_sweep_step(
 
     if not use_table and impl in ("tabulated", "pallas"):
         impl = "direct"
+
+    if impl == "esdirk":
+        from bdlz_tpu.solvers.batching import make_batched_esdirk_step
+
+        return make_batched_esdirk_step(
+            static, mesh=mesh, stats_sink=esdirk_stats_sink,
+            knobs=esdirk_knobs,
+        )
 
     if impl == "pallas":
         from bdlz_tpu.ops.kjma_pallas import REDUCE_DEFAULT, point_yields_pallas
@@ -210,12 +233,14 @@ def make_sweep_step(
     elif impl == "direct":
         def one(pp, grid):
             return point_yields(pp, static, grid, jnp)
-    elif impl == "esdirk":
-        # General (stiff) regime: σv > 0, washout, or DM depletion make the
-        # fast quadrature invalid — evolve the coupled Boltzmann system
-        # with the vmappable ESDIRK integrator instead (lanes carry their
-        # own adaptive steps in lockstep; failures surface as NaN so the
-        # sweep's mask-and-report path handles them).
+    elif impl == "esdirk_lockstep":
+        # General (stiff) regime, legacy strategy: σv > 0, washout, or DM
+        # depletion make the fast quadrature invalid — evolve the coupled
+        # Boltzmann system with the vmappable ESDIRK integrator (lanes
+        # carry their own adaptive steps in lockstep; finished lanes idle
+        # under masking until the whole batch converges — the repacked
+        # impl="esdirk" engine removes exactly that; failures surface as
+        # NaN so the sweep's mask-and-report path handles them).
         from bdlz_tpu.models.yields_pipeline import YieldsResult, present_day
         from bdlz_tpu.physics.thermo import entropy_density, n_chi_equilibrium
         from bdlz_tpu.solvers.sdirk import solve_boltzmann_esdirk
@@ -303,7 +328,7 @@ def _clamp_chunk_to_memory(chunk_size: int, n_y: int, mesh, impl: str) -> int:
     nz = 1200  # the reference's fixed z-grid (scheme-as-spec)
     if impl == "direct":
         per_point_bytes = 3 * max(int(n_y), 1) * nz * 8
-    elif impl == "esdirk":
+    elif impl in ("esdirk", "esdirk_lockstep"):
         per_point_bytes = 32 * nz * 8
     else:  # tabulated / pallas fast paths
         per_point_bytes = 20 * max(int(n_y), 1) * 8
@@ -335,6 +360,42 @@ def _clamp_chunk_to_memory(chunk_size: int, n_y: int, mesh, impl: str) -> int:
 _TIER_CODE = {False: 0, True: 1, None: 2}
 _TIER_FROM_CODE = {code: tier for tier, code in _TIER_CODE.items()}
 _TIER_FAILED = -2
+#: Version of the tier-agreement wire vector.  Bump whenever the CODE
+#: TABLE above (or the vector layout) changes meaning: the agreement
+#: vector carries [version, -version, code], so a fleet mixing binaries
+#: with different tables fails with an explicit version-skew error
+#: instead of min() silently resolving a code one side interprets
+#: differently (or a bare KeyError three calls later).  WIRE-FORMAT
+#: BREAK (r6): pre-r6 binaries sent a length-1 vector — mixing them
+#: with r6+ fails the allgather shape check fleet-wide at startup,
+#: which is the intended outcome, just with a blunter message (see
+#: docs/multihost.md "Startup agreement").
+_TIER_WIRE_VERSION = 1
+
+
+def _agree_tier_code(local_code: int) -> int:
+    """Fleet-agree on the pallas tier over a VERSIONED allreduce vector.
+
+    Elementwise min over ``[version, -version, code]`` yields
+    ``[min_v, -max_v, min_code]``: any version spread across the fleet
+    (mixed binaries whose tier tables may disagree) raises the same
+    explicit error on every host before the code is interpreted.
+    """
+    from bdlz_tpu.parallel.multihost import allreduce_min
+
+    vec = np.asarray(allreduce_min(np.array(
+        [_TIER_WIRE_VERSION, -_TIER_WIRE_VERSION, int(local_code)],
+        dtype=np.int64,
+    )))
+    v_min, v_max = int(vec[0]), -int(vec[1])
+    if v_min != _TIER_WIRE_VERSION or v_max != _TIER_WIRE_VERSION:
+        raise RuntimeError(
+            "pallas tier-agreement wire-format version skew across the "
+            f"fleet (min {v_min}, max {v_max}; this host "
+            f"{_TIER_WIRE_VERSION}): all hosts must run the same "
+            "bdlz_tpu build"
+        )
+    return int(vec[2])
 
 
 def resolve_pallas_tier(
@@ -544,7 +605,9 @@ def run_sweep(
         chunk_size = ((max(chunk_size, n_dev) + n_dev - 1) // n_dev) * n_dev
     # The fast quadrature impls are only valid without annihilation,
     # washout, or source depletion (the reference's can_quad guard, :372);
-    # a sweep touching those knobs is routed to the stiff ESDIRK path.
+    # a sweep touching those knobs is routed to the stiff ESDIRK path —
+    # by default the lane-repacking batch engine, unless the caller
+    # explicitly pinned the legacy lockstep strategy.
     from bdlz_tpu.config import needs_ode_path
 
     needs_ode = (
@@ -556,17 +619,24 @@ def run_sweep(
         )
     )
     requested_impl = impl
-    if needs_ode:
+    reason = None
+    if needs_ode and impl != "esdirk_lockstep":
         impl = "esdirk"
+        reason = "stiff regime: sigma_v/washout/depletion active"
     use_table = "I_p" not in axes
     if not use_table and impl in ("tabulated", "pallas"):
         impl = "direct"
+        reason = "I_p swept: per-I_p table unavailable"
+    if impl == "esdirk" and jax.process_count() > 1:
+        # host-side lane compaction needs every lane addressable; a
+        # multi-controller chunk is a global array whose shards live on
+        # other hosts — run the single-program lockstep strategy there
+        impl = "esdirk_lockstep"
+        reason = "multi-controller run: host lane-compaction needs addressable lanes"
     if impl != requested_impl:
         print(
             f"[sweep] impl {requested_impl!r} is invalid for this configuration; "
-            f"using {impl!r} "
-            + ("(stiff regime: sigma_v/washout/depletion active)" if needs_ode
-               else "(I_p swept: per-I_p table unavailable)"),
+            f"using {impl!r} ({reason})",
             file=sys.stderr,
         )
         if fuse_exp:
@@ -583,7 +653,7 @@ def run_sweep(
 
     chunk_size = int(np.asarray(_bcast(np.array([chunk_size])))[0])
     pallas_reduce: "bool | None" = None  # resolved tier (None = kernel default)
-    if impl in ("direct", "esdirk"):
+    if impl in ("direct", "esdirk", "esdirk_lockstep"):
         aux = make_kjma_grid(jnp)
     else:
         table = make_f_table(float(base.I_p), jnp, n=table_nodes)
@@ -641,7 +711,7 @@ def run_sweep(
             # whose preflight failed entirely (-2) fails the whole fleet
             # together instead of deadlocking a later collective.
             _local_code = _tier_code
-            _tier_code = int(np.asarray(_armin(np.array([_tier_code])))[0])
+            _tier_code = _agree_tier_code(_tier_code)
             if _tier_code == _TIER_FAILED:
                 raise RuntimeError(
                     "no pallas kernel tier preflights clean on every host "
@@ -681,9 +751,25 @@ def run_sweep(
             aux = (table, build_shifted_table(table))
         else:
             aux = table
+    esdirk_knobs = None
+    if impl == "esdirk":
+        # Resolve the repacked engine's tri-state knobs ONCE over the
+        # FULL grid's I_p column and pass the same dict to every chunk:
+        # per-chunk re-resolution would let chunk boundaries slicing an
+        # I_p axis flip tabulated_av chunk-by-chunk — numerics keyed on
+        # chunk_size, which the resume hash below does not include.
+        from bdlz_tpu.solvers.batching import resolve_engine_knobs
+
+        esdirk_knobs = resolve_engine_knobs(static, np.asarray(pp_all.I_p))
+    # Per-chunk compaction stats from the repacked stiff engine flow to
+    # the event log (one "esdirk_rounds" event per chunk) — the repacking
+    # exists to retire lanes early, and that claim needs numbers attached.
+    _esdirk_stats_holder: list = []
     step = make_sweep_step(
         static, mesh=mesh, n_y=n_y, use_table=use_table, impl=impl,
         interpret=interpret, fuse_exp=fuse_exp, reduce=pallas_reduce,
+        esdirk_stats_sink=_esdirk_stats_holder.append,
+        esdirk_knobs=esdirk_knobs,
     )
 
     from bdlz_tpu.parallel.multihost import (
@@ -728,6 +814,19 @@ def run_sweep(
             # resumed directory must not splice the two layouts
             **({"table_split3": True} if TABLE_SPLIT3 else {}),
         }
+    if impl == "esdirk":
+        # The repacked engine's RESOLVED knobs join the identity (the
+        # config's tri-state Nones resolve per-engine, so the config hash
+        # alone cannot pin them): auto-h0/PI change results at ~1e-7,
+        # the tabulated A/V RHS at ~1e-11 — a resumed directory must not
+        # splice chunks across knob settings.  ``esdirk_knobs`` is the
+        # sweep-level resolution the step above actually runs with.
+        # Pre-existing impl="esdirk" directories (computed by the old
+        # lockstep strategy) get a different hash and recompute, which
+        # is exactly right — the new default engine is a different
+        # numerical engine.
+        hash_extra = dict(hash_extra or {})
+        hash_extra["esdirk"] = {"strategy": "repack", **esdirk_knobs}
     h = grid_hash(base, axes, n_y, impl, extra=hash_extra)
     if out_dir is not None:
         import os
@@ -866,6 +965,14 @@ def run_sweep(
                 "chunk_done", chunk=ci, n_valid=n_valid,
                 n_failed=int(bad.sum()), seconds=round(time.time() - t_chunk, 4),
             )
+            while _esdirk_stats_holder:
+                cs = _esdirk_stats_holder.pop(0)
+                event_log.emit(
+                    "esdirk_rounds", chunk=ci, **cs.summary(),
+                    per_round=cs.as_rows(),
+                )
+        else:
+            _esdirk_stats_holder.clear()
 
         if chunk_file and coordinator:
             np.savez(chunk_file, **host, failed=bad)

@@ -1,5 +1,6 @@
 """Yield solvers (framework layer L4): direct quadrature and the stiff
-Boltzmann ODE path."""
+Boltzmann ODE path (per-point SDIRK pairs in ``sdirk``, the
+lane-repacking batched engine in ``batching``)."""
 from bdlz_tpu.solvers.quadrature import integrate_YB_quadrature
 
 __all__ = ["integrate_YB_quadrature"]

@@ -1,0 +1,377 @@
+"""Lane-repacking batched ESDIRK engine — the stiff sweep's default
+execution strategy.
+
+The lockstep strategy (``jit(vmap(solve_boltzmann_esdirk))``, kept as
+``impl="esdirk_lockstep"``) drags every lane through the masked
+while-loop until the batch's worst straggler converges, and evaluates the
+full (n_z,) KJMA z-integral at every stage abscissa of every lane.  This
+module replaces both costs for batched solves:
+
+* **Rounds + repacking** — run the vmapped loop for a bounded number of
+  attempted steps (``round_steps``), pause (the pause is bit-transparent:
+  :class:`~bdlz_tpu.solvers.sdirk.ESDIRKState` carries the complete
+  controller history and the loop body is shared with the lockstep
+  solver), then front-pack the still-unconverged lanes into a dense
+  smaller batch on the host before the next round.  Finished lanes stop
+  costing anything instead of idling under masking; padded batch sizes
+  walk a small bucket ladder (powers of two × device count) so the round
+  program compiles once per bucket, not once per occupancy.
+* **Cost bucketing** — lanes are pre-sorted by a cheap stiffness proxy
+  (Γ_wash magnitude, then source-ramp width σ_y/(β/H) — the two knobs
+  that measurably stretch the step count) so early-retiring lanes sit
+  together and compaction shrinks the batch as soon as possible, rather
+  than every round carrying one straggler per bucket.
+* **Tabulated A/V right-hand side** — the engine's runtime is the KJMA
+  z-integral at the 5 stage abscissae per step (everything else the
+  stepper does is (2,)-vector arithmetic; measured, docs/perf_notes.md
+  "Stiff engine (r6)").  When the batch shares one I_p — every sweep
+  that does not scan I_p — the z-integral collapses to the same cubic
+  F(y)-table lookup the quadrature fast path uses, built once per I_p:
+  measured ~2.4e-11 relative shift on Y_B for a ~200× cheaper RHS.
+* **Single-lane accelerations** — the Hairer–Wanner automatic starting
+  step and the PI step-size controller (``solvers/sdirk.py`` knobs),
+  default ON here and OFF everywhere else, per the tri-state
+  ``StaticChoices`` knobs (``ode_auto_h0``/``ode_pi_controller``/
+  ``ode_tabulated_av``: None = engine decides).
+
+The per-lane math lives entirely in :mod:`bdlz_tpu.solvers.sdirk`
+(:func:`~bdlz_tpu.solvers.sdirk.esdirk_init` /
+:func:`~bdlz_tpu.solvers.sdirk.esdirk_advance` over the shared stepper
+body) — with the acceleration knobs forced off, this engine reproduces
+the lockstep engine bit-for-bit per lane on mixed-stiffness batches
+(tests/test_sdirk_batching.py).  Per-round compaction counters surface
+through :class:`bdlz_tpu.utils.profiling.CompactionStats`; the bench
+records the lockstep-vs-repacked ratio as ``vs_lockstep``.
+
+Multi-controller runs cannot host-compact non-addressable global arrays;
+``parallel.sweep.run_sweep`` routes those to the lockstep engine.
+"""
+from __future__ import annotations
+
+import time
+from functools import lru_cache
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np  # host-side orchestration; jitted lanes go through jnp (bdlz-lint R1 audit)
+
+from bdlz_tpu.backend import ensure_x64
+from bdlz_tpu.config import PointParams, StaticChoices
+from bdlz_tpu.physics.percolation import KJMAGrid
+from bdlz_tpu.utils.profiling import CompactionStats
+
+ensure_x64()
+
+#: Default attempted-step budget per round.  Small enough that a batch
+#: whose fast lanes finish in ~180 steps (the washout bench grid) gets a
+#: few compaction opportunities, large enough that the per-round host
+#: sync + dispatch (~ms) stays well under the round's compute.
+ROUND_STEPS_DEFAULT = 64
+
+#: Per-process cache of host-built F(y) tables, keyed by (I_p, n): the
+#: build is a (n × 1200) host tensor — once per sweep, not per chunk.
+_AV_TABLE_CACHE: Dict[Tuple[float, int], Any] = {}
+_AV_TABLE_NODES = 16384
+
+
+def _cached_av_table(I_p: float, jnp):
+    key = (float(I_p), _AV_TABLE_NODES)
+    if key not in _AV_TABLE_CACHE:
+        from bdlz_tpu.ops.kjma_table import make_f_table
+
+        while len(_AV_TABLE_CACHE) >= 16:  # bound: each table is ~128 KB
+            _AV_TABLE_CACHE.pop(next(iter(_AV_TABLE_CACHE)))
+        _AV_TABLE_CACHE[key] = make_f_table(float(I_p), jnp, n=_AV_TABLE_NODES)
+    return _AV_TABLE_CACHE[key]
+
+
+def resolve_engine_knobs(
+    static: StaticChoices, I_p_col: np.ndarray
+) -> Dict[str, bool]:
+    """Resolve the tri-state StaticChoices knobs for THIS engine.
+
+    None means "engine decides", and this engine's defaults are ON —
+    the lockstep/per-point paths resolve the same Nones to OFF
+    (``solve_boltzmann_esdirk``), which is what keeps archived results
+    bit-stable while new sweeps get the fast defaults.  The tabulated
+    RHS additionally requires a uniform I_p (the F-table is per-I_p):
+    a mixed-I_p batch silently falls back to the exact kernel rather
+    than failing the sweep.
+    """
+    def tri(v, default):
+        return default if v is None else bool(v)
+
+    uniform_ip = np.unique(np.asarray(I_p_col, dtype=np.float64)).size == 1
+    return {
+        "auto_h0": tri(static.ode_auto_h0, True),
+        "pi_controller": tri(static.ode_pi_controller, True),
+        "tabulated_av": tri(static.ode_tabulated_av, True) and uniform_ip,
+    }
+
+
+def _bucket_size(n_active: int, n_dev: int, n_cap: int) -> int:
+    """Padded dispatch size: next power of two, rounded to a device
+    multiple, capped at the full (device-rounded) batch.  The ladder has
+    O(log n) rungs, so the jitted round program compiles a handful of
+    times total regardless of how occupancy decays."""
+    b = 1 << max(n_active - 1, 0).bit_length()
+    b = ((max(b, 1) + n_dev - 1) // n_dev) * n_dev
+    return min(b, n_cap)
+
+
+@lru_cache(maxsize=64)
+def _lane_programs(
+    static: StaticChoices,
+    auto_h0: bool,
+    pi_controller: bool,
+    max_steps: int,
+    round_steps: int,
+):
+    """(init, advance) — jitted vmapped per-lane programs, CACHED.
+
+    The jit objects must outlive one ``solve_boltzmann_esdirk_batch``
+    call or every chunk re-pays XLA compilation (measured: ~2.4 s per
+    rebuild vs ~5 ms per warm 64-lane round); the cache key is the
+    static configuration and both programs take the z-grid and the
+    optional F-table as call-time arguments, so per-sweep data never
+    leaks into the key.  Both rebuild the lane's ODE problem from its
+    PointParams via the shared
+    :func:`~bdlz_tpu.solvers.sdirk.boltzmann_ode_problem`, so a lane
+    advanced here follows exactly the trajectory the lockstep engine
+    would give it (modulo the acceleration knobs).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from bdlz_tpu.physics.thermo import entropy_density, n_chi_equilibrium
+    from bdlz_tpu.solvers.sdirk import (
+        boltzmann_ode_problem,
+        esdirk_advance,
+        esdirk_init,
+    )
+
+    # unknown regimes fall to THERMAL, matching the reference ODE path's
+    # else-branch default (:399-400) — same resolution as the lockstep
+    # sweep branch
+    thermal = not static.regime.lower().startswith("non")
+    rtol, atol, method = static.ode_rtol, static.ode_atol, static.ode_method
+
+    def lane_problem(pp_i, grid, av_table):
+        T_hi = pp_i.T_max_over_Tp * pp_i.T_p_GeV
+        T_lo = pp_i.T_min_over_Tp * pp_i.T_p_GeV
+        return boltzmann_ode_problem(
+            pp_i, static.chi_stats, static.deplete_DM_from_source, grid,
+            T_lo=T_lo, T_hi=T_hi, av_table=av_table,
+        )
+
+    def init_one(pp_i, grid, av_table):
+        T_hi = pp_i.T_max_over_Tp * pp_i.T_p_GeV
+        if thermal:
+            Ychi0 = n_chi_equilibrium(
+                T_hi, pp_i.m_chi_GeV, pp_i.g_chi, static.chi_stats, jnp
+            ) / entropy_density(T_hi, pp_i.g_star_s, jnp)
+        else:
+            Ychi0 = pp_i.Y_chi_init
+        Y0 = jnp.stack([jnp.asarray(Ychi0, dtype=jnp.float64),
+                        jnp.float64(0.0)])
+        rhs_u, u0, u1, h_max_fn = lane_problem(pp_i, grid, av_table)
+        return esdirk_init(
+            rhs_u, u0, u1, Y0, rtol=rtol, atol=atol, h_max_fn=h_max_fn,
+            method=method, auto_h0=auto_h0,
+        )
+
+    def advance_one(pp_i, state_i, grid, av_table):
+        rhs_u, u0, u1, h_max_fn = lane_problem(pp_i, grid, av_table)
+        return esdirk_advance(
+            rhs_u, state_i, u0, u1, rtol=rtol, atol=atol,
+            max_steps=max_steps, h_max_fn=h_max_fn, method=method,
+            pi_controller=pi_controller, budget=round_steps,
+        )
+
+    return (
+        jax.jit(jax.vmap(init_one, in_axes=(0, None, None))),
+        jax.jit(jax.vmap(advance_one, in_axes=(0, 0, None, None))),
+    )
+
+
+def _take_pp(pp_host: PointParams, idx: np.ndarray) -> PointParams:
+    return PointParams(*(f[idx] for f in pp_host))
+
+
+def solve_boltzmann_esdirk_batch(
+    pp: PointParams,
+    static: StaticChoices,
+    grid: KJMAGrid,
+    mesh=None,
+    round_steps: int = ROUND_STEPS_DEFAULT,
+    max_steps: int = 10_000,
+    stats: Optional[CompactionStats] = None,
+    knobs: Optional[Dict[str, bool]] = None,
+):
+    """Solve the Boltzmann system for a batch of points, lane-repacked.
+
+    ``pp`` is a PointParams-of-arrays (one lane per point; the thermal/
+    nonthermal initial condition is resolved from ``static.regime`` like
+    the sweep layer does).  Returns a batched
+    :class:`~bdlz_tpu.solvers.sdirk.ESDIRKSolution` in the INPUT lane
+    order — the stiffness-proxy sort is an internal execution detail.
+    ``stats`` (a :class:`~bdlz_tpu.utils.profiling.CompactionStats`)
+    receives one record per round.
+
+    ``knobs`` is the :func:`resolve_engine_knobs` result to run with;
+    None resolves from THIS batch.  A caller that splits one logical
+    sweep into chunks must resolve ONCE over the full grid and pass the
+    result here — per-chunk resolution would make ``tabulated_av``
+    depend on how chunk boundaries slice an I_p axis, i.e. numerics
+    keyed by chunk_size, which the sweep's resume hash deliberately does
+    not include (run_sweep does exactly this).
+
+    With a ``mesh``, each round's packed batch is device_put with the
+    batch sharding so multi-device hosts split rounds across chips; the
+    compaction itself is host-side (single-controller only — the sweep
+    layer routes multi-process runs to the lockstep engine).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from bdlz_tpu.solvers.sdirk import ESDIRKState, solution_from_state
+
+    pp_host = PointParams(*(np.asarray(f, dtype=np.float64) for f in pp))
+    n = int(pp_host.m_chi_GeV.shape[0])
+    if n == 0:
+        raise ValueError("empty batch")
+
+    if knobs is None:
+        knobs = resolve_engine_knobs(static, pp_host.I_p)
+    elif knobs["tabulated_av"] and np.unique(pp_host.I_p).size != 1:
+        # the F-table is per-I_p: a sweep-level resolution of True with a
+        # mixed-I_p chunk is a caller bug — fail loudly, never silently
+        # run a different numerical kernel than the one the caller hashed
+        raise ValueError(
+            "tabulated_av=True passed for a batch with mixed I_p values"
+        )
+    av_table = (
+        _cached_av_table(float(pp_host.I_p[0]), jnp)
+        if knobs["tabulated_av"] else None
+    )
+
+    # Cost bucketing: group lanes by expected step count BEFORE round 1 so
+    # retirement fronts are compact.  Primary key: washout magnitude (the
+    # post-pulse tail integrates a stiff decay whose resolution cost grows
+    # with Γ_wash); secondary: source-ramp width σ_y/(β/H) (sets how many
+    # capped steps cross the pulse window).  Descending, stable (ties keep
+    # input order → deterministic).
+    ramp_w = pp_host.sigma_y / np.maximum(pp_host.beta_over_H, 1e-30)
+    # lexsort: LAST key is primary
+    order = np.lexsort((-ramp_w, -np.abs(pp_host.Gamma_wash_over_H)))
+    pp_sorted = _take_pp(pp_host, order)
+
+    n_dev = int(mesh.devices.size) if mesh is not None else 1
+    n_cap = ((n + n_dev - 1) // n_dev) * n_dev
+    sharding = None
+    if mesh is not None:
+        from bdlz_tpu.parallel.mesh import batch_sharding
+
+        sharding = batch_sharding(mesh)
+
+    init_fn, advance_fn = _lane_programs(
+        static, knobs["auto_h0"], knobs["pi_controller"],
+        int(max_steps), int(round_steps),
+    )
+    grid_j = KJMAGrid(*(jnp.asarray(a) for a in grid))
+
+    def dispatch(fn, idx, *extra):
+        """Gather lanes ``idx``, pad to a bucket, run ``fn``, return host
+        arrays trimmed back to ``len(idx)``."""
+        size = _bucket_size(len(idx), n_dev, n_cap)
+        pad = np.concatenate([idx, np.repeat(idx[-1:], size - len(idx))])
+        args = [jax.tree.map(jnp.asarray, _take_pp(pp_sorted, pad))]
+        for e in extra:
+            args.append(jax.tree.map(lambda a: jnp.asarray(a[pad]), e))
+        if sharding is not None:
+            args = [jax.tree.map(lambda a: jax.device_put(a, sharding), a)
+                    for a in args]
+        out = fn(*args, grid_j, av_table)
+        out = jax.block_until_ready(out)
+        host = jax.tree.map(lambda a: np.asarray(a)[: len(idx)], out)
+        return host, size
+
+    all_idx = np.arange(n)
+    state_host, _ = dispatch(init_fn, all_idx)
+    # promote to WRITABLE host arrays we can scatter rounds back into
+    # (np.asarray of a jax output is a read-only view)
+    state_host = ESDIRKState(*(np.array(f) for f in state_host))
+
+    def active_mask(s: ESDIRKState) -> np.ndarray:
+        return ~s.done & (s.n < max_steps)
+
+    round_index = 0
+    while True:
+        act = active_mask(state_host)
+        idx = np.flatnonzero(act)
+        if idx.size == 0:
+            break
+        acc0 = int(state_host.n_accepted[idx].sum())
+        rej0 = int(state_host.n_rejected[idx].sum())
+        t0 = time.time()
+        new_state, size = dispatch(advance_fn, idx, state_host)
+        seconds = time.time() - t0
+        for name, col in zip(ESDIRKState._fields, new_state):
+            getattr(state_host, name)[idx] = col
+        still = active_mask(state_host)
+        if stats is not None:
+            stats.record_round(
+                round_index=round_index,
+                batch_lanes=int(size),
+                active_lanes=int(idx.size),
+                lanes_retired=int(idx.size - still[idx].sum()),
+                steps_accepted=int(state_host.n_accepted[idx].sum() - acc0),
+                steps_rejected=int(state_host.n_rejected[idx].sum() - rej0),
+                seconds=seconds,
+            )
+        round_index += 1
+
+    # back to input lane order
+    unsort = np.empty_like(order)
+    unsort[order] = np.arange(n)
+    final = ESDIRKState(*(f[unsort] for f in state_host))
+    return solution_from_state(final)
+
+
+def make_batched_esdirk_step(
+    static: StaticChoices,
+    mesh=None,
+    round_steps: int = ROUND_STEPS_DEFAULT,
+    max_steps: int = 10_000,
+    stats_sink=None,
+    knobs: Optional[Dict[str, bool]] = None,
+):
+    """``step(pp_chunk, grid) -> YieldsResult`` on the repacked engine.
+
+    The drop-in counterpart of the lockstep ``make_sweep_step`` branch:
+    same aux (the raw KJMA z-grid), same mask-and-report semantics
+    (failed lanes become NaN rows).  ``stats_sink``, when given, is
+    called with the chunk's :class:`CompactionStats` after each chunk —
+    the sweep layer forwards per-round rows to its event log.
+    ``knobs`` pins one engine-knob resolution across every chunk the
+    step will see (see :func:`solve_boltzmann_esdirk_batch`); None
+    resolves per chunk, which is only safe for single-batch callers.
+    """
+    def step(pp_chunk, grid):
+        from bdlz_tpu.models.yields_pipeline import YieldsResult, present_day
+
+        stats = CompactionStats()
+        sol = solve_boltzmann_esdirk_batch(
+            pp_chunk, static, grid, mesh=mesh, round_steps=round_steps,
+            max_steps=max_steps, stats=stats, knobs=knobs,
+        )
+        if stats_sink is not None:
+            stats_sink(stats)
+        m_chi = np.asarray(pp_chunk.m_chi_GeV, dtype=np.float64)
+        m_B = np.asarray(pp_chunk.m_B_kg, dtype=np.float64)
+        res = present_day(sol.y[:, 1], sol.y[:, 0], m_chi, m_B, np)
+        ok = np.asarray(sol.success)
+        return YieldsResult(
+            *(np.where(ok, np.asarray(f), np.nan) for f in res)
+        )
+
+    return step

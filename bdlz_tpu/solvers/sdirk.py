@@ -19,8 +19,14 @@ Design notes for TPU/XLA:
   the exact 2×2 Jacobian from ``jax.jacfwd`` and a closed-form 2×2 linear
   solve — no LU, no dynamic iteration counts, so vmapped lanes stay in
   lockstep;
-* under ``vmap`` each lane carries its own adaptive step size; finished
-  lanes idle via masking until the whole batch converges.
+* under ``vmap`` each lane carries its own adaptive step size; in the
+  plain lockstep vmap, finished lanes idle via masking until the whole
+  batch converges — the rounds-based lane-repacking engine in
+  :mod:`bdlz_tpu.solvers.batching` removes that waste by pausing the
+  loop every bounded number of steps (:func:`esdirk_advance` with a
+  ``budget``) and front-packing the still-running lanes, while this
+  module stays the single definition of the per-lane math
+  (:func:`_make_stepper` / :class:`ESDIRKState`).
 
 Tableaus: Kvaernø (2004), "Singly diagonally implicit Runge–Kutta methods
 with an explicit first stage", BIT 44 — the 4-stage order-3/2 ESDIRK pair
@@ -103,6 +109,33 @@ class ESDIRKSolution(NamedTuple):
     n_rejected: object
 
 
+class ESDIRKState(NamedTuple):
+    """The full resumable per-lane integration state.
+
+    Everything the adaptive loop carries between steps, exposed as a
+    pytree so the batched engine (``solvers/batching.py``) can pause a
+    lane after a bounded round of steps, compact the still-running lanes
+    on the host, and resume — a resumed lane replays exactly the step
+    sequence the uninterrupted loop would have taken (bit-identical;
+    pinned in tests/test_sdirk_batching.py).
+
+    ``err_prev`` is the accepted-step error history the PI controller
+    feeds on; it is carried (and defined: 1.0 = neutral) even when the
+    controller is the plain I one, so the state layout does not depend
+    on controller knobs.
+    """
+
+    x: object          # current abscissa
+    y: object          # current state, shape like y0
+    h: object          # next trial step size
+    f: object          # slope at (x, y) — the reusable stiffly-accurate last stage
+    err_prev: object   # last accepted scaled error norm (PI history)
+    n: object          # attempted steps so far
+    n_accepted: object
+    n_rejected: object
+    done: object       # bool: reached x1
+
+
 def _solve_2x2(J, r):
     """Closed-form solve J @ d = r for 2-vectors."""
     det = J[0, 0] * J[1, 1] - J[0, 1] * J[1, 0]
@@ -112,35 +145,29 @@ def _solve_2x2(J, r):
     return jnp.stack([d0, d1])
 
 
-def esdirk_solve(
+def _make_stepper(
     rhs: Callable,
     x0,
     x1,
-    y0,
-    rtol: float = 1e-8,
-    atol: float = 1e-16,
-    max_steps: int = 10_000,
-    newton_iters: int = 6,
-    h_max=None,
-    h_max_fn: Callable | None = None,
-    method: str = "sdirk4",
-) -> ESDIRKSolution:
-    """Integrate dy/dx = rhs(x, y), y shape (2,), x0 < x1, adaptively.
+    rtol,
+    atol,
+    max_steps: int,
+    newton_iters: int,
+    h_max,
+    h_max_fn: Callable | None,
+    method: str,
+    pi_controller: bool,
+):
+    """Build ``(cond, body)`` for the adaptive loop over ``ESDIRKState``.
 
-    Pure traceable function: wrap in ``jit`` at the call boundary and
-    ``vmap`` over closures' parameters for sweeps. ``h_max`` (optional,
-    traced) caps the step size — essential when the RHS contains a narrow
-    feature (the bounce source pulse) that pure local error control could
-    step across without ever sampling.  ``h_max_fn`` (optional, traceable
-    ``x -> cap``) makes that cap position-dependent, so a narrow feature
-    whose location is known a priori only taxes the steps that cross it
-    — the measured step count drops ~3× on the washout bench grid versus
-    a global pulse cap (docs/perf_notes.md).
+    THE single definition of the step attempt + controller, shared by the
+    run-to-completion solver (:func:`esdirk_solve`) and the rounds-based
+    resume path (:func:`esdirk_advance`) — one body function is what makes
+    the repacked engine bit-identical to the lockstep one per lane.
     """
     c, A, b, b_emb, order, g, explicit_first = _TABLEAUS[method]()
     n_stages = len(c)
 
-    y0 = jnp.asarray(y0, dtype=jnp.float64)
     x0 = jnp.asarray(x0, dtype=jnp.float64)
     x1 = jnp.asarray(x1, dtype=jnp.float64)
     span = x1 - x0
@@ -189,12 +216,11 @@ def esdirk_solve(
         # stage slope IS rhs(x+h, y_new) — reusable as the next step's f0
         return y_new, err, ks[-1]
 
-    def cond(state):
-        _, _, _, _, n, _, _, done = state
-        return jnp.logical_and(~done, n < max_steps)
+    def cond(state: ESDIRKState):
+        return jnp.logical_and(~state.done, state.n < max_steps)
 
-    def body(state):
-        x, y, h, f, n, n_acc, n_rej, _ = state
+    def body(state: ESDIRKState) -> ESDIRKState:
+        x, y, h, f = state.x, state.y, state.h, state.f
         h_allowed = h_cap if h_max_fn is None else jnp.minimum(h_cap, h_max_fn(x))
         h_eff = jnp.minimum(jnp.minimum(h, h_allowed), x1 - x)
         y_new, err, f_last = attempt_step(x, y, h_eff, f)
@@ -202,57 +228,247 @@ def esdirk_solve(
         err = jnp.where(jnp.isfinite(err), err, jnp.inf)
         accept = err <= 1.0
 
-        factor = 0.9 * jnp.where(err > 0.0, err, 1e-10) ** (-1.0 / order)
+        e = jnp.where(err > 0.0, err, 1e-10)
+        if pi_controller:
+            # Gustafsson/Hairer–Wanner PI: h·err^(−kI−kP)·err_prev^(kP) —
+            # the error-history term damps the I controller's overshoot
+            # (steady state err_prev ≈ err recovers err^(−0.3/order)), so
+            # near-boundary steps stop oscillating between accept/reject.
+            # Rejections fall back to the plain I response (standard).
+            kI, kP = 0.3 / order, 0.4 / order
+            ep = jnp.maximum(state.err_prev, 1e-10)
+            factor = jnp.where(
+                accept,
+                0.9 * e ** (-(kI + kP)) * ep ** kP,
+                0.9 * e ** (-1.0 / order),
+            )
+        else:
+            factor = 0.9 * e ** (-1.0 / order)
         factor = jnp.clip(factor, 0.2, 5.0)
         h_next = jnp.clip(h_eff * factor, jnp.abs(span) * 1e-12, h_cap)
 
         x = jnp.where(accept, x + h_eff, x)
         y = jnp.where(accept, y_new, y)
         f = jnp.where(accept, f_last, f)
+        err_prev = jnp.where(accept, e, state.err_prev)
         done = x >= x1 - jnp.abs(span) * 1e-14
-        return (
-            x, y, h_next, f,
-            n + 1,
-            n_acc + accept.astype(jnp.int64),
-            n_rej + (~accept).astype(jnp.int64),
-            done,
+        return ESDIRKState(
+            x=x, y=y, h=h_next, f=f, err_prev=err_prev,
+            n=state.n + 1,
+            n_accepted=state.n_accepted + accept.astype(jnp.int64),
+            n_rejected=state.n_rejected + (~accept).astype(jnp.int64),
+            done=done,
         )
 
+    return cond, body
+
+
+def esdirk_init(
+    rhs: Callable,
+    x0,
+    x1,
+    y0,
+    rtol: float = 1e-8,
+    atol: float = 1e-16,
+    h_max=None,
+    h_max_fn: Callable | None = None,
+    method: str = "sdirk4",
+    auto_h0: bool = False,
+) -> ESDIRKState:
+    """Initial :class:`ESDIRKState` at ``x0`` (slope eval + step-size guess).
+
+    ``auto_h0=False`` reproduces the historical conservative guess
+    ``h = span·1e−4`` bit-for-bit.  ``auto_h0=True`` runs the standard
+    Hairer–Wanner starting-step algorithm (Solving ODEs I, §II.4): one
+    extra slope evaluation estimates ``y''`` and sizes the first step to
+    the method's order, so short spans stop paying a fixed ~log₅(1e4)
+    ramp-up tax and long quiet heads are crossed immediately.  Any
+    position-dependent cap (``h_max_fn``) still binds the result.
+    """
+    _, _, _, _, order, _, _ = _TABLEAUS[method]()
+    y0 = jnp.asarray(y0, dtype=jnp.float64)
+    x0 = jnp.asarray(x0, dtype=jnp.float64)
+    x1 = jnp.asarray(x1, dtype=jnp.float64)
+    span = x1 - x0
+    h_cap = jnp.abs(span) if h_max is None else jnp.asarray(h_max, dtype=jnp.float64)
     f0 = rhs(x0, y0)
-    state0 = (
-        x0, y0, jnp.minimum(span * 1e-4, h_cap), f0,
-        jnp.int64(0), jnp.int64(0), jnp.int64(0),
-        jnp.asarray(False),
+    if auto_h0:  # bdlz-lint: disable=R2 — trace-static knob (jit static_argname), branches pick the traced program, never a tracer
+        scale0 = jnp.asarray(atol) + rtol * jnp.abs(y0)
+        d0 = jnp.sqrt(jnp.mean((y0 / scale0) ** 2))
+        d1 = jnp.sqrt(jnp.mean((f0 / scale0) ** 2))
+        h_a = jnp.where((d0 < 1e-5) | (d1 < 1e-5), 1e-6 * jnp.abs(span),
+                        0.01 * d0 / jnp.maximum(d1, 1e-300))
+        h_a = jnp.minimum(h_a, h_cap)
+        if h_max_fn is not None:
+            h_a = jnp.minimum(h_a, h_max_fn(x0))
+        # explicit Euler probe → second-derivative estimate d2
+        f1 = rhs(x0 + h_a, y0 + h_a * f0)
+        d2 = jnp.sqrt(jnp.mean(((f1 - f0) / scale0) ** 2)) / jnp.maximum(h_a, 1e-300)
+        dm = jnp.maximum(d1, d2)
+        h_b = jnp.where(
+            dm <= 1e-15,
+            jnp.maximum(1e-6 * jnp.abs(span), h_a * 1e-3),
+            (0.01 / dm) ** (1.0 / (order + 1.0)),
+        )
+        h_init = jnp.minimum(100.0 * h_a, h_b)
+        h_init = jnp.clip(h_init, jnp.abs(span) * 1e-12, h_cap)
+        if h_max_fn is not None:
+            h_init = jnp.minimum(h_init, h_max_fn(x0))
+    else:
+        h_init = jnp.minimum(span * 1e-4, h_cap)
+    return ESDIRKState(
+        x=x0, y=y0, h=h_init, f=f0, err_prev=jnp.float64(1.0),
+        n=jnp.int64(0), n_accepted=jnp.int64(0), n_rejected=jnp.int64(0),
+        done=jnp.asarray(False),
     )
-    _, y_f, _, _, n, n_acc, n_rej, done = jax.lax.while_loop(cond, body, state0)
-    success = jnp.logical_and(done, jnp.all(jnp.isfinite(y_f)))
+
+
+def esdirk_advance(
+    rhs: Callable,
+    state: ESDIRKState,
+    x0,
+    x1,
+    rtol: float = 1e-8,
+    atol: float = 1e-16,
+    max_steps: int = 10_000,
+    newton_iters: int = 6,
+    h_max=None,
+    h_max_fn: Callable | None = None,
+    method: str = "sdirk4",
+    pi_controller: bool = False,
+    budget: int | None = None,
+) -> ESDIRKState:
+    """Advance an :class:`ESDIRKState` adaptively toward ``x1``.
+
+    ``budget=None`` runs until done or ``max_steps`` total attempts (the
+    classic solve).  A finite ``budget`` bounds the ATTEMPTED steps of
+    this call — the rounds primitive of the lane-repacking batch engine:
+    advance every live lane ``budget`` steps, pause, compact, repeat.
+    Pausing is bit-transparent: the loop body AND carry layout are
+    shared with the unbudgeted path — the round bound rides on the
+    state's own attempt counter ``n`` instead of an extra loop-carried
+    index, because changing the carry signature was measured to change
+    XLA's fusion choices inside the body and shift results by an ulp
+    (which would break the repacked engine's bit-parity contract).
+    """
+    cond, body = _make_stepper(
+        rhs, x0, x1, rtol, atol, max_steps, newton_iters, h_max, h_max_fn,
+        method, pi_controller,
+    )
+    if budget is None:
+        return jax.lax.while_loop(cond, body, state)
+
+    n_stop = jnp.minimum(state.n + budget, max_steps)
+
+    def cond_round(s):
+        return jnp.logical_and(~s.done, s.n < n_stop)
+
+    return jax.lax.while_loop(cond_round, body, state)
+
+
+def solution_from_state(state: ESDIRKState) -> ESDIRKSolution:
+    """Collapse a final state into the caller-facing solution record."""
+    success = jnp.logical_and(state.done, jnp.all(jnp.isfinite(state.y), axis=-1))
     return ESDIRKSolution(
-        y=y_f, success=success, n_steps=n, n_accepted=n_acc, n_rejected=n_rej
+        y=state.y, success=success, n_steps=state.n,
+        n_accepted=state.n_accepted, n_rejected=state.n_rejected,
     )
 
 
-@partial(
-    jax.jit,
-    # rtol/atol are traced (atol may be a per-component array — the
-    # Boltzmann state spans ~7 decades between Y_chi and Y_B when
-    # annihilation re-thermalizes chi, and one scalar floor cannot serve
-    # both components); only genuinely structural choices stay static.
-    static_argnames=("chi_stats", "deplete", "max_steps", "method"),
-)
-def _boltzmann_esdirk_jit(
+def esdirk_solve(
+    rhs: Callable,
+    x0,
+    x1,
+    y0,
+    rtol: float = 1e-8,
+    atol: float = 1e-16,
+    max_steps: int = 10_000,
+    newton_iters: int = 6,
+    h_max=None,
+    h_max_fn: Callable | None = None,
+    method: str = "sdirk4",
+    auto_h0: bool = False,
+    pi_controller: bool = False,
+) -> ESDIRKSolution:
+    """Integrate dy/dx = rhs(x, y), y shape (2,), x0 < x1, adaptively.
+
+    Pure traceable function: wrap in ``jit`` at the call boundary and
+    ``vmap`` over closures' parameters for sweeps. ``h_max`` (optional,
+    traced) caps the step size — essential when the RHS contains a narrow
+    feature (the bounce source pulse) that pure local error control could
+    step across without ever sampling.  ``h_max_fn`` (optional, traceable
+    ``x -> cap``) makes that cap position-dependent, so a narrow feature
+    whose location is known a priori only taxes the steps that cross it
+    — the measured step count drops ~3× on the washout bench grid versus
+    a global pulse cap (docs/perf_notes.md).
+
+    ``auto_h0``/``pi_controller`` opt into the Hairer–Wanner starting
+    step and the PI step controller (see :func:`esdirk_init` and
+    :func:`_make_stepper`); both default OFF so every pre-existing
+    result stays bit-identical — the repacked batch engine
+    (``solvers/batching.py``) turns them on by default.
+    """
+    state0 = esdirk_init(
+        rhs, x0, x1, y0, rtol=rtol, atol=atol, h_max=h_max,
+        h_max_fn=h_max_fn, method=method, auto_h0=auto_h0,
+    )
+    state = esdirk_advance(
+        rhs, state0, x0, x1, rtol=rtol, atol=atol, max_steps=max_steps,
+        newton_iters=newton_iters, h_max=h_max, h_max_fn=h_max_fn,
+        method=method, pi_controller=pi_controller,
+    )
+    return solution_from_state(state)
+
+
+def boltzmann_ode_problem(
     pp: PointParams,
-    Y0,
-    T_lo,
-    T_hi,
-    grid: KJMAGrid,
     chi_stats: str,
     deplete: bool,
-    rtol: float,
-    atol: float,
-    max_steps: int,
-    method: str = "sdirk4",
+    grid: KJMAGrid,
+    T_lo=None,
+    T_hi=None,
+    av_table=None,
 ):
-    rhs = make_rhs(pp, chi_stats, deplete, grid, jnp)
+    """Assemble the log-x Boltzmann integration problem for one point.
+
+    Returns ``(rhs_u, u0, u1, h_max_fn)`` — the u = ln x RHS, the span,
+    and the position-aware step cap.  THE single definition shared by the
+    per-point jit path below and the lane-repacking batch engine
+    (``solvers/batching.py``), so the two engines integrate literally the
+    same problem (bit-identity pinned in tests/test_sdirk_batching.py).
+
+    ``T_lo``/``T_hi`` default to the window ratios in ``pp``; explicit
+    values are used verbatim (never reconstructed through a ratio
+    round-trip — a single-ulp difference in x0 changes the whole adaptive
+    step sequence and breaks bitwise parity with archived runs).
+
+    ``av_table`` (a :class:`~bdlz_tpu.ops.kjma_table.KJMATable`, optional)
+    replaces the per-evaluation (n_z,) KJMA z-integral with the cubic
+    F(y)-table lookup — the stiff-path analog of the sweep layer's
+    tabulated fast path.  Measured on the washout bench grid: ~2.4e-11
+    relative shift on Y_B for a ~200× cheaper RHS (the z-integral at the
+    5 stage abscissae per step IS the engine's runtime; everything else
+    the stepper does is (2,)-vector arithmetic — docs/perf_notes.md
+    "Stiff engine").  Only valid when the batch shares one I_p (the
+    table is per-I_p); callers gate on that.
+    """
+    A_over_V_T = None
+    if av_table is not None:
+        from bdlz_tpu.ops.kjma_table import area_over_volume_tabulated
+        from bdlz_tpu.physics.percolation import y_of_T
+
+        def A_over_V_T(T):
+            y = y_of_T(T, pp.T_p_GeV, pp.beta_over_H, jnp)
+            return area_over_volume_tabulated(
+                y, pp.beta_over_H, pp.T_p_GeV, pp.v_w, pp.g_star,
+                av_table, jnp,
+            )
+
+    rhs = make_rhs(pp, chi_stats, deplete, grid, jnp, A_over_V_T=A_over_V_T)
+    if T_lo is None:
+        T_lo = pp.T_min_over_Tp * pp.T_p_GeV
+    if T_hi is None:
+        T_hi = pp.T_max_over_Tp * pp.T_p_GeV
     x0 = pp.m_chi_GeV / T_hi
     x1 = pp.m_chi_GeV / jnp.maximum(T_lo, 1e-30)
 
@@ -310,9 +526,44 @@ def _boltzmann_esdirk_jit(
             cap = jnp.where(d > 1e-12, jnp.minimum(cap, d), cap)
         return cap
 
+    return rhs_u, u0, u1, h_max_fn
+
+
+@partial(
+    jax.jit,
+    # rtol/atol are traced (atol may be a per-component array — the
+    # Boltzmann state spans ~7 decades between Y_chi and Y_B when
+    # annihilation re-thermalizes chi, and one scalar floor cannot serve
+    # both components); only genuinely structural choices stay static.
+    static_argnames=(
+        "chi_stats", "deplete", "max_steps", "method", "auto_h0",
+        "pi_controller",
+    ),
+)
+def _boltzmann_esdirk_jit(
+    pp: PointParams,
+    Y0,
+    T_lo,
+    T_hi,
+    grid: KJMAGrid,
+    chi_stats: str,
+    deplete: bool,
+    rtol: float,
+    atol: float,
+    max_steps: int,
+    method: str = "sdirk4",
+    auto_h0: bool = False,
+    pi_controller: bool = False,
+    av_table=None,
+):
+    rhs_u, u0, u1, h_max_fn = boltzmann_ode_problem(
+        pp, chi_stats, deplete, grid, T_lo=T_lo, T_hi=T_hi,
+        av_table=av_table,
+    )
     return esdirk_solve(
         rhs_u, u0, u1, Y0, rtol=rtol, atol=atol, max_steps=max_steps,
-        h_max_fn=h_max_fn, method=method,
+        h_max_fn=h_max_fn, method=method, auto_h0=auto_h0,
+        pi_controller=pi_controller,
     )
 
 
@@ -332,12 +583,17 @@ def solve_boltzmann_esdirk(
     atol=None,
     max_steps: int = 10_000,
     method: str | None = None,
+    av_table=None,
 ):
     """Boltzmann evolution in x = m/T over [m/T_hi, m/T_lo], JAX path.
 
     ``method``/``rtol``/``atol`` default to ``static``'s ``ode_method`` /
     ``ode_rtol`` / ``ode_atol`` (the config's keys); explicit arguments
     override (``atol`` may also be a per-component (2,) array).
+    ``av_table`` (a :class:`~bdlz_tpu.ops.kjma_table.KJMATable`) swaps
+    the per-step KJMA z-integral for the cubic F(y)-table lookup — see
+    :func:`boltzmann_ode_problem`; the default None keeps this path's
+    bit-pinned exact kernel.
 
     Same RHS semantics as the reference ODE path (`first_principles_yields.py
     :270-286`) but with the batched KJMA kernel evaluated exactly (no
@@ -365,8 +621,15 @@ def solve_boltzmann_esdirk(
     if atol is None:
         atol = static.ode_atol
     grid = KJMAGrid(*(jnp.asarray(a) for a in grid))
+    # Tri-state engine knobs resolve None -> False HERE: this per-point
+    # path is the bit-pinned one (golden parity, the Radau cross-check
+    # battery); the accelerations default on only in the repacked batch
+    # engine (solvers/batching.py), per its resolution.
     return _boltzmann_esdirk_jit(
         pp, jnp.asarray(Y0, dtype=jnp.float64), T_lo, T_hi, grid,
         static.chi_stats, static.deplete_DM_from_source, rtol, atol, max_steps,
         method,
+        auto_h0=bool(static.ode_auto_h0),
+        pi_controller=bool(static.ode_pi_controller),
+        av_table=av_table,
     )

@@ -61,12 +61,15 @@ def main(argv=None) -> None:
                          "NaN-producing primitive (which includes designed "
                          "failed-point NaNs)")
     ap.add_argument("--impl", default="tabulated",
-                    choices=("tabulated", "pallas", "direct", "esdirk"),
+                    choices=("tabulated", "pallas", "direct", "esdirk",
+                             "esdirk_lockstep"),
                     help="Per-point engine: tabulated (XLA fast path), pallas "
                          "(MXU interpolation kernel — fastest on real TPU), "
                          "direct (raw (n_y x n_z) kernel; forced when I_p is swept), "
-                         "esdirk (stiff Boltzmann integrator; forced when sigma_v, "
-                         "washout, or depletion are active)")
+                         "esdirk (stiff Boltzmann integrator — the lane-repacking "
+                         "batch engine; forced when sigma_v, washout, or depletion "
+                         "are active), esdirk_lockstep (the legacy single-program "
+                         "vmapped stiff loop, kept for A/B)")
     ap.add_argument("--fuse-exp", action="store_true", dest="fuse_exp",
                     help="With --impl pallas: evaluate the merged exponential "
                          "inside the kernel (accurate f32 Cody-Waite exp)")

@@ -1,9 +1,66 @@
 """Tracing / profiling utilities (SURVEY §5: none in the reference —
-print-statements only; here: jax.profiler traces + throughput reporting).
+print-statements only; here: jax.profiler traces, throughput reporting,
+and the stiff batch engine's per-round compaction counters).
 """
 from __future__ import annotations
 
 import contextlib
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any, Dict, List
+
+
+@dataclass(frozen=True)
+class EsdirkRound:
+    """One round of the lane-repacking batched ESDIRK engine
+    (``solvers/batching.py``): which lanes ran, what they did, how long
+    the round took on the wall."""
+
+    round_index: int
+    batch_lanes: int       # padded batch actually dispatched
+    active_lanes: int      # live (unconverged, in-budget) lanes this round
+    lanes_retired: int     # lanes that finished (or exhausted) this round
+    steps_accepted: int    # accepted steps across live lanes this round
+    steps_rejected: int    # rejected attempts across live lanes this round
+    seconds: float
+
+
+@dataclass
+class CompactionStats:
+    """Per-round record of a repacked batched stiff solve.
+
+    The engine appends one :class:`EsdirkRound` per dispatch; ``summary``
+    collapses the list into the totals that bench JSON / event logs
+    carry.  ``pad_waste`` is the fraction of dispatched lane-rounds that
+    were padding or already-converged masking — the quantity the
+    repacking exists to minimize (a lockstep solve of the same batch has
+    waste = 1 − mean(steps)/max(steps) instead).
+    """
+
+    rounds: List[EsdirkRound] = field(default_factory=list)
+
+    def record_round(self, **kw: Any) -> None:
+        self.rounds.append(EsdirkRound(**kw))
+
+    @property
+    def n_rounds(self) -> int:
+        return len(self.rounds)
+
+    def summary(self) -> Dict[str, Any]:
+        dispatched = sum(r.batch_lanes for r in self.rounds)
+        active = sum(r.active_lanes for r in self.rounds)
+        return {
+            "rounds": self.n_rounds,
+            "lanes_retired": sum(r.lanes_retired for r in self.rounds),
+            "steps_accepted": sum(r.steps_accepted for r in self.rounds),
+            "steps_rejected": sum(r.steps_rejected for r in self.rounds),
+            "seconds": round(sum(r.seconds for r in self.rounds), 4),
+            "pad_waste": round(1.0 - active / dispatched, 4) if dispatched else 0.0,
+        }
+
+    def as_rows(self) -> List[Dict[str, Any]]:
+        """The per-round records as plain dicts (event logs, JSON)."""
+        return [dataclasses.asdict(r) for r in self.rounds]
 
 
 @contextlib.contextmanager

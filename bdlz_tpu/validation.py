@@ -132,10 +132,13 @@ def population_max_rel(run_chunk, chunk: int, ref: np.ndarray) -> float:
     # ref==0 points can't contribute a relative error, but silently
     # dropping them would let an engine emit a large finite value at a
     # zero-reference point and still pass (ADVICE r4).  Hold them to an
-    # absolute tolerance scaled to the population's magnitude instead.
+    # absolute tolerance scaled to the MEDIAN nonzero |ref| — the
+    # population spans ~15 decades, so max|ref| would hand zero-reference
+    # points a tolerance ~10 decades above the typical output scale and
+    # let a grossly wrong engine value slip through (ADVICE r5).
     n_zero = int(n - nz.sum())
     if n_zero:
-        abs_tol = 1e-6 * float(np.max(np.abs(ref)))
+        abs_tol = 1e-6 * float(np.median(np.abs(ref[nz])))
         worst = float(np.max(np.abs(got[~nz])))
         if worst > abs_tol:
             raise GateFailure(
@@ -219,37 +222,54 @@ def reference_ratios_cached(
     path's source (a code change invalidates the cache).  Set
     ``BDLZ_REF_CACHE_DIR=''`` to disable.
 
-    The default directory is per-user (0700, uid-suffixed under the
-    system temp dir) and an existing directory not owned by this uid is
-    refused — the cache IS the accuracy gate's ground truth, so a
-    world-writable shared path would let another local user substitute
-    it.  ``stats``, when given, records ``{"cache_hit": bool}`` so
-    evidence artifacts can stamp whether their reference timing measured
-    a recompute or a disk read.
+    The default directory lives under the user's cache root
+    (``$XDG_CACHE_HOME`` or ``~/.cache`` — NOT the world-writable system
+    temp dir), is created 0700, and a pre-existing directory is trusted
+    only if it is a real directory (``lstat`` — a symlink is refused
+    outright, it could point anywhere), owned by this uid, and not
+    group/other-writable — the cache IS the accuracy gate's ground
+    truth, so any path another local user could write substitutes the
+    truth (ADVICE r5).  A corrupt cached file is deleted and recomputed
+    instead of crashing the gate.  ``stats``, when given, records
+    ``{"cache_hit": bool}`` so evidence artifacts can stamp whether
+    their reference timing measured a recompute or a disk read.
     """
     import hashlib
     import os
+    import stat as statmod
+    import sys
     import tempfile
 
     if cache_dir is None:
+        cache_root = os.environ.get(
+            "XDG_CACHE_HOME",
+            os.path.join(os.path.expanduser("~"), ".cache"),
+        )
         cache_dir = os.environ.get(
-            "BDLZ_REF_CACHE_DIR",
-            os.path.join(tempfile.gettempdir(),
-                         f"bdlz_refcache-{os.getuid()}"),
+            "BDLZ_REF_CACHE_DIR", os.path.join(cache_root, "bdlz_refcache")
         )
     if stats is not None:
         stats["cache_hit"] = False
     if not cache_dir:
         return reference_ratios(grid, static, n_y=n_y)
-    os.makedirs(cache_dir, mode=0o700, exist_ok=True)
-    st = os.stat(cache_dir)
-    if st.st_uid != os.getuid():
-        import sys
 
-        print(f"[refcache] {cache_dir} is owned by uid {st.st_uid}, not "
-              f"{os.getuid()}; refusing to trust it (caching disabled)",
-              file=sys.stderr)
+    def _refuse(why: str):
+        print(f"[refcache] {cache_dir} {why}; refusing to trust it "
+              "(caching disabled)", file=sys.stderr)
         return reference_ratios(grid, static, n_y=n_y)
+
+    os.makedirs(cache_dir, mode=0o700, exist_ok=True)
+    st = os.lstat(cache_dir)
+    if statmod.S_ISLNK(st.st_mode):
+        return _refuse("is a symlink")
+    if not statmod.S_ISDIR(st.st_mode):
+        return _refuse("is not a directory")
+    if st.st_uid != os.getuid():
+        return _refuse(f"is owned by uid {st.st_uid}, not {os.getuid()}")
+    if st.st_mode & 0o022:
+        return _refuse(
+            f"is group/other-writable (mode {statmod.S_IMODE(st.st_mode):04o})"
+        )
     h = hashlib.sha256()
     for f in grid:
         h.update(np.ascontiguousarray(np.asarray(f, dtype=np.float64)).tobytes())
@@ -258,11 +278,23 @@ def reference_ratios_cached(
     path = os.path.join(cache_dir, f"ref_{h.hexdigest()[:24]}.npy")
     n = int(np.asarray(grid.m_chi_GeV).shape[0])
     if os.path.exists(path):
-        out = np.load(path)
-        if out.shape == (n,):
-            if stats is not None:
-                stats["cache_hit"] = True
-            return out
+        try:
+            out = np.load(path)
+        except Exception as exc:
+            # a torn write or disk corruption must cost one recompute,
+            # not the whole gate run (ADVICE r5) — and the poisoned file
+            # must go, or every future hit re-pays this branch
+            print(f"[refcache] {path} is corrupt ({exc!r}); deleting and "
+                  "recomputing", file=sys.stderr)
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+        else:
+            if out.shape == (n,):
+                if stats is not None:
+                    stats["cache_hit"] = True
+                return out
     out = reference_ratios(grid, static, n_y=n_y)
     fd, tmp = tempfile.mkstemp(dir=cache_dir, suffix=".npy")
     os.close(fd)

@@ -30,7 +30,10 @@ long to wait for a dead accelerator relay to recover before benching CPU;
 the JSON stamps platform/tpu_unavailable/relay_waited_s either way),
 BDLZ_BENCH_ODE_POINTS (grid size for the secondary stiff ESDIRK sweep
 metric, printed as its own line before the main one; default 1024 on
-TPU, 64 on the CPU-fallback path), BDLZ_BENCH_LZ_POINTS (grid size for
+TPU, 64 on the CPU-fallback path — the line A/Bs the lane-repacking
+batch engine against the legacy lockstep strategy and records
+vs_lockstep, both engines' Radau spot accuracy, and the per-round
+compaction stats), BDLZ_BENCH_LZ_POINTS (grid size for
 the two LZ-sweep secondary metrics — per-point P derived from a bounce
 profile through the two-channel LZ kernel, once analytically and once
 through the coherent transfer-matrix P(v_w) table; default: the full
@@ -309,6 +312,7 @@ def main() -> None:
 
         from bdlz_tpu.parallel.sweep import make_sweep_step
         from bdlz_tpu.physics.percolation import make_kjma_grid as _mkg
+        from bdlz_tpu.utils.profiling import CompactionStats
 
         # CPU fallback still records a (small, flagged) number so a
         # relay-dead round never benches two of three engines as null
@@ -325,28 +329,108 @@ def main() -> None:
             "Gamma_wash_over_H": np.linspace(0.005, 0.1, side_o),
         })
         n_ode = int(np.asarray(pp_ode.m_chi_GeV).shape[0])
-        step_ode = make_sweep_step(static_ode, mesh=mesh, impl="esdirk")
         grid_j = _mkg(jnp)
         # pad to a device multiple (side_o**2 need not divide n_dev)
         pad_n = ((n_ode + n_dev - 1) // n_dev) * n_dev
         ppc = _pad_chunk(pp_ode, 0, n_ode, pad_n)
-        ppc = jax.tree.map(lambda a: jax.device_put(jnp.asarray(a), sharding), ppc)
-        step_ode(ppc, grid_j).DM_over_B.block_until_ready()  # compile warm-up
-        t1 = time.time()
-        out_ode = step_ode(ppc, grid_j).DM_over_B
-        out_ode.block_until_ready()
-        esdirk_seconds = time.time() - t1
+        ppc_dev = jax.tree.map(
+            lambda a: jax.device_put(jnp.asarray(a), sharding), ppc
+        )
+
+        def time_engine(impl, **kw):
+            step = make_sweep_step(static_ode, mesh=mesh, impl=impl, **kw)
+            out = step(ppc_dev, grid_j).DM_over_B
+            jax.block_until_ready(out)  # compile warm-up
+            t1 = time.time()
+            out = step(ppc_dev, grid_j).DM_over_B
+            jax.block_until_ready(out)
+            return np.asarray(out)[:n_ode], time.time() - t1
+
+        # A/B: the lane-repacking batch engine (the sweep default) vs the
+        # legacy lockstep strategy — the speedup is the round's headline
+        # stiff-engine evidence, so it is measured, not asserted.
+        out_lock, lock_seconds = time_engine("esdirk_lockstep")
+        stats_box = []
+        out_ode, esdirk_seconds = time_engine(
+            "esdirk", esdirk_stats_sink=stats_box.append
+        )
         per_chip_ode = round(n_ode / esdirk_seconds / n_dev, 2)
+        per_chip_lock = round(n_ode / lock_seconds / n_dev, 2)
+        both = np.isfinite(out_ode) & np.isfinite(out_lock) & (out_lock != 0)
+        rel_vs_lock = (
+            float(np.max(np.abs(out_ode[both] / out_lock[both] - 1.0)))
+            if both.any() else None
+        )
+        stats = stats_box[-1].summary() if stats_box else CompactionStats().summary()
+
+        # "equal rel_err_vs_reference": both engines against the scalar
+        # pulse-capped exact-kernel Radau truth (the cross-check the test
+        # battery pins at 1e-6) on a few grid corners — ~1.2 s/point, so
+        # a spot sample, not the grid
+        from bdlz_tpu.models.yields_pipeline import present_day
+        from bdlz_tpu.solvers.boltzmann import solve_scipy_radau
+
+        # None until a spot is actually measured — an all-skipped sample
+        # (Radau non-convergence, engine NaN at the corners) must report
+        # null, never a fabricated-perfect 0.0
+        rel_ref = {"esdirk": None, "lockstep": None}
+        for i in (0, n_ode // 2, n_ode - 1):
+            pp_i = type(pp_ode)(*(float(np.asarray(f)[i]) for f in pp_ode))
+            T_lo_i = pp_i.T_min_over_Tp * pp_i.T_p_GeV
+            T_hi_i = pp_i.T_max_over_Tp * pp_i.T_p_GeV
+            ref = solve_scipy_radau(
+                pp_i, static_ode.chi_stats,
+                static_ode.deplete_DM_from_source, _mkg(np),
+                (pp_i.Y_chi_init, 0.0), T_lo_i, T_hi_i,
+                rtol=1e-10, atol=1e-20, reference_step_cap=False,
+                pulse_step_cap=True, table_n=None,
+            )
+            if not ref.success:
+                continue
+            ref_ratio = float(present_day(
+                ref.Y_B, ref.Y_chi, pp_i.m_chi_GeV, pp_i.m_B_kg, np
+            ).DM_over_B)
+            if ref_ratio == 0.0 or not np.isfinite(ref_ratio):
+                continue
+            for name, arr in (("esdirk", out_ode), ("lockstep", out_lock)):
+                val = float(arr[i])
+                if not np.isfinite(val):
+                    continue  # the n_failed field already reports NaNs
+                err = abs(val / ref_ratio - 1.0)
+                rel_ref[name] = (
+                    err if rel_ref[name] is None else max(rel_ref[name], err)
+                )
         print(
             json.dumps({
                 "metric": "esdirk_sweep_points_per_sec_per_chip",
                 "value": per_chip_ode,
                 "unit": "stiff ODE param-points/sec/chip (Gamma_wash grid)",
                 "n_points": n_ode,
-                "n_failed": int(
-                    (~np.isfinite(np.asarray(out_ode)[:n_ode])).sum()
-                ),
+                "n_failed": int((~np.isfinite(out_ode)).sum()),
                 "seconds": round(esdirk_seconds, 3),
+                # the lockstep A/B: same grid, same tolerances, legacy
+                # engine — vs_lockstep is the repacking+accelerations
+                # speedup at the rel_err recorded next to it
+                "vs_lockstep": round(per_chip_ode / max(per_chip_lock, 1e-9), 1),
+                "lockstep_points_per_sec_per_chip": per_chip_lock,
+                "lockstep_seconds": round(lock_seconds, 3),
+                "rel_err_vs_lockstep": (
+                    None if rel_vs_lock is None
+                    else float(f"{rel_vs_lock:.3e}")
+                ),
+                # spot sample vs the pulse-capped exact-kernel Radau truth
+                # (3 grid corners) for BOTH engines — "3x at equal
+                # accuracy" needs the accuracy measured on the same line;
+                # null = no spot could be measured, NOT perfect accuracy
+                "rel_err_vs_reference": (
+                    None if rel_ref["esdirk"] is None
+                    else float(f"{rel_ref['esdirk']:.3e}")
+                ),
+                "lockstep_rel_err_vs_reference": (
+                    None if rel_ref["lockstep"] is None
+                    else float(f"{rel_ref['lockstep']:.3e}")
+                ),
+                "compaction": stats,
                 "platform": jax.devices()[0].platform,
                 "tpu_unavailable": tpu_unavailable,
             })

@@ -7,6 +7,7 @@ import subprocess
 import sys
 
 import numpy as np
+import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -59,4 +60,22 @@ def test_bench_cpu_smoke():
     for s in secondary:
         assert s["platform"] == "cpu"
         assert "tpu_unavailable" in s
+    # the stiff metric carries the lockstep A/B: repacked throughput,
+    # the speedup ratio, the engines' mutual drift, AND both engines'
+    # Radau spot accuracy ("3x at equal rel_err" needs all four fields)
+    ode = next(s for s in secondary
+               if s["metric"] == "esdirk_sweep_points_per_sec_per_chip")
+    assert ode["value"] > 0 and ode["lockstep_points_per_sec_per_chip"] > 0
+    assert ode["vs_lockstep"] == pytest.approx(
+        ode["value"] / ode["lockstep_points_per_sec_per_chip"], rel=0.05
+    )
+    # null is bench's documented "not measured" sentinel (Radau spot
+    # failure / all-NaN lanes); on the CPU smoke grid every spot must
+    # actually measure, so fail with the real signal, not a TypeError
+    for key in ("rel_err_vs_lockstep", "rel_err_vs_reference",
+                "lockstep_rel_err_vs_reference"):
+        assert ode[key] is not None, f"{key} unmeasured (null) on smoke grid"
+        assert ode[key] <= 1e-6, (key, ode[key])
+    assert ode["compaction"]["rounds"] >= 1
+    assert ode["compaction"]["lanes_retired"] >= ode["n_points"]
     assert np.isfinite(d["value"])

@@ -104,3 +104,23 @@ def test_shape_metadata_branches_are_not_tracer_branches():
     )
     report = lint_source(source, path="ops/tree_product.py")
     assert not [f for f in report.active if f.rule == "R2"]
+
+
+def test_lint_sh_clean_including_batching_engine():
+    """scripts/lint.sh — the repo's one lint command — is part of tier-1:
+    it must exit 0 on the tree, and the lane-repacking stiff engine
+    specifically (solvers/batching.py + the solvers it drives) must carry
+    zero unsuppressed findings (host-orchestration np use is exactly the
+    surface R1 exists to police, so it is pinned per-file, not only via
+    the package-wide sweep)."""
+    proc = subprocess.run(
+        ["bash", str(REPO_ROOT / "scripts" / "lint.sh")],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    report = lint_paths([
+        str(PACKAGE / "solvers" / "batching.py"),
+        str(PACKAGE / "solvers" / "sdirk.py"),
+    ])
+    offenders = "\n".join(f.render() for f in report.active)
+    assert not report.active, f"stiff-engine findings:\n{offenders}"

@@ -109,6 +109,35 @@ def test_table2d_speed_chunk_budget_matches_default():
     )
 
 
+def test_table2d_ragged_tail_compiles_once():
+    """speed_chunk not dividing n_v must NOT cost a second compilation:
+    the tail chunk is padded to the common chunk shape (mirroring
+    probabilities_for_points) — a second trace of the jitted P_chunk
+    would re-pay ~the whole first chunk's compile on long profiles.
+    Also pins that the padded tail produces the same values as an
+    evenly-divided build."""
+    from bdlz_tpu.lz.sweep_bridge import TRACE_COUNTS, make_P_of_vw_gamma_table
+
+    xi = np.linspace(-30.0, 30.0, 2001)
+    prof = BounceProfile(
+        xi=xi, delta=-0.08 * np.tanh(xi / 4.0), mix=np.full(2001, 0.02)
+    )
+    before = TRACE_COUNTS["P_chunk_2d"]
+    # n_v=10 with speed_chunk=4 -> chunks of 4, 4, and a ragged 2
+    t_ragged = make_P_of_vw_gamma_table(
+        prof, 0.1, 0.9, 0.0, 0.2, n_v=10, n_g=8, speed_chunk=4
+    )
+    assert TRACE_COUNTS["P_chunk_2d"] - before == 1
+    # dividing chunk, same nodes: values bitwise equal (vmap lanes are
+    # independent, so tail padding cannot perturb the real nodes)
+    t_even = make_P_of_vw_gamma_table(
+        prof, 0.1, 0.9, 0.0, 0.2, n_v=10, n_g=8, speed_chunk=5
+    )
+    np.testing.assert_array_equal(
+        np.asarray(t_ragged.values), np.asarray(t_even.values)
+    )
+
+
 def test_ptable_build_at_1e6_segments(big_profile, monkeypatch):
     """The MCMC's P(v_w) table build runs the chunked path end to end at
     design scale (small node count keeps the test fast; the table-node

@@ -1,0 +1,372 @@
+"""Lane-repacking batched ESDIRK engine (solvers/batching.py).
+
+The engine's contract has two halves, both pinned here:
+
+* with the acceleration knobs OFF it is a pure EXECUTION-ORDER
+  transformation — every lane's step sequence, counters, and final state
+  are bit-identical to the lockstep vmapped engine, regardless of round
+  budget, batch composition, or input lane order;
+* with the knobs ON (its defaults) it stays inside the stiff path's
+  accuracy contract versus the lockstep engine while retiring lanes
+  monotonically (the compaction stats are the evidence surface).
+"""
+import dataclasses
+
+import numpy as np
+import pytest
+
+from bdlz_tpu.config import (
+    config_from_dict,
+    static_choices_from_config,
+)
+from bdlz_tpu.parallel.sweep import build_grid
+from bdlz_tpu.utils.profiling import CompactionStats
+
+
+def bench_cfg(**over):
+    base = {
+        "regime": "nonthermal",
+        "P_chi_to_B": 0.14925839040304145,
+        "source_shape_sigma_y": 9.0,
+        "incident_flux_scale": 1.07e-9,
+        "Y_chi_init": 4.90e-10,
+    }
+    base.update(over)
+    return config_from_dict(base)
+
+
+def mixed_grid(n_side: int):
+    """A mixed-stiffness flat grid: washout strength and pulse width both
+    spread over their interesting ranges so per-lane step counts diverge
+    (which is what makes repacking non-trivial)."""
+    cfg = dataclasses.replace(
+        bench_cfg(), Gamma_wash_over_H=0.01, T_min_over_Tp=0.1
+    )
+    axes = {
+        "m_chi_GeV": np.geomspace(0.3, 3.0, n_side).tolist(),
+        "Gamma_wash_over_H": np.geomspace(1e-3, 0.5, n_side).tolist(),
+        "source_shape_sigma_y": [3.0, 15.0],
+    }
+    return cfg, build_grid(cfg, axes)
+
+
+def lockstep_solve(pp, static):
+    """The reference: jit(vmap(solve_boltzmann_esdirk)) — the legacy
+    lockstep strategy, bit-pinned by the golden/Radau battery."""
+    import jax
+    import jax.numpy as jnp
+
+    from bdlz_tpu.physics.percolation import make_kjma_grid
+    from bdlz_tpu.solvers.sdirk import solve_boltzmann_esdirk
+
+    grid = make_kjma_grid(jnp)
+
+    def one(pp_i):
+        T_hi = pp_i.T_max_over_Tp * pp_i.T_p_GeV
+        T_lo = pp_i.T_min_over_Tp * pp_i.T_p_GeV
+        return solve_boltzmann_esdirk(
+            pp_i, static, grid, (pp_i.Y_chi_init, 0.0), T_lo, T_hi
+        )
+
+    ppj = jax.tree.map(jnp.asarray, pp)
+    return jax.jit(jax.vmap(one))(ppj)
+
+
+def repacked_solve(pp, static, **kw):
+    import jax.numpy as jnp
+
+    from bdlz_tpu.physics.percolation import make_kjma_grid
+    from bdlz_tpu.solvers.batching import solve_boltzmann_esdirk_batch
+
+    return solve_boltzmann_esdirk_batch(
+        pp, static, make_kjma_grid(jnp), **kw
+    )
+
+
+KNOBS_OFF = dict(
+    ode_auto_h0=False, ode_pi_controller=False, ode_tabulated_av=False
+)
+
+
+class TestBitParityWithLockstep:
+    def _assert_bit_identical(self, pp, static, round_steps):
+        ref = lockstep_solve(pp, static)
+        stats = CompactionStats()
+        sol = repacked_solve(
+            pp, static, round_steps=round_steps, stats=stats
+        )
+        np.testing.assert_array_equal(np.asarray(sol.y), np.asarray(ref.y))
+        np.testing.assert_array_equal(
+            np.asarray(sol.n_steps), np.asarray(ref.n_steps)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(sol.n_accepted), np.asarray(ref.n_accepted)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(sol.n_rejected), np.asarray(ref.n_rejected)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(sol.success), np.asarray(ref.success)
+        )
+        return stats
+
+    def test_bit_identical_small_mixed_batch(self):
+        """Knobs off, small budget (forces several pause/compact/resume
+        cycles): per-lane bits match the lockstep engine exactly."""
+        cfg, pp = mixed_grid(2)  # 8 lanes
+        static = static_choices_from_config(cfg)._replace(**KNOBS_OFF)
+        stats = self._assert_bit_identical(pp, static, round_steps=48)
+        assert stats.n_rounds > 1  # the pause/resume path actually ran
+
+    @pytest.mark.slow
+    def test_bit_identical_32_lane_mixed_batch(self):
+        """The full 32-lane mixed-stiffness case (slow: the lockstep
+        reference pays the exact-kernel z-integral on every lane)."""
+        cfg, pp = mixed_grid(4)  # 32 lanes
+        static = static_choices_from_config(cfg)._replace(**KNOBS_OFF)
+        stats = self._assert_bit_identical(pp, static, round_steps=40)
+        assert stats.n_rounds > 1
+
+    def test_lane_order_independence(self):
+        """Shuffling the input lanes permutes the outputs and nothing
+        else — the stiffness-proxy sort and the unsort are exact
+        inverses, and vmapped lanes do not interact."""
+        cfg, pp = mixed_grid(2)
+        static = static_choices_from_config(cfg)._replace(**KNOBS_OFF)
+        sol = repacked_solve(pp, static, round_steps=48)
+        rng = np.random.default_rng(11)
+        perm = rng.permutation(8)
+        pp_shuf = type(pp)(*(np.asarray(f)[perm] for f in pp))
+        sol_shuf = repacked_solve(pp_shuf, static, round_steps=48)
+        np.testing.assert_array_equal(
+            np.asarray(sol_shuf.y), np.asarray(sol.y)[perm]
+        )
+        np.testing.assert_array_equal(
+            np.asarray(sol_shuf.n_steps), np.asarray(sol.n_steps)[perm]
+        )
+
+
+class TestRoundsAndRetirement:
+    def test_retires_monotonically(self):
+        """Active lane counts never increase across rounds, every lane
+        retires exactly once, and the recorded accept/reject counters
+        reconcile with the solution's totals."""
+        cfg, pp = mixed_grid(2)
+        static = static_choices_from_config(cfg)
+        stats = CompactionStats()
+        sol = repacked_solve(pp, static, round_steps=32, stats=stats)
+        active = [r.active_lanes for r in stats.rounds]
+        assert all(a >= b for a, b in zip(active, active[1:]))
+        assert sum(r.lanes_retired for r in stats.rounds) == 8
+        assert sum(r.steps_accepted for r in stats.rounds) == int(
+            np.asarray(sol.n_accepted).sum()
+        )
+        assert sum(r.steps_rejected for r in stats.rounds) == int(
+            np.asarray(sol.n_rejected).sum()
+        )
+        assert all(r.seconds >= 0.0 for r in stats.rounds)
+        s = stats.summary()
+        assert 0.0 <= s["pad_waste"] < 1.0
+
+    def test_all_lanes_converge_in_round_one(self):
+        """A budget larger than any lane's step count: exactly one round,
+        everyone retires in it."""
+        cfg, pp = mixed_grid(2)
+        static = static_choices_from_config(cfg)
+        stats = CompactionStats()
+        sol = repacked_solve(pp, static, round_steps=100_000, stats=stats)
+        assert stats.n_rounds == 1
+        assert stats.rounds[0].lanes_retired == 8
+        assert bool(np.asarray(sol.success).all())
+
+    def test_no_lane_converges(self):
+        """max_steps below any lane's need: every lane exhausts its
+        budget, reports failure (not NaN, not a hang), and the round loop
+        terminates after ceil(max_steps/round_steps) rounds."""
+        cfg, pp = mixed_grid(2)
+        static = static_choices_from_config(cfg)
+        stats = CompactionStats()
+        sol = repacked_solve(
+            pp, static, round_steps=10, max_steps=25, stats=stats
+        )
+        assert not bool(np.asarray(sol.success).any())
+        np.testing.assert_array_equal(np.asarray(sol.n_steps), 25)
+        assert stats.n_rounds == 3  # 10 + 10 + 5
+        # no lane "retires" by converging, but all leave the active set
+        assert sum(r.lanes_retired for r in stats.rounds) == 8
+
+
+class TestAcceleratedDefaults:
+    def test_accelerated_engine_stays_in_contract(self):
+        """The engine's default knobs (auto-h0 + PI + tabulated A/V) move
+        results by ~1e-8 on the washout grid — well inside the stiff
+        path's 1e-6 contract vs the Radau-pinned lockstep engine."""
+        cfg, pp = mixed_grid(2)
+        static = static_choices_from_config(cfg)
+        ref = lockstep_solve(pp, static)
+        sol = repacked_solve(pp, static)
+        ok = np.asarray(ref.success) & np.asarray(sol.success)
+        assert ok.all()
+        YB_r, YB_s = np.asarray(ref.y)[:, 1], np.asarray(sol.y)[:, 1]
+        assert np.max(np.abs(YB_s / YB_r - 1.0)) < 1e-6
+        Yc_r, Yc_s = np.asarray(ref.y)[:, 0], np.asarray(sol.y)[:, 0]
+        assert np.max(np.abs(Yc_s / Yc_r - 1.0)) < 1e-6
+
+    def test_mixed_ip_batch_falls_back_to_exact_kernel(self):
+        """The F(y) table is per-I_p: a batch sweeping I_p silently runs
+        the exact-kernel RHS instead (resolution is per-batch, and the
+        knob resolution is what the sweep folds into its resume hash)."""
+        from bdlz_tpu.solvers.batching import resolve_engine_knobs
+
+        cfg, pp = mixed_grid(2)
+        static = static_choices_from_config(cfg)
+        assert resolve_engine_knobs(static, np.asarray(pp.I_p)) == {
+            "auto_h0": True, "pi_controller": True, "tabulated_av": True,
+        }
+        ip_mixed = np.asarray(pp.I_p).copy()
+        ip_mixed[0] = 0.5
+        assert resolve_engine_knobs(static, ip_mixed)["tabulated_av"] is False
+        # explicit config override beats the engine default
+        static_off = static._replace(ode_tabulated_av=False)
+        assert resolve_engine_knobs(
+            static_off, np.asarray(pp.I_p)
+        )["tabulated_av"] is False
+        # and the mixed-I_p batch still solves correctly end to end
+        pp_mixed = pp._replace(I_p=ip_mixed)
+        sol = repacked_solve(pp_mixed, static)
+        ref = lockstep_solve(pp_mixed, static._replace(**KNOBS_OFF))
+        assert bool(np.asarray(sol.success).all())
+        rel = np.abs(
+            np.asarray(sol.y)[:, 1] / np.asarray(ref.y)[:, 1] - 1.0
+        )
+        assert np.max(rel) < 1e-6
+
+
+class TestSweepIntegration:
+    def test_sweep_default_is_repacked_and_matches_engine(self):
+        """run_sweep's stiff default (impl='esdirk') reproduces a direct
+        batch-engine solve.  The sweep layer adds chunk padding and mesh
+        sharding; a sharded one-lane-per-device dispatch was measured to
+        re-tile the z-integral's trapezoid reduction and shift results by
+        ~1 ulp (6e-14 rel), so the cross-EXECUTION-SHAPE comparison is
+        pinned at 1e-12 — the strict bitwise contract lives in
+        TestBitParityWithLockstep, where both engines run the same
+        shape."""
+        from bdlz_tpu.models.yields_pipeline import present_day
+        from bdlz_tpu.parallel import make_mesh, run_sweep
+
+        cfg = dataclasses.replace(
+            bench_cfg(), Gamma_wash_over_H=0.05, T_min_over_Tp=0.2
+        )
+        static = static_choices_from_config(cfg)
+        axes = {"m_chi_GeV": [0.5, 0.95, 1.4]}
+        mesh = make_mesh(shape=(4, 2))
+        res = run_sweep(cfg, axes, static, mesh=mesh, chunk_size=8)
+        assert res.n_failed == 0
+        pp = build_grid(cfg, axes)
+        sol = repacked_solve(pp, static)
+        ref = present_day(
+            np.asarray(sol.y)[:, 1], np.asarray(sol.y)[:, 0],
+            np.asarray(pp.m_chi_GeV), np.asarray(pp.m_B_kg), np,
+        )
+        np.testing.assert_allclose(res.outputs["Y_B"], ref.Y_B, rtol=1e-12)
+        np.testing.assert_allclose(
+            res.outputs["DM_over_B"], ref.DM_over_B, rtol=1e-12
+        )
+
+    def test_lockstep_strategy_still_selectable(self):
+        """impl='esdirk_lockstep' stays available for A/B and reproduces
+        the repacked engine within the contract."""
+        from bdlz_tpu.parallel import make_mesh, run_sweep
+
+        cfg = dataclasses.replace(
+            bench_cfg(), Gamma_wash_over_H=0.05, T_min_over_Tp=0.2
+        )
+        static = static_choices_from_config(cfg)
+        axes = {"m_chi_GeV": [0.5, 0.95]}
+        mesh = make_mesh(shape=(4, 2))
+        res_new = run_sweep(cfg, axes, static, mesh=mesh, chunk_size=8)
+        res_old = run_sweep(
+            cfg, axes, static, mesh=mesh, chunk_size=8,
+            impl="esdirk_lockstep",
+        )
+        np.testing.assert_allclose(
+            res_new.outputs["Y_B"], res_old.outputs["Y_B"], rtol=1e-6
+        )
+
+    def test_esdirk_resume_hash_pins_resolved_knobs(self, tmp_path):
+        """A directory computed at one knob resolution must not resume
+        under another: flipping a tri-state knob changes the manifest
+        hash, so the sweep recomputes from scratch."""
+        from bdlz_tpu.parallel import make_mesh, run_sweep
+
+        cfg = dataclasses.replace(
+            bench_cfg(), Gamma_wash_over_H=0.05, T_min_over_Tp=0.2
+        )
+        axes = {"m_chi_GeV": [0.5, 0.95]}
+        mesh = make_mesh(shape=(4, 2))
+        out = str(tmp_path / "sweep")
+        static = static_choices_from_config(cfg)
+        run_sweep(cfg, axes, static, mesh=mesh, chunk_size=8, out_dir=out)
+        r_same = run_sweep(
+            cfg, axes, static, mesh=mesh, chunk_size=8, out_dir=out
+        )
+        assert r_same.resumed_chunks == 1
+        r_flip = run_sweep(
+            cfg, axes, static._replace(ode_pi_controller=False),
+            mesh=mesh, chunk_size=8, out_dir=out,
+        )
+        assert r_flip.resumed_chunks == 0
+
+    def test_chunk_boundaries_never_flip_the_rhs_kernel(self):
+        """A stiff sweep over an I_p axis resolves tabulated_av=False at
+        the SWEEP level: chunks that happen to land inside one I_p block
+        (here every chunk, at chunk_size=2 on an I_p-slowest grid) must
+        NOT silently upgrade to the F-table RHS — results are identical
+        whether chunk boundaries align with I_p blocks or not (review
+        finding r6: per-chunk knob resolution keyed numerics on
+        chunk_size, which the resume hash does not include)."""
+        from bdlz_tpu.parallel import run_sweep
+
+        cfg = dataclasses.replace(
+            bench_cfg(), Gamma_wash_over_H=0.05, T_min_over_Tp=0.2
+        )
+        static = static_choices_from_config(cfg)
+        # I_p varies slowest: chunk_size=2 puts each chunk inside one
+        # I_p block (uniform), chunk_size=4 spans both blocks (mixed)
+        axes = {"I_p": [0.3, 0.34], "m_chi_GeV": [0.5, 0.95]}
+        res_aligned = run_sweep(cfg, axes, static, chunk_size=2)
+        res_mixed = run_sweep(cfg, axes, static, chunk_size=4)
+        assert res_aligned.n_failed == res_mixed.n_failed == 0
+        np.testing.assert_array_equal(
+            res_aligned.outputs["Y_B"], res_mixed.outputs["Y_B"]
+        )
+
+    def test_event_log_carries_compaction_rounds(self, tmp_path):
+        """The per-round compaction stats surface through the sweep's
+        event log (one esdirk_rounds event per chunk)."""
+        from bdlz_tpu.parallel import make_mesh, run_sweep
+        from bdlz_tpu.utils.logging import EventLog
+
+        cfg = dataclasses.replace(
+            bench_cfg(), Gamma_wash_over_H=0.05, T_min_over_Tp=0.2
+        )
+        static = static_choices_from_config(cfg)
+        mesh = make_mesh(shape=(4, 2))
+        log_path = tmp_path / "events.jsonl"
+        ev = EventLog(path=str(log_path))
+        run_sweep(
+            cfg, {"m_chi_GeV": [0.5, 0.95]}, static, mesh=mesh,
+            chunk_size=8, event_log=ev,
+        )
+        ev.close()
+        import json
+
+        events = [json.loads(ln) for ln in log_path.read_text().splitlines()]
+        rounds = [e for e in events if e["event"] == "esdirk_rounds"]
+        assert len(rounds) == 1
+        # the chunk is padded to chunk_size, so the engine retires the
+        # padding lanes too — 8, not 2
+        assert rounds[0]["lanes_retired"] == 8
+        assert rounds[0]["rounds"] >= 1
+        assert isinstance(rounds[0]["per_round"], list)

@@ -227,7 +227,13 @@ class TestODESweep:
             pp_i, static, grid, (float(pp_i.Y_chi_init), 0.0), T_lo, T_hi
         )
         ref = present_day(sol.y[1], sol.y[0], pp_i.m_chi_GeV, pp_i.m_B_kg, jnp)
-        assert YB[i] == pytest.approx(float(ref.Y_B), rel=1e-12)
+        # the sweep's default stiff engine is the repacked batch engine
+        # with the acceleration knobs on (~2e-8 vs the bit-pinned
+        # per-point path); ABSOLUTE tolerance, because approx's rel on a
+        # ~1e-10 yield would silently be dominated by its 1e-12 abs
+        # default.  The bit-level sweep↔engine pin lives in
+        # tests/test_sdirk_batching.py.
+        assert YB[i] == pytest.approx(float(ref.Y_B), rel=1e-6, abs=0.0)
 
     def test_quadrature_limit_agreement(self, base_cfg, mesh8):
         """With all ODE knobs at zero, the esdirk sweep must agree with the
@@ -564,3 +570,32 @@ def test_resume_invalidated_by_chunk_size_change(base_cfg, mesh8, tmp_path,
     np.testing.assert_allclose(
         r1.outputs["DM_over_B"], r2.outputs["DM_over_B"], rtol=1e-12
     )
+
+
+def test_tier_agreement_wire_version_skew(monkeypatch):
+    """The fleet tier agreement sends [version, -version, code]: a fleet
+    mixing wire-format versions must fail with the explicit skew error on
+    every host, never interpret another build's tier code (satellite of
+    the r6 wire-format break; see docs/multihost.md)."""
+    import bdlz_tpu.parallel.multihost as mh
+    from bdlz_tpu.parallel.sweep import (
+        _TIER_WIRE_VERSION,
+        _agree_tier_code,
+    )
+
+    # healthy single-process path: identity allreduce, code passes through
+    assert _agree_tier_code(1) == 1
+    assert _agree_tier_code(-2) == -2
+
+    # simulate a fleet where another host runs wire version v+1: the
+    # elementwise min over [v, -v, code] columns yields min_v != max_v
+    def skewed_armin(arr):
+        other = np.array(
+            [_TIER_WIRE_VERSION + 1, -(_TIER_WIRE_VERSION + 1), 0],
+            dtype=np.int64,
+        )
+        return np.minimum(np.asarray(arr), other)
+
+    monkeypatch.setattr(mh, "allreduce_min", skewed_armin)
+    with pytest.raises(RuntimeError, match="version skew"):
+        _agree_tier_code(1)

@@ -43,8 +43,10 @@ def test_all_zero_reference_raises():
 
 def test_ref_zero_points_held_to_abs_tol(capsys):
     """ref==0 points are excluded from max-rel but bounded by an absolute
-    tolerance scaled to the population magnitude (1e-6 * max|ref|); the
-    exclusion count is logged to stderr, keeping stdout JSON-clean."""
+    tolerance scaled to the TYPICAL population magnitude (1e-6 * median
+    nonzero |ref| — max|ref| over a 15-decade population would be ~10
+    decades too loose, ADVICE r5); the exclusion count is logged to
+    stderr, keeping stdout JSON-clean."""
     ref = np.array([10.0, 0.0, -5.0, 0.0])
     got = np.array([10.0, 5e-6, -5.0 * (1 + 2e-7), -4e-6])
     rel = population_max_rel(_runner(got), 2, ref)
@@ -114,3 +116,99 @@ def test_ref_zero_point_with_large_engine_value_fails():
     got = np.array([10.0, 0.5, -5.0])
     with pytest.raises(GateFailure, match="zero-reference point"):
         population_max_rel(_runner(got), 3, ref)
+
+
+def test_abs_tol_scales_to_median_not_max():
+    """One 15-decade outlier in the reference population must not loosen
+    the zero-point tolerance by 15 decades (ADVICE r5): a value that is
+    huge relative to the TYPICAL output scale fails even though it is
+    tiny next to max|ref|."""
+    ref = np.array([1e6, 1.0, 1.0, 0.0])
+    got = np.array([1e6, 1.0, 1.0, 0.5])  # 0.5 ≪ 1e-6*max but ≫ 1e-6*median
+    with pytest.raises(GateFailure, match="zero-reference point"):
+        population_max_rel(_runner(got), 4, ref)
+
+
+class TestRefcacheHardening:
+    """The cache dir IS the accuracy gate's ground truth (ADVICE r5):
+    symlinks, foreign write bits, and corrupt payloads must all fail
+    SAFE — recompute, never trust."""
+
+    def _pop(self):
+        from bdlz_tpu.config import config_from_dict, static_choices_from_config
+        from bdlz_tpu.validation import build_audit_population
+
+        base = config_from_dict({
+            "regime": "nonthermal", "P_chi_to_B": 0.149,
+            "Y_chi_init": 4.9e-10, "incident_flux_scale": 1.07e-9,
+        })
+        pop = build_audit_population(base, 4, seed=7)
+        return pop.grid, static_choices_from_config(base)
+
+    def test_symlinked_cache_dir_refused(self, tmp_path, capsys):
+        from bdlz_tpu.validation import reference_ratios_cached
+
+        grid, static = self._pop()
+        real = tmp_path / "real"
+        real.mkdir(mode=0o700)
+        link = tmp_path / "link"
+        link.symlink_to(real)
+        stats = {}
+        out = reference_ratios_cached(
+            grid, static, n_y=200, cache_dir=str(link), stats=stats
+        )
+        assert "symlink" in capsys.readouterr().err
+        assert stats["cache_hit"] is False
+        assert not list(real.glob("ref_*.npy"))  # nothing written through it
+        np.testing.assert_array_equal(
+            out,
+            reference_ratios_cached(grid, static, n_y=200, cache_dir=""),
+        )
+
+    def test_group_writable_cache_dir_refused(self, tmp_path, capsys):
+        import os
+
+        from bdlz_tpu.validation import reference_ratios_cached
+
+        grid, static = self._pop()
+        d = tmp_path / "loose"
+        d.mkdir()
+        os.chmod(d, 0o770)
+        reference_ratios_cached(grid, static, n_y=200, cache_dir=str(d))
+        assert "group/other-writable" in capsys.readouterr().err
+        assert not list(d.glob("ref_*.npy"))
+
+    def test_corrupt_cache_file_deleted_and_recomputed(self, tmp_path, capsys):
+        from bdlz_tpu.validation import reference_ratios_cached
+
+        grid, static = self._pop()
+        d = str(tmp_path / "cache")
+        first = reference_ratios_cached(grid, static, n_y=200, cache_dir=d)
+        files = list((tmp_path / "cache").glob("ref_*.npy"))
+        assert len(files) == 1
+        files[0].write_bytes(b"not a numpy file")
+        stats = {}
+        again = reference_ratios_cached(
+            grid, static, n_y=200, cache_dir=d, stats=stats
+        )
+        assert "corrupt" in capsys.readouterr().err
+        assert stats["cache_hit"] is False
+        np.testing.assert_array_equal(again, first)
+        # the rewritten file is valid again: third call is a clean hit
+        stats = {}
+        third = reference_ratios_cached(
+            grid, static, n_y=200, cache_dir=d, stats=stats
+        )
+        assert stats["cache_hit"] is True
+        np.testing.assert_array_equal(third, first)
+
+    def test_default_dir_under_user_cache_root(self, tmp_path, monkeypatch):
+        """The default cache root honors XDG_CACHE_HOME (and therefore
+        never lands in the world-writable system temp dir)."""
+        from bdlz_tpu.validation import reference_ratios_cached
+
+        grid, static = self._pop()
+        monkeypatch.setenv("XDG_CACHE_HOME", str(tmp_path / "xdg"))
+        monkeypatch.delenv("BDLZ_REF_CACHE_DIR", raising=False)
+        reference_ratios_cached(grid, static, n_y=200)
+        assert list((tmp_path / "xdg" / "bdlz_refcache").glob("ref_*.npy"))
