@@ -1,0 +1,30 @@
+"""Yield-surface emulator: error-controlled tensor-grid surrogate of the
+full exact pipeline, built by driving the production sweep engine and
+served through a jitted log-space interpolation kernel (microsecond
+queries vs milliseconds-to-seconds per exact point).  See
+ARCHITECTURE.md "Emulator + serving layer" for the artifact format and
+staleness rules."""
+from bdlz_tpu.emulator.artifact import (  # noqa: F401
+    FIELDS,
+    SCHEMA_VERSION,
+    EmulatorArtifact,
+    EmulatorArtifactError,
+    artifact_hash,
+    build_identity,
+    check_identity,
+    load_artifact,
+    save_artifact,
+)
+from bdlz_tpu.emulator.build import (  # noqa: F401
+    AxisSpec,
+    BuildReport,
+    EmulatorBuildError,
+    build_emulator,
+    make_exact_evaluator,
+)
+from bdlz_tpu.emulator.grid import (  # noqa: F401
+    in_domain_one,
+    interp_log_fields,
+    make_domain_fn,
+    make_query_fn,
+)

@@ -1,0 +1,360 @@
+"""Versioned yield-surface emulator artifacts: save, load, reject-loudly.
+
+An artifact is a directory holding
+
+* ``artifact.npz`` — one ``axis_<name>`` node array per parameter axis
+  (strictly increasing, config-schema units) and one ``field_<name>``
+  value array per emitted pipeline output, shaped ``(n_1, …, n_d)`` in
+  axis order (C-order, matching ``parallel.sweep.build_grid``'s
+  first-axis-slowest convention);
+* ``manifest.json`` — schema version, identity (the resolved base
+  config / static choices / n_y / engine the surface was computed
+  with), build provenance (refinement rounds, held-out max rel err,
+  build seconds), and a content hash.
+
+The hash follows the ``run_sweep`` resume-hash pattern
+(``grid_hash``: config identity + axes + n_y + impl) extended with the
+value bytes and the schema version, so EVERY way an artifact can go
+stale is loud: changed physics knobs change the identity hash, a
+modified/corrupt ``.npz`` changes the value hash, and a schema change
+changes the version.  A mismatch is :class:`EmulatorArtifactError` at
+load — a stale emulator silently serving wrong yields is the one
+failure mode this layer must never have.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import tempfile
+from typing import Any, Dict, List, Mapping, NamedTuple, Sequence, Tuple
+
+import numpy as np  # host-side use only; jitted paths go through the backend.py xp seam (bdlz-lint R1 audit)
+
+#: Bump whenever the artifact layout or manifest meaning changes: a
+#: version mismatch at load is an explicit error, never a reinterpret.
+SCHEMA_VERSION = 1
+
+#: The pipeline outputs an artifact carries (YieldsResult field order).
+FIELDS = ("Y_B", "Y_chi", "rho_B_kg_m3", "rho_DM_kg_m3", "DM_over_B")
+
+
+class EmulatorArtifactError(ValueError):
+    """A stale, tampered, or malformed emulator artifact.
+
+    A dedicated type so callers can distinguish "this artifact must be
+    rebuilt" from unrelated ValueErrors — and so tests can pin that
+    every rejection path raises it explicitly."""
+
+
+class EmulatorArtifact(NamedTuple):
+    """One loaded (or freshly built) yield-surface emulator."""
+
+    axis_names: Tuple[str, ...]            # config-schema axis names, in order
+    axis_nodes: Tuple[np.ndarray, ...]     # strictly increasing f64 nodes
+    axis_scales: Tuple[str, ...]           # "lin" | "log" interpolation coord
+    values: Dict[str, np.ndarray]          # field -> (n_1, ..., n_d) f64
+    identity: Dict[str, Any]               # resolved config/static/n_y/impl
+    manifest: Dict[str, Any]               # full manifest payload
+
+    @property
+    def domain(self) -> Dict[str, Tuple[float, float]]:
+        return {
+            name: (float(nodes[0]), float(nodes[-1]))
+            for name, nodes in zip(self.axis_names, self.axis_nodes)
+        }
+
+    @property
+    def n_points(self) -> int:
+        n = 1
+        for nodes in self.axis_nodes:
+            n *= len(nodes)
+        return n
+
+
+def build_identity(base, static, n_y: int, impl: str) -> Dict[str, Any]:
+    """The physics identity an artifact is valid for.
+
+    Same ingredients as ``parallel.sweep.grid_hash`` (config through
+    ``config_identity_dict`` so adding a defaulted extension field does
+    not invalidate every existing artifact; resolved StaticChoices;
+    n_y; engine) — an emulator is a cache of ``run_sweep`` output and
+    must go stale exactly when a sweep directory would.
+    """
+    from bdlz_tpu.config import config_identity_dict
+
+    return {
+        "base": config_identity_dict(base),
+        "static": list(tuple(static)),
+        "n_y": int(n_y),
+        "impl": str(impl),
+    }
+
+
+def artifact_hash(
+    axis_names: Sequence[str],
+    axis_nodes: Sequence[np.ndarray],
+    axis_scales: Sequence[str],
+    values: Mapping[str, np.ndarray],
+    identity: Mapping[str, Any],
+) -> str:
+    """Content hash over axes + value bytes + identity + schema version.
+
+    The axis SCALES are part of the identity: they select each axis's
+    interpolation coordinate, so the same table queried under a
+    different scale list returns different numbers.
+    """
+    h = hashlib.sha256()
+    payload = {
+        "schema_version": SCHEMA_VERSION,
+        "axes": {
+            str(n): [float(v) for v in np.asarray(nodes)]
+            for n, nodes in zip(axis_names, axis_nodes)
+        },
+        "scales": [str(s) for s in axis_scales],
+        "identity": dict(identity),
+        "fields": sorted(values),
+    }
+    h.update(json.dumps(payload, sort_keys=True).encode())
+    for name in sorted(values):
+        h.update(name.encode())
+        h.update(np.ascontiguousarray(
+            np.asarray(values[name], dtype=np.float64)
+        ).tobytes())
+    return h.hexdigest()[:16]
+
+
+def _validate_table(artifact: EmulatorArtifact, where: str) -> None:
+    """Reject non-finite or non-positive cells LOUDLY.
+
+    The query kernel interpolates in log-space: a NaN/inf cell would
+    poison every query in its 2^d-cell neighborhood, and a zero or
+    negative cell has no logarithm — both must fail at the boundary
+    (build or load), never surface as a quietly wrong served yield.
+    """
+    if len(artifact.axis_names) != len(artifact.axis_nodes):
+        raise EmulatorArtifactError(
+            f"{where}: {len(artifact.axis_names)} axis names but "
+            f"{len(artifact.axis_nodes)} node arrays"
+        )
+    shape = tuple(len(n) for n in artifact.axis_nodes)
+    if len(artifact.axis_scales) != len(artifact.axis_names):
+        raise EmulatorArtifactError(
+            f"{where}: {len(artifact.axis_names)} axes but "
+            f"{len(artifact.axis_scales)} scales"
+        )
+    for name, nodes, scale in zip(
+        artifact.axis_names, artifact.axis_nodes, artifact.axis_scales
+    ):
+        nodes = np.asarray(nodes)
+        if scale not in ("lin", "log"):
+            raise EmulatorArtifactError(
+                f"{where}: axis {name!r} has unknown scale {scale!r}"
+            )
+        if nodes.ndim != 1 or len(nodes) < 2:
+            raise EmulatorArtifactError(
+                f"{where}: axis {name!r} needs >= 2 one-dimensional nodes"
+            )
+        if not np.all(np.isfinite(nodes)) or not np.all(np.diff(nodes) > 0):
+            raise EmulatorArtifactError(
+                f"{where}: axis {name!r} nodes must be finite and strictly "
+                "increasing"
+            )
+        if scale == "log" and nodes[0] <= 0.0:
+            raise EmulatorArtifactError(
+                f"{where}: log-scale axis {name!r} needs positive nodes"
+            )
+    if not artifact.values:
+        raise EmulatorArtifactError(f"{where}: artifact carries no fields")
+    for fname, vals in artifact.values.items():
+        vals = np.asarray(vals)
+        if vals.shape != shape:
+            raise EmulatorArtifactError(
+                f"{where}: field {fname!r} has shape {vals.shape}, expected "
+                f"{shape} from the axis node counts"
+            )
+        bad = ~np.isfinite(vals)
+        if bad.any():
+            idx = tuple(int(i) for i in np.argwhere(bad)[0])
+            raise EmulatorArtifactError(
+                f"{where}: field {fname!r} holds {int(bad.sum())} "
+                f"non-finite cell(s), first at grid index {idx} — the "
+                "emulator build masks nothing; rebuild over a domain where "
+                "the exact pipeline succeeds"
+            )
+        nonpos = vals <= 0.0
+        if nonpos.any():
+            idx = tuple(int(i) for i in np.argwhere(nonpos)[0])
+            raise EmulatorArtifactError(
+                f"{where}: field {fname!r} holds {int(nonpos.sum())} "
+                f"non-positive cell(s), first at grid index {idx} — the "
+                "log-space query kernel needs strictly positive values"
+            )
+
+
+def save_artifact(out_dir: str, artifact: EmulatorArtifact) -> str:
+    """Write ``artifact.npz`` + ``manifest.json`` into ``out_dir``.
+
+    Both writes are atomic (tmp + ``os.replace``; the manifest through
+    the shared ``utils.io.atomic_write_json`` helper) and the manifest
+    goes LAST — a reader never sees a manifest whose hash refers to a
+    half-written ``.npz``.
+    """
+    from bdlz_tpu.utils.io import atomic_write_json
+
+    _validate_table(artifact, where="save")
+    os.makedirs(out_dir, exist_ok=True)
+    npz_path = os.path.join(out_dir, "artifact.npz")
+
+    arrays: Dict[str, np.ndarray] = {}
+    for name, nodes in zip(artifact.axis_names, artifact.axis_nodes):
+        arrays[f"axis_{name}"] = np.asarray(nodes, dtype=np.float64)
+    for name, vals in artifact.values.items():
+        arrays[f"field_{name}"] = np.asarray(vals, dtype=np.float64)
+    # suffix must end in ".npz" or np.savez APPENDS it and the rename
+    # would ship an empty temp file as the artifact
+    fd, tmp = tempfile.mkstemp(dir=out_dir, suffix=".tmp.npz")
+    os.close(fd)
+    try:
+        np.savez(tmp, **arrays)
+        os.replace(tmp, npz_path)
+    except BaseException:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
+
+    manifest = dict(artifact.manifest)
+    manifest["schema_version"] = SCHEMA_VERSION
+    manifest["axes"] = list(artifact.axis_names)
+    manifest["axis_scales"] = {
+        n: s for n, s in zip(artifact.axis_names, artifact.axis_scales)
+    }
+    manifest["fields"] = sorted(artifact.values)
+    manifest["identity"] = artifact.identity
+    manifest["hash"] = artifact_hash(
+        artifact.axis_names, artifact.axis_nodes, artifact.axis_scales,
+        artifact.values, artifact.identity,
+    )
+    atomic_write_json(os.path.join(out_dir, "manifest.json"), manifest, indent=2)
+    return out_dir
+
+
+def load_artifact(
+    path: str, expect_identity: "Mapping[str, Any] | None" = None
+) -> EmulatorArtifact:
+    """Load and fully validate an artifact directory.
+
+    Rejections (all :class:`EmulatorArtifactError`, all explicit about
+    what went stale):
+
+    * missing/unparsable manifest or ``.npz``;
+    * ``schema_version`` mismatch (the reader would misinterpret the
+      layout);
+    * content-hash mismatch — the ``.npz`` or the manifest's identity
+      was modified after the build (torn copy, hand edit, bit rot);
+    * non-finite or non-positive table cells (see ``_validate_table``);
+    * ``expect_identity`` given and != the stored identity — the caller
+      is about to serve physics the artifact was not built for (changed
+      config knobs, different engine, different n_y).
+    """
+    manifest_path = os.path.join(path, "manifest.json")
+    npz_path = os.path.join(path, "artifact.npz")
+    try:
+        with open(manifest_path, encoding="utf-8") as f:
+            manifest = json.load(f)
+    except Exception as exc:
+        raise EmulatorArtifactError(
+            f"cannot read emulator manifest {manifest_path}: {exc!r}"
+        ) from exc
+    version = manifest.get("schema_version")
+    if version != SCHEMA_VERSION:
+        raise EmulatorArtifactError(
+            f"emulator artifact {path} has schema_version {version!r}, this "
+            f"build reads {SCHEMA_VERSION}; rebuild the artifact"
+        )
+    axis_names = tuple(str(n) for n in manifest.get("axes", ()))
+    field_names = [str(n) for n in manifest.get("fields", ())]
+    identity = manifest.get("identity")
+    scales_map = manifest.get("axis_scales")
+    if (
+        not axis_names or not field_names
+        or not isinstance(identity, dict) or not isinstance(scales_map, dict)
+    ):
+        raise EmulatorArtifactError(
+            f"emulator manifest {manifest_path} is missing "
+            "axes/axis_scales/fields/identity"
+        )
+    axis_scales = tuple(str(scales_map.get(n, "lin")) for n in axis_names)
+    try:
+        with np.load(npz_path) as data:
+            axis_nodes = tuple(
+                np.asarray(data[f"axis_{n}"], dtype=np.float64)
+                for n in axis_names
+            )
+            values = {
+                n: np.asarray(data[f"field_{n}"], dtype=np.float64)
+                for n in field_names
+            }
+    except EmulatorArtifactError:
+        raise
+    except Exception as exc:
+        raise EmulatorArtifactError(
+            f"cannot read emulator table {npz_path}: {exc!r}"
+        ) from exc
+
+    got_hash = artifact_hash(axis_names, axis_nodes, axis_scales, values, identity)
+    if got_hash != manifest.get("hash"):
+        raise EmulatorArtifactError(
+            f"emulator artifact {path} failed its content-hash check "
+            f"(manifest {manifest.get('hash')!r}, recomputed {got_hash!r}): "
+            "the table or its identity changed after the build — rebuild "
+            "instead of serving a stale/tampered surface"
+        )
+    artifact = EmulatorArtifact(
+        axis_names=axis_names,
+        axis_nodes=axis_nodes,
+        axis_scales=axis_scales,
+        values=values,
+        identity=identity,
+        manifest=manifest,
+    )
+    _validate_table(artifact, where=f"load {path}")
+    if expect_identity is not None:
+        check_identity(artifact, expect_identity)
+    return artifact
+
+
+def check_identity(
+    artifact: EmulatorArtifact,
+    expect: Mapping[str, Any],
+    exempt_config_keys: Sequence[str] = (),
+) -> None:
+    """Raise unless the artifact was built for the expected physics.
+
+    ``exempt_config_keys`` names base-config keys whose stored value is
+    irrelevant because they are artifact AXES (the per-point value
+    overrides them) — the likelihood layer uses this so a caller whose
+    base config differs only in a swept field is not falsely rejected.
+    """
+    stored = dict(artifact.identity)
+    want = dict(expect)
+    sb = dict(stored.get("base", {}))
+    wb = dict(want.get("base", {}))
+    for key in set(exempt_config_keys) | set(artifact.axis_names):
+        sb.pop(key, None)
+        wb.pop(key, None)
+    stored["base"], want["base"] = sb, wb
+    diffs: List[str] = []
+    for key in sorted(set(stored) | set(want)):
+        if stored.get(key) != want.get(key):
+            diffs.append(
+                f"{key}: artifact={stored.get(key)!r} caller={want.get(key)!r}"
+            )
+    if diffs:
+        raise EmulatorArtifactError(
+            "emulator artifact identity mismatch (stale artifact — the "
+            "physics knobs changed since the build; rebuild it):\n  "
+            + "\n  ".join(diffs)
+        )

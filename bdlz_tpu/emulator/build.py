@@ -1,0 +1,627 @@
+"""Adaptive tensor-grid emulator builds: populate, probe, refine, save.
+
+The build drives the production sweep engine
+(:func:`bdlz_tpu.parallel.sweep.run_sweep`) in chunks over a tensor
+grid of the configured parameter box, then iterates:
+
+1. draw random probe points, evaluate the EXACT pipeline at them (paid
+   once — their exact values join an accumulating POOL that every later
+   round re-scores for free) and the interim emulator's log-space
+   interpolation;
+2. score per-probe errors with the shared gate rule
+   (:func:`bdlz_tpu.validation.relative_errors` — rel where the
+   reference is nonzero, median-nonzero-scaled abs at zero references);
+3. for every pool probe over the internal target (``rtol/safety``),
+   insert a midpoint node into the ONE axis whose local log-curvature
+   (second divided difference of the stored surface, in the axis's own
+   scale coordinate) is largest — tensor structure means each insert
+   buys a whole hyperplane of new exact evaluations, so the refinement
+   spends its budget on the axes that actually bend;
+4. evaluate only the NEW hyperplanes (never the full grid again) and
+   merge them into the table.
+
+The loop ends when the WHOLE pool scores clean (one lucky round of
+fresh probes cannot end the build — localized features like the
+T = m/3 flux-seam band hide from small draws) or ``max_rounds`` is
+exhausted; either way a FRESH, larger held-out set (different seed) is
+scored and recorded in the manifest as ``max_rel_err`` — the number a
+consumer trusts is never measured on the points that steered the build.
+"""
+from __future__ import annotations
+
+import sys
+import time
+from typing import Any, Dict, List, Mapping, NamedTuple, Optional, Tuple
+
+import numpy as np  # host-side use only; jitted paths go through the backend.py xp seam (bdlz-lint R1 audit)
+
+from bdlz_tpu.emulator.artifact import (
+    FIELDS,
+    EmulatorArtifact,
+    build_identity,
+    save_artifact,
+)
+from bdlz_tpu.emulator.grid import axis_coord, interp_log_fields
+
+VALID_SCALES = ("lin", "log")
+
+#: Node spacing below which a midpoint insert is refused (relative to
+#: the axis span): past this the surface error is not interpolation-
+#: limited and further splitting just burns sweep evaluations.
+_MIN_REL_GAP = 1e-9
+
+_LN10 = float(np.log(10.0))
+
+
+class EmulatorBuildError(RuntimeError):
+    """The build could not produce a trustworthy surface (failed exact
+    points inside the box, invalid spec, refinement budget exhausted
+    with ``require_converged=True``)."""
+
+
+class AxisSpec(NamedTuple):
+    """One parameter axis of the emulator box (config-schema units)."""
+
+    lo: float
+    hi: float
+    n0: int = 5          # initial node count
+    scale: str = "lin"   # "lin" | "log" — node placement and midpoints
+
+
+class BuildReport(NamedTuple):
+    """Provenance of one build, mirrored into the artifact manifest."""
+
+    rounds: List[Dict[str, Any]]   # per-round: probes failed, nodes added, …
+    converged: bool                # pool clean AND no interval estimate over target
+    max_rel_err: float             # held-out set, AFTER refinement
+    rtol: float
+    n_exact_evals: int             # total exact-pipeline points paid
+    build_seconds: float
+    axis_nodes: Dict[str, int]     # final per-axis node counts
+
+
+def _axis_nodes(spec: AxisSpec) -> np.ndarray:
+    if not (np.isfinite(spec.lo) and np.isfinite(spec.hi) and spec.lo < spec.hi):
+        raise EmulatorBuildError(f"axis bounds must be finite with lo < hi, got {spec}")
+    if spec.n0 < 2:
+        raise EmulatorBuildError(f"axis needs >= 2 initial nodes, got {spec}")
+    if spec.scale not in VALID_SCALES:
+        raise EmulatorBuildError(
+            f"axis scale must be one of {VALID_SCALES}, got {spec.scale!r}"
+        )
+    if spec.scale == "log":
+        if spec.lo <= 0:
+            raise EmulatorBuildError(f"log axis needs lo > 0, got {spec}")
+        return np.geomspace(spec.lo, spec.hi, spec.n0)
+    return np.linspace(spec.lo, spec.hi, spec.n0)
+
+
+def _midpoint(lo: float, hi: float, scale: str) -> float:
+    if scale == "log":
+        return float(np.sqrt(lo * hi))
+    return 0.5 * (lo + hi)
+
+
+def _draw_probes(
+    spec: Mapping[str, AxisSpec], n: int, rng: np.random.Generator
+) -> Dict[str, np.ndarray]:
+    """n random points, per-axis uniform in the axis's own scale."""
+    cols: Dict[str, np.ndarray] = {}
+    for name, ax in spec.items():
+        if ax.scale == "log":
+            cols[name] = 10.0 ** rng.uniform(
+                np.log10(ax.lo), np.log10(ax.hi), n
+            )
+        else:
+            cols[name] = rng.uniform(ax.lo, ax.hi, n)
+    return cols
+
+
+def _exact_fields(
+    base, axes: Mapping[str, np.ndarray], static, *, product: bool,
+    mesh, chunk_size: int, n_y: int, impl: str,
+) -> Tuple[Dict[str, np.ndarray], int]:
+    """Exact pipeline over a product grid via the production sweep engine.
+
+    Returns (field -> flat array in C grid order, n_points).  A failed
+    (non-finite) point inside the requested box is an
+    :class:`EmulatorBuildError`: the emulator masks nothing — a surface
+    with holes must be rebuilt over a domain where the pipeline works.
+    """
+    from bdlz_tpu.parallel.sweep import run_sweep
+
+    assert product, "zipped probe evaluation goes through make_exact_evaluator"
+    res = run_sweep(
+        base, dict(axes), static, mesh=mesh, chunk_size=chunk_size,
+        n_y=n_y, out_dir=None, keep_outputs=True, impl=impl,
+    )
+    n_pts = res.n_points
+    if res.n_failed:
+        bad = np.argwhere(np.asarray(res.failed_mask))[:, 0]
+        raise EmulatorBuildError(
+            f"{res.n_failed}/{n_pts} exact pipeline points failed "
+            f"(non-finite) inside the emulator box (first flat index "
+            f"{int(bad[0])}); shrink the box or fix the configuration"
+        )
+    return dict(res.outputs), n_pts
+
+
+def make_exact_evaluator(
+    base, static, *, n_y: int, impl: str, mesh=None, chunk_size: int = 2048,
+):
+    """Zipped exact-pipeline evaluator through the production engine.
+
+    Returns ``evaluate(axes) -> {field: (n,) array}`` where ``axes``
+    maps config-schema names to equal-length per-point value arrays.
+    Non-finite outputs pass through as NaN (mask-and-report — the
+    SERVING layer's out-of-domain fallback must answer garbage corners
+    with NaN, not die); the build's probe path layers its own loud
+    rejection on top.  The step/aux pairing matches ``run_sweep``'s, so
+    emulator refinement compares against exactly the engine that filled
+    the table, and chunks are padded to one fixed shape (one compile).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from bdlz_tpu.models.yields_pipeline import YieldsResult
+    from bdlz_tpu.ops.kjma_table import make_f_table
+    from bdlz_tpu.parallel.sweep import _pad_chunk, build_grid, make_sweep_step
+    from bdlz_tpu.physics.percolation import make_kjma_grid
+
+    interpret = impl == "pallas" and jax.devices()[0].platform == "cpu"
+    step = make_sweep_step(
+        static, mesh=mesh, n_y=n_y, impl=impl, interpret=interpret
+    )
+    if impl == "tabulated":
+        aux = make_f_table(float(base.I_p), jnp)
+    elif impl == "pallas":
+        from bdlz_tpu.ops.kjma_pallas import build_shifted_table
+
+        table = make_f_table(float(base.I_p), jnp)
+        aux = (table, build_shifted_table(table))
+    else:
+        aux = make_kjma_grid(jnp)
+
+    def evaluate(axes: Mapping[str, Any]) -> Dict[str, np.ndarray]:
+        pp = build_grid(base, dict(axes), product=False)
+        n = int(np.asarray(pp.m_chi_GeV).shape[0])
+        chunk = min(int(chunk_size), n) if chunk_size else n
+        out: Dict[str, List[np.ndarray]] = {
+            f: [] for f in YieldsResult._fields
+        }
+        for lo in range(0, n, chunk):
+            hi = min(lo + chunk, n)
+            res = step(_pad_chunk(pp, lo, hi, chunk), aux)
+            for f in YieldsResult._fields:
+                out[f].append(np.asarray(getattr(res, f))[: hi - lo])
+        return {f: np.concatenate(v) for f, v in out.items()}
+
+    return evaluate
+
+
+def _emulated_fields(
+    axis_nodes: List[np.ndarray],
+    axis_scales: List[str],
+    log_values: Dict[str, np.ndarray],
+    probes: np.ndarray,
+) -> Dict[str, np.ndarray]:
+    """Host-side interim-emulator prediction at (n, d) probe points.
+
+    Uses the SAME trace-safe interpolation core as the jitted query
+    kernel (``grid.interp_log_fields``) with ``xp=np`` — the build's
+    error estimates and the served values cannot drift apart.
+    """
+    n = probes.shape[0]
+    out = {f: np.empty(n) for f in log_values}
+    for i in range(n):
+        logs = interp_log_fields(
+            probes[i], axis_nodes, axis_scales, log_values, np
+        )
+        for f, v in logs.items():
+            out[f][i] = 10.0 ** v
+    return out
+
+
+def _probe_errors(
+    emu: Dict[str, np.ndarray], exact: Dict[str, np.ndarray]
+) -> np.ndarray:
+    """Per-probe error = max over fields of the shared gate rule."""
+    from bdlz_tpu.validation import relative_errors
+
+    per_field = [relative_errors(emu[f], exact[f]) for f in emu]
+    return np.max(np.stack(per_field), axis=0)
+
+
+def _curvature_scores(
+    log_values: Dict[str, np.ndarray],
+    axis_nodes: List[np.ndarray],
+    axis_scales: List[str],
+    probe: np.ndarray,
+) -> np.ndarray:
+    """Per-axis estimated local interpolation error at one probe.
+
+    For each axis: ``|f''| · h²`` of log10(value) along that axis at the
+    probe's nearest grid point, with the second DIVIDED difference taken
+    in the axis's own interpolation coordinate (:func:`grid.axis_coord`
+    — index-space differences would be blind to non-uniform spacing,
+    which refinement creates by design) and ``h`` the probe's bracketing
+    gap in that coordinate.  This is, up to a constant, the multilinear
+    interpolation error the refinement is trying to kill — so ranking
+    axes by it spends each insert where it buys the most.  A 2-node axis
+    has no curvature estimate yet and scores +inf: it must be split
+    before anything can be said about it.
+    """
+    from bdlz_tpu.emulator.grid import axis_coord
+
+    d = len(axis_nodes)
+    near = tuple(
+        int(np.clip(np.searchsorted(axis_nodes[k], probe[k]), 0,
+                    len(axis_nodes[k]) - 1))
+        for k in range(d)
+    )
+    scores = np.zeros(d)
+    for k in range(d):
+        nodes = axis_nodes[k]
+        n_k = len(nodes)
+        if n_k < 3:
+            scores[k] = np.inf
+            continue
+        i = int(np.clip(near[k], 1, n_k - 2))
+        u = axis_coord(np.asarray(nodes), axis_scales[k], np)
+        bracket = int(np.clip(np.searchsorted(nodes, probe[k]) - 1, 0, n_k - 2))
+        h = float(u[bracket + 1] - u[bracket])
+        du_lo = float(u[i] - u[i - 1])
+        du_hi = float(u[i + 1] - u[i])
+        for logv in log_values.values():
+            lo = near[:k] + (i - 1,) + near[k + 1:]
+            mid = near[:k] + (i,) + near[k + 1:]
+            hi = near[:k] + (i + 1,) + near[k + 1:]
+            f2 = 2.0 * (
+                (float(logv[hi]) - float(logv[mid])) / du_hi
+                - (float(logv[mid]) - float(logv[lo])) / du_lo
+            ) / (du_lo + du_hi)
+            scores[k] = max(scores[k], abs(f2) * h * h)
+    return scores
+
+
+def _axis_interval_estimates(
+    log_values: Dict[str, np.ndarray],
+    nodes: List[np.ndarray],
+    scales: List[str],
+    k: int,
+) -> "np.ndarray | None":
+    """Per-interval a-posteriori error estimate along axis ``k``.
+
+    ``|f''|·h²/8·ln10`` — the standard linear-interpolation bound on
+    log10(value), converted to a VALUE-relative error — with ``f''`` the
+    second divided difference of every field in the axis's scale
+    coordinate, maxed over fields AND over the rest of the tensor grid.
+    This is what lets the refinement control the sup-norm: a random
+    probe pool only measures error where probes land, while the table
+    itself knows where it curves — intervals no probe ever hit still
+    get split when their estimate exceeds the target.  Returns one
+    estimate per interval (len n_k − 1), or None for a 2-node axis (no
+    curvature information until a probe forces a split).
+    """
+    u = np.asarray(axis_coord(np.asarray(nodes[k]), scales[k], np))
+    n_k = len(u)
+    if n_k < 3:
+        return None
+    du = np.diff(u)
+    c = np.zeros(n_k - 2)
+    for logv in log_values.values():
+        f = np.moveaxis(logv, k, 0).reshape(n_k, -1)
+        d1 = np.diff(f, axis=0) / du[:, None]
+        d2 = 2.0 * np.diff(d1, axis=0) / (du[:-1] + du[1:])[:, None]
+        c = np.maximum(c, np.max(np.abs(d2), axis=1))
+    # node-level curvature (ends take their neighbor's), then per
+    # interval the worse endpoint
+    c_node = np.concatenate([c[:1], c, c[-1:]])
+    return np.maximum(c_node[:-1], c_node[1:]) * du * du / 8.0 * _LN10
+
+
+def build_emulator(
+    base,
+    spec: Mapping[str, AxisSpec],
+    static=None,
+    *,
+    rtol: float = 1e-4,
+    safety: float = 2.0,
+    n_probe: int = 64,
+    n_holdout: Optional[int] = None,
+    max_rounds: int = 8,
+    max_nodes_per_axis: int = 1024,
+    seed: int = 0,
+    n_y: int = 2000,
+    impl: str = "tabulated",
+    chunk_size: int = 2048,
+    mesh=None,
+    out_dir: Optional[str] = None,
+    event_log=None,
+    require_converged: bool = False,
+) -> Tuple[EmulatorArtifact, BuildReport]:
+    """Build (and optionally save) an error-controlled yield-surface emulator.
+
+    ``spec`` maps config-schema axis names (``parallel.sweep.AXIS_MAP``
+    keys) to :class:`AxisSpec` boxes; axis order fixes the artifact's
+    coordinate order.  ``rtol`` is the ADVERTISED tolerance under the
+    shared gate rule; internally the refinement targets ``rtol/safety``
+    (default half-tolerance), because the probe pool is a sample — a
+    pool converged exactly AT rtol leaves the held-out set scoring just
+    above it.  The recorded ``max_rel_err`` is measured at the end on a
+    held-out random point set (``n_holdout``, default 4×``n_probe``)
+    that the refinement never saw.  With ``require_converged=True`` a
+    budget-exhausted build raises instead of saving a surface that
+    missed its tolerance.
+    """
+    from bdlz_tpu.config import static_choices_from_config, validate
+    from bdlz_tpu.parallel.sweep import AXIS_MAP
+
+    t0 = time.time()
+    validate(base)
+    if not (safety >= 1.0):
+        raise EmulatorBuildError(f"safety must be >= 1, got {safety}")
+    refine_tol = float(rtol) / float(safety)
+    if static is None:
+        static = static_choices_from_config(base)
+    if not spec:
+        raise EmulatorBuildError("emulator spec needs at least one axis")
+    unknown = sorted(set(spec) - set(AXIS_MAP))
+    if unknown:
+        raise EmulatorBuildError(
+            f"unknown emulator axes {unknown}; valid: {sorted(AXIS_MAP)}"
+        )
+    # Engine resolution mirrors run_sweep, and is done HERE (once) so the
+    # product population, the probe evaluations, and the artifact identity
+    # all name the same engine — a split would gate the emulator against a
+    # different engine than the one that filled its table.
+    from bdlz_tpu.config import needs_ode_path
+
+    if needs_ode_path(base) and impl != "esdirk_lockstep":
+        impl = "esdirk"
+    if "I_p" in spec and impl in ("tabulated", "pallas"):
+        # mirrors run_sweep's use_table guard — the F-table is per-I_p
+        impl = "direct"
+    spec = dict(spec)
+    axis_names: List[str] = list(spec)
+    nodes: List[np.ndarray] = [_axis_nodes(spec[k]) for k in axis_names]
+    scales: List[str] = [spec[k].scale for k in axis_names]
+    rng = np.random.default_rng(seed)
+
+    def grid_shape() -> Tuple[int, ...]:
+        return tuple(len(a) for a in nodes)
+
+    # --- initial population: one product sweep over the tensor grid ---
+    flat, n_exact = _exact_fields(
+        base, {k: a for k, a in zip(axis_names, nodes)}, static,
+        product=True, mesh=mesh, chunk_size=chunk_size, n_y=n_y, impl=impl,
+    )
+    values = {f: np.asarray(flat[f]).reshape(grid_shape()) for f in FIELDS}
+    _check_positive(values)
+    log_values = {f: np.log10(values[f]) for f in FIELDS}
+
+    # ONE compiled probe evaluator for every refinement round and the
+    # held-out pass (re-building it per round would re-jit per round)
+    exact_eval = make_exact_evaluator(
+        base, static, n_y=n_y, impl=impl, mesh=mesh,
+        chunk_size=min(int(chunk_size), int(n_probe)),
+    )
+
+    def exact_zip(axes):
+        flat = exact_eval(axes)
+        # every SCORED field must be finite, not just the ratio: a probe
+        # whose rho overflows while DM_over_B stays finite would
+        # otherwise NaN its error score, and NaN > tol is False — the
+        # probe would silently pass and the build falsely converge
+        for fname in FIELDS:
+            bad = ~np.isfinite(flat[fname])
+            if bad.any():
+                raise EmulatorBuildError(
+                    f"{int(bad.sum())}/{len(bad)} exact probe points have "
+                    f"non-finite {fname} inside the emulator box; shrink "
+                    "the box or fix the configuration"
+                )
+        return flat
+
+    # The probe POOL accumulates across rounds: every probe's exact value
+    # is paid once and cached, and convergence means the WHOLE pool is
+    # clean — a single lucky round of fresh probes must not end the
+    # build, because localized features (the T = m/3 flux-seam band cuts
+    # a diagonal through (m_chi, T_p) boxes) hide from any one small
+    # draw.  Re-scoring the pool costs host-side interpolation only.
+    pool_probes = np.empty((0, len(axis_names)))
+    pool_exact: Dict[str, np.ndarray] = {f: np.empty(0) for f in FIELDS}
+    rounds: List[Dict[str, Any]] = []
+    converged = False
+    for r in range(int(max_rounds) + 1):
+        probe_cols = _draw_probes(spec, int(n_probe), rng)
+        probes = np.stack([probe_cols[k] for k in axis_names], axis=1)
+        exact = exact_zip(probe_cols)
+        n_exact += int(n_probe)
+        pool_probes = np.concatenate([pool_probes, probes])
+        for f in FIELDS:
+            pool_exact[f] = np.concatenate([pool_exact[f], exact[f]])
+        emu = _emulated_fields(nodes, scales, log_values, pool_probes)
+        errs = _probe_errors(emu, pool_exact)
+        failing = np.flatnonzero(errs > refine_tol)
+
+        # Curvature-driven split candidates (sup-norm control): every
+        # interval whose a-posteriori estimate exceeds the internal
+        # target gets split, probe or no probe — randomized probes
+        # alone leave the un-probed intervals' error uncontrolled (a
+        # 200-node axis has more intervals than a round has probes).
+        curv: Dict[int, List[Tuple[float, float]]] = {}
+        for k in range(len(axis_names)):
+            est = _axis_interval_estimates(log_values, nodes, scales, k)
+            if est is None:
+                continue
+            ax = nodes[k]
+            span = float(ax[-1] - ax[0])
+            for j in np.flatnonzero(est > refine_tol):
+                j = int(j)
+                if (ax[j + 1] - ax[j]) <= _MIN_REL_GAP * span:
+                    continue
+                curv.setdefault(k, []).append((
+                    float(est[j]),
+                    _midpoint(float(ax[j]), float(ax[j + 1]),
+                              spec[axis_names[k]].scale),
+                ))
+        row = {
+            "round": r,
+            "pool_size": int(pool_probes.shape[0]),
+            "n_failing": int(len(failing)),
+            "n_est_splits": sum(len(v) for v in curv.values()),
+            "max_rel_err": float(errs.max()),
+            "grid_shape": list(grid_shape()),
+        }
+        if event_log is not None:
+            event_log.emit("emulator_refine_round", **row)
+        if not len(failing) and not curv:
+            rounds.append(row)
+            converged = True
+            break
+        if r == int(max_rounds):
+            rounds.append(row)
+            break
+
+        # --- probe-driven inserts: one midpoint per failing pool probe
+        # (measured error — it goes in even where the estimate is calm) ---
+        inserts: Dict[int, set] = {}
+        for p in failing:
+            scores = _curvature_scores(
+                log_values, nodes, scales, pool_probes[p]
+            )
+            for k in np.argsort(-scores):
+                k = int(k)
+                ax = nodes[k]
+                if len(ax) + len(inserts.get(k, ())) >= int(max_nodes_per_axis):
+                    continue  # axis at cap; try the next-best one
+                i = int(np.clip(np.searchsorted(ax, pool_probes[p, k]) - 1,
+                                0, len(ax) - 2))
+                mid = _midpoint(float(ax[i]), float(ax[i + 1]),
+                                spec[axis_names[k]].scale)
+                span = float(ax[-1] - ax[0])
+                if (ax[i + 1] - ax[i]) <= _MIN_REL_GAP * span:
+                    continue  # interval already saturated; next-best axis
+                inserts.setdefault(k, set()).add(mid)
+                break
+        # --- estimate-driven inserts, worst intervals first, bounded so
+        # a pathological axis cannot blow the tensor grid past the cap ---
+        for k, cands in curv.items():
+            room = (
+                int(max_nodes_per_axis) - len(nodes[k])
+                - len(inserts.get(k, ()))
+            )
+            for _, mid in sorted(cands, reverse=True)[: max(room, 0)]:
+                inserts.setdefault(k, set()).add(mid)
+        if not inserts:
+            rounds.append({**row, "note": "no refinable interval left"})
+            break
+
+        # --- evaluate only the new hyperplanes, axis by axis ---
+        added = 0
+        for k in sorted(inserts):
+            new_vals = np.asarray(sorted(inserts[k]), dtype=np.float64)
+            axes_eval = {
+                name: (new_vals if j == k else nodes[j])
+                for j, name in enumerate(axis_names)
+            }
+            flat, n_new = _exact_fields(
+                base, axes_eval, static, product=True, mesh=mesh,
+                chunk_size=chunk_size, n_y=n_y, impl=impl,
+            )
+            n_exact += n_new
+            slab_shape = tuple(
+                len(new_vals) if j == k else len(nodes[j])
+                for j in range(len(axis_names))
+            )
+            pos = np.searchsorted(nodes[k], new_vals)
+            for f in FIELDS:
+                slab = np.asarray(flat[f]).reshape(slab_shape)
+                _check_positive({f: slab})
+                values[f] = np.insert(values[f], pos, slab, axis=k)
+                log_values[f] = np.log10(values[f])
+            nodes[k] = np.insert(nodes[k], pos, new_vals)
+            added += len(new_vals)
+        row["nodes_added"] = added
+        rounds.append(row)
+
+    # --- held-out validation: points the refinement never saw, and a
+    # LARGER draw than any single round (the recorded number is what a
+    # consumer trusts — it must not inherit one round's sampling luck) ---
+    n_holdout = max(4 * int(n_probe), 64) if n_holdout is None else int(n_holdout)
+    held_cols = _draw_probes(
+        spec, n_holdout, np.random.default_rng(seed + 10_000)
+    )
+    held = np.stack([held_cols[k] for k in axis_names], axis=1)
+    exact = exact_zip(held_cols)
+    n_exact += n_holdout
+    held_errs = _probe_errors(
+        _emulated_fields(nodes, scales, log_values, held), exact
+    )
+    max_rel_err = float(held_errs.max())
+    if not converged:
+        msg = (
+            f"emulator refinement exhausted {max_rounds} rounds with "
+            f"held-out max rel err {max_rel_err:.3e} vs target {rtol:.1e}"
+        )
+        if require_converged:
+            raise EmulatorBuildError(msg)
+        print(f"[emulator] WARNING: {msg}", file=sys.stderr)
+
+    seconds = time.time() - t0
+    report = BuildReport(
+        rounds=rounds,
+        converged=converged,
+        max_rel_err=max_rel_err,
+        rtol=float(rtol),
+        n_exact_evals=int(n_exact),
+        build_seconds=round(seconds, 3),
+        axis_nodes={k: len(a) for k, a in zip(axis_names, nodes)},
+    )
+    artifact = EmulatorArtifact(
+        axis_names=tuple(axis_names),
+        axis_nodes=tuple(nodes),
+        axis_scales=tuple(scales),
+        values=values,
+        identity=build_identity(base, static, n_y, impl),
+        manifest={
+            "rtol_target": float(rtol),
+            "max_rel_err": max_rel_err,
+            "converged": bool(converged),
+            "refinement_rounds": len(rounds),
+            "build_seconds": report.build_seconds,
+            "n_exact_evals": report.n_exact_evals,
+            "axis_scales": {k: spec[k].scale for k in axis_names},
+            "domain": {
+                k: [float(a[0]), float(a[-1])]
+                for k, a in zip(axis_names, nodes)
+            },
+        },
+    )
+    if event_log is not None:
+        event_log.emit(
+            "emulator_build_done", converged=bool(converged),
+            max_rel_err=max_rel_err, n_exact_evals=n_exact,
+            seconds=report.build_seconds,
+            grid_shape=list(grid_shape()),
+        )
+    if out_dir is not None:
+        save_artifact(out_dir, artifact)
+    return artifact, report
+
+
+def _check_positive(values: Mapping[str, np.ndarray]) -> None:
+    """Loud rejection at build time — same contract the loader enforces."""
+    for f, v in values.items():
+        v = np.asarray(v)
+        if not np.all(np.isfinite(v)):
+            raise EmulatorBuildError(
+                f"exact pipeline produced non-finite {f} inside the box"
+            )
+        if not np.all(v > 0.0):
+            raise EmulatorBuildError(
+                f"exact pipeline produced non-positive {f} inside the box; "
+                "the log-space emulator needs strictly positive fields — "
+                "shrink the box"
+            )

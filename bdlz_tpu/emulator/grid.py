@@ -1,0 +1,167 @@
+"""The emulator's jitted query kernel: log-space tensor-grid interpolation.
+
+Queries are (batch, d) parameter vectors in config-schema units (axis
+order = the artifact's ``axis_names``).  Values are interpolated
+multilinearly in **log10 of the stored field** over the (possibly
+non-uniform — refinement inserts midpoints where the surface curves)
+per-axis node arrays: the yield surface spans many decades and is far
+closer to log-linear than linear across a cell, so log-space
+interpolation is what makes the adaptive build's rel-tol target cheap
+to hit.  Everything inside the kernel is pure gathers + FMAs on
+``jnp`` arrays captured at closure time — trace-safe, vmapped, jitted
+once per artifact shape (the closure pins the arrays, so one compiled
+program serves every query batch of the same length).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Sequence
+
+import numpy as np  # host-side use only; jitted paths go through the backend.py xp seam (bdlz-lint R1 audit)
+
+from bdlz_tpu.emulator.artifact import EmulatorArtifact
+
+Array = Any
+
+
+def axis_coord(x: Array, scale: str, xp) -> Array:
+    """The interpolation coordinate of one axis value.
+
+    ``"log"`` axes interpolate in log10(x): the yield surface is near
+    power-law in those parameters, so a linear-in-x stencil on geomspace
+    nodes would carry curvature no amount of node doubling removes
+    cheaply — in log-log a power law is exactly linear.  ``"lin"`` axes
+    interpolate in x.
+    """
+    if scale == "log":
+        return xp.log10(x)
+    return x
+
+
+def interp_log_fields(
+    theta: Array,
+    axis_nodes: Sequence[Array],
+    axis_scales: Sequence[str],
+    log_values: Dict[str, Array],
+    xp,
+) -> Dict[str, Array]:
+    """Interpolate every field at ONE query point ``theta`` (shape (d,)).
+
+    Trace-safe core shared by the vmapped query kernel and the
+    likelihood fast path (which evaluates one walker at a time under
+    the ensemble's vmap).  Coordinates are clamped into the node range
+    — domain policy (reject / exact fallback / −inf prior) is the
+    CALLER'S job via :func:`in_domain_one`; clamping here keeps the
+    kernel total so a jitted caller can mask afterwards.
+
+    Multilinear over the 2^d cell corners, in log10 of the VALUES, with
+    each axis's fractional offset computed in that axis's own scale
+    coordinate (:func:`axis_coord`); the bracketing search runs on the
+    raw (possibly non-uniform — refinement inserts midpoints) node
+    arrays, which a monotone coordinate transform leaves valid.
+    """
+    d = len(axis_nodes)
+    idx = []
+    frac = []
+    for k in range(d):
+        nodes = axis_nodes[k]
+        scale = axis_scales[k]
+        n_k = nodes.shape[0]
+        x = xp.clip(theta[k], nodes[0], nodes[-1])
+        i = xp.clip(
+            xp.searchsorted(nodes, x, side="right") - 1, 0, n_k - 2
+        ).astype("int32")
+        u = axis_coord(x, scale, xp)
+        u0 = axis_coord(nodes[i], scale, xp)
+        u1 = axis_coord(nodes[i + 1], scale, xp)
+        t = (u - u0) / (u1 - u0)
+        idx.append(i)
+        frac.append(t)
+
+    out: Dict[str, Array] = {}
+    # d is trace-static (artifact shape), so the 2^d corner loop unrolls
+    # at trace time into pure gathers + FMAs.
+    corner_weights = []
+    corner_indices = []
+    for corner in range(1 << d):
+        w = 1.0
+        ind = []
+        for k in range(d):
+            bit = (corner >> k) & 1
+            w = w * (frac[k] if bit else (1.0 - frac[k]))
+            ind.append(idx[k] + bit)
+        corner_weights.append(w)
+        corner_indices.append(tuple(ind))
+    for name, logv in log_values.items():
+        acc = 0.0
+        for w, ind in zip(corner_weights, corner_indices):
+            acc = acc + w * logv[ind]
+        out[name] = acc
+    return out
+
+
+def in_domain_one(theta: Array, axis_nodes: Sequence[Array], xp) -> Array:
+    """True iff every coordinate of one (d,) query lies inside the box."""
+    ok = True
+    for k, nodes in enumerate(axis_nodes):
+        ok = xp.logical_and(
+            ok,
+            xp.logical_and(theta[k] >= nodes[0], theta[k] <= nodes[-1]),
+        )
+    return ok
+
+
+def device_tables(artifact: EmulatorArtifact, fields: Sequence[str]):
+    """(axis_nodes, log_values) as jnp arrays — the one host→device ship."""
+    from bdlz_tpu.backend import ensure_x64
+
+    ensure_x64()
+    import jax.numpy as jnp
+
+    nodes = tuple(jnp.asarray(np.asarray(a, dtype=np.float64))
+                  for a in artifact.axis_nodes)
+    logv = {
+        name: jnp.asarray(np.log10(np.asarray(artifact.values[name],
+                                              dtype=np.float64)))
+        for name in fields
+    }
+    return nodes, logv
+
+
+def make_query_fn(
+    artifact: EmulatorArtifact, field: str = "DM_over_B"
+) -> Callable:
+    """Jitted, vmapped ``query(thetas (B, d)) -> values (B,)``.
+
+    Compiles once per (artifact shape, batch length): the node/value
+    arrays are closure-captured device constants, so repeated calls at
+    a fixed batch size reuse one XLA program — the serving layer pads
+    its batches to a fixed size for exactly this reason.
+    """
+    if field not in artifact.values:
+        raise KeyError(
+            f"field {field!r} not in artifact (has {sorted(artifact.values)})"
+        )
+    import jax
+    import jax.numpy as jnp
+
+    nodes, logv = device_tables(artifact, (field,))
+    scales = artifact.axis_scales
+
+    def one(theta):
+        log_f = interp_log_fields(theta, nodes, scales, logv, jnp)[field]
+        return 10.0 ** log_f
+
+    return jax.jit(jax.vmap(one))
+
+
+def make_domain_fn(artifact: EmulatorArtifact) -> Callable:
+    """Jitted, vmapped ``in_domain(thetas (B, d)) -> bool (B,)``."""
+    import jax
+    import jax.numpy as jnp
+
+    nodes, _ = device_tables(artifact, ())
+
+    def one(theta):
+        return in_domain_one(theta, nodes, jnp)
+
+    return jax.jit(jax.vmap(one))

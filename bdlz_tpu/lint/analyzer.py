@@ -71,6 +71,7 @@ STATIC_PARAM_NAMES = {
     "method",
     "regime",
     "impl",
+    "scale",  # emulator axis scale ("lin"/"log") — structural by construction
     "n_y",
     "nz",
     "n_mu",

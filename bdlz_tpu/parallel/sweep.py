@@ -975,14 +975,16 @@ def run_sweep(
             _esdirk_stats_holder.clear()
 
         if chunk_file and coordinator:
+            from bdlz_tpu.utils.io import atomic_write_json
+
             np.savez(chunk_file, **host, failed=bad)
             manifest["chunks"][str(ci)] = {
                 "file": chunk_file,
                 "n_valid": n_valid,
                 "n_failed": int(bad.sum()),
             }
-            with open(manifest_path, "w") as f:
-                json.dump(manifest, f)
+            # atomic: a crash mid-write must not corrupt resume state
+            atomic_write_json(manifest_path, manifest)
         if keep_outputs:
             for f in fields:
                 collected[f].append(host[f])

@@ -221,8 +221,10 @@ def run_ensemble_checkpointed(
             )
         manifest["done"] = sorted(set(int(i) for i in manifest["done"]) | {k})
         if coordinator:
-            with open(manifest_path, "w") as f:
-                json.dump(manifest, f)
+            from bdlz_tpu.utils.io import atomic_write_json
+
+            # atomic: a crash mid-write must not corrupt resume state
+            atomic_write_json(manifest_path, manifest)
         if event_log is not None:
             event_log.emit(
                 "mcmc_segment_done", segment=k, steps=steps_k,

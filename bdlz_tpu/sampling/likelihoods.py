@@ -52,6 +52,7 @@ def make_pipeline_logprob(
     lz_lambda1: float | None = None,
     lz_P_table=None,
     lz_P_table2d=None,
+    emulator=None,
 ) -> Callable:
     """Build logp(θ) = Planck likelihood of the pipeline at θ.
 
@@ -82,6 +83,22 @@ def make_pipeline_logprob(
     evaluation interpolates P at the walker's (v_w, Γ_φ), so the MCMC
     constrains the decoherence of the distributed-LZ transport against
     the Planck data.
+
+    ``emulator`` (a loaded :class:`bdlz_tpu.emulator.EmulatorArtifact`,
+    or an artifact-directory path) switches logp to the EMULATOR-BACKED
+    FAST MODE: ρ_B and ρ_DM come from the artifact's jitted log-space
+    interpolation instead of the per-walker exact pipeline — the whole
+    reason the emulator exists, since every MCMC step evaluates the
+    pipeline once per walker.  Requirements, all checked loudly at
+    construction: every sampled ``param_keys`` entry must be an artifact
+    axis; the artifact's identity must match ``base``/``static`` (a
+    stale artifact is an :class:`~bdlz_tpu.emulator.EmulatorArtifactError`,
+    never a silently wrong posterior); and axes not being sampled are
+    pinned at the base config's value, which must sit inside the
+    artifact's box.  Walkers OUTSIDE the box score −inf (the emulator
+    domain acts as an implicit prior — size the box to contain
+    ``bounds``); mutually exclusive with the ``lz_*`` P derivations.
+    The default ``emulator=None`` leaves the exact path byte-identical.
     """
     n_lz = sum(x is not None for x in (lz_lambda1, lz_P_table, lz_P_table2d))
     if n_lz > 1:
@@ -116,6 +133,12 @@ def make_pipeline_logprob(
         )
     bounds = dict(bounds or {})
     pp0 = point_params_from_config(base, base.P_chi_to_B or 0.0)
+
+    if emulator is not None:
+        return _make_emulator_logprob(
+            base, static, emulator, param_keys, bounds, log_params,
+            n_lz=n_lz,
+        )
 
     def logp(theta):
         values = {}
@@ -153,6 +176,109 @@ def make_pipeline_logprob(
         res = point_yields_fast(pp, static, table, jnp, n_y=n_y)
         ob, od = omegas_from_result(res)
         lp = lp + planck_gaussian_logp(ob, od)
+        return jnp.where(jnp.isfinite(lp), lp, -jnp.inf)
+
+    return logp
+
+
+def _make_emulator_logprob(
+    base, static, emulator, param_keys, bounds, log_params, n_lz: int,
+) -> Callable:
+    """The emulator-backed fast mode of :func:`make_pipeline_logprob`.
+
+    Validates the artifact against the caller's physics up front (stale
+    artifacts must die at construction, not skew a chain), then returns
+    a logp that interpolates log10(ρ_B) and log10(ρ_DM) from the
+    artifact's table — trace-safe, so ``run_ensemble`` vmaps it across
+    walkers exactly like the exact-path logp.
+    """
+    from bdlz_tpu.emulator import (
+        EmulatorArtifact,
+        build_identity,
+        check_identity,
+        load_artifact,
+    )
+    from bdlz_tpu.emulator.grid import (
+        device_tables,
+        in_domain_one,
+        interp_log_fields,
+    )
+
+    if n_lz:
+        raise ValueError(
+            "the emulator fast mode is mutually exclusive with the lz_* P "
+            "derivations: bake the LZ seam into the emulator's axes (e.g. "
+            "sweep v_w with lz_profile at BUILD time) instead"
+        )
+    if not isinstance(emulator, EmulatorArtifact):
+        emulator = load_artifact(str(emulator))
+    missing = [k for k in param_keys if k not in emulator.axis_names]
+    if missing:
+        raise ValueError(
+            f"sampled parameter(s) {missing} are not axes of the emulator "
+            f"artifact (axes: {list(emulator.axis_names)}); rebuild the "
+            "artifact with those axes or sample on the exact path"
+        )
+    # Stale-artifact gate: the stored identity must match the caller's
+    # physics.  Axis fields are exempt (their per-walker values override
+    # the base); n_y/impl are the artifact's own build record.
+    check_identity(
+        emulator,
+        build_identity(
+            base, static,
+            int(emulator.identity.get("n_y", 0)),
+            str(emulator.identity.get("impl", "tabulated")),
+        ),
+    )
+    pinned: dict = {}
+    for name, nodes in zip(emulator.axis_names, emulator.axis_nodes):
+        if name in param_keys:
+            continue
+        v = getattr(base, name)
+        if v is None:
+            raise ValueError(
+                f"emulator axis {name!r} is not sampled and the base config "
+                "pins it to None; set a concrete value"
+            )
+        v = float(v)
+        if not (float(nodes[0]) <= v <= float(nodes[-1])):
+            raise ValueError(
+                f"base config {name}={v} lies outside the emulator's "
+                f"[{float(nodes[0])}, {float(nodes[-1])}] box for that axis"
+            )
+        pinned[name] = v
+
+    nodes_j, logv = device_tables(
+        emulator, ("rho_B_kg_m3", "rho_DM_kg_m3")
+    )
+    scales = emulator.axis_scales
+    axis_order = emulator.axis_names
+    key_pos = {k: i for i, k in enumerate(param_keys)}
+
+    def logp(theta):
+        lp = jnp.zeros(())
+        sampled = {}
+        for i, k in enumerate(param_keys):
+            v = theta[i]
+            if k in log_params:
+                v = 10.0 ** v
+            if k in bounds:
+                lo, hi = bounds[k]
+                inside_b = jnp.logical_and(theta[i] >= lo, theta[i] <= hi)
+                lp = jnp.where(inside_b, lp, -jnp.inf)
+            sampled[k] = v
+        tvec = jnp.stack([
+            sampled[name] if name in key_pos else jnp.float64(pinned[name])
+            for name in axis_order
+        ])
+        # outside the artifact's box the surface is extrapolation-free by
+        # design — score -inf (implicit prior; documented)
+        inside = in_domain_one(tvec, nodes_j, jnp)
+        logs = interp_log_fields(tvec, nodes_j, scales, logv, jnp)
+        ob = 10.0 ** logs["rho_B_kg_m3"] / RHO_CRIT_OVER_H2_KG_M3
+        od = 10.0 ** logs["rho_DM_kg_m3"] / RHO_CRIT_OVER_H2_KG_M3
+        lp = lp + planck_gaussian_logp(ob, od)
+        lp = jnp.where(inside, lp, -jnp.inf)
         return jnp.where(jnp.isfinite(lp), lp, -jnp.inf)
 
     return logp

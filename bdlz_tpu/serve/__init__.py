@@ -1,0 +1,12 @@
+"""Microbatching query service over the yield-surface emulator
+(`bdlz_tpu/emulator/`): request queue + dynamic batching
+(max-batch-size / max-wait-latency), per-request out-of-domain fallback
+to the exact pipeline, and per-batch observability rows
+(``utils.profiling.ServeStats``).  Entry point: ``python -m
+bdlz_tpu.serve`` (``serve_cli.py``)."""
+from bdlz_tpu.serve.batcher import (  # noqa: F401
+    BatchResult,
+    MicroBatcher,
+    drain_results,
+)
+from bdlz_tpu.serve.service import YieldService  # noqa: F401

@@ -1,0 +1,7 @@
+"""``python -m bdlz_tpu.serve`` → the serving CLI."""
+import sys
+
+from bdlz_tpu.serve.serve_cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,208 @@
+"""Dynamic micro-batching for the query service.
+
+Single-point queries are the natural unit for callers (one user, one
+parameter point) but the worst unit for the accelerator: the jitted
+interpolation kernel answers 4096 points for barely more than it
+answers one.  The batcher sits between the two — requests enqueue from
+any thread, and a dispatch fires when EITHER
+
+* ``max_batch_size`` requests are waiting (full batch, zero added
+  latency), OR
+* the OLDEST waiting request has aged ``max_wait_s`` (latency bound:
+  a lone request never waits longer than the knob).
+
+Design for testability: the dispatch POLICY is a pure function of
+(queue state, now) — :meth:`MicroBatcher.ready_at` / the collection in
+:meth:`run_once` take an injectable ``clock``, so tier-1 unit-tests
+drive batching decisions with a fake clock and never sleep.  The
+background thread (:meth:`start`/:meth:`stop`) is a thin loop around
+``run_once`` guarded by a condition variable; it is exercised by the
+CLI, not by tier-1.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from typing import Any, Callable, Deque, NamedTuple, Optional, Sequence
+
+import numpy as np  # host-side use only; jitted paths go through the backend.py xp seam (bdlz-lint R1 audit)
+
+from bdlz_tpu.utils.profiling import ServeStats
+
+
+class _Pending(NamedTuple):
+    theta: np.ndarray
+    enqueued_at: float
+    future: Future
+
+
+class BatchResult(NamedTuple):
+    """What a process_batch callback returns: per-request values plus
+    how many of them took the out-of-domain exact fallback."""
+
+    values: Sequence[float]
+    n_fallback: int = 0
+
+
+class MicroBatcher:
+    """Request queue + dynamic batcher in front of a batch evaluator.
+
+    ``process_batch`` maps a ``(B, d)`` float64 array to a
+    :class:`BatchResult` (or a bare value sequence).  Exceptions it
+    raises are delivered to every future in the failing batch — a bad
+    batch never wedges the queue.
+    """
+
+    def __init__(
+        self,
+        process_batch: Callable,
+        max_batch_size: int = 256,
+        max_wait_s: float = 0.005,
+        clock: Callable[[], float] = time.monotonic,
+        stats: Optional[ServeStats] = None,
+    ):
+        if max_batch_size < 1:
+            raise ValueError("max_batch_size must be >= 1")
+        if max_wait_s < 0.0:
+            raise ValueError("max_wait_s must be >= 0")
+        self._process = process_batch
+        self.max_batch_size = int(max_batch_size)
+        self.max_wait_s = float(max_wait_s)
+        self._clock = clock
+        self.stats = stats if stats is not None else ServeStats()
+        self._queue: Deque[_Pending] = deque()
+        self._lock = threading.Lock()
+        self._wake = threading.Condition(self._lock)
+        self._thread: Optional[threading.Thread] = None
+        self._stopping = False
+        self._batch_index = 0
+
+    # ---- enqueue ----------------------------------------------------
+
+    def submit(self, theta) -> Future:
+        """Enqueue one d-dimensional query; resolves to its value."""
+        theta = np.asarray(theta, dtype=np.float64).reshape(-1)
+        fut: Future = Future()
+        with self._wake:
+            self._queue.append(_Pending(theta, self._clock(), fut))
+            self._wake.notify()
+        return fut
+
+    # ---- dispatch policy (pure in queue state + now) ----------------
+
+    def ready_at(self, now: Optional[float] = None) -> bool:
+        """Would a dispatch fire at time ``now``?  (No side effects.)"""
+        now = self._clock() if now is None else now
+        with self._lock:
+            return self._ready_locked(now)
+
+    def _ready_locked(self, now: float) -> bool:
+        if not self._queue:
+            return False
+        if len(self._queue) >= self.max_batch_size:
+            return True
+        return (now - self._queue[0].enqueued_at) >= self.max_wait_s
+
+    def pending(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    # ---- one dispatch (the unit tier-1 tests) -----------------------
+
+    def run_once(self, force: bool = False) -> int:
+        """Collect and evaluate one batch if the policy says so.
+
+        Returns the number of requests served (0 = policy said wait).
+        ``force=True`` drains a partial batch regardless of age — the
+        shutdown path, so no request is ever dropped.
+        """
+        now = self._clock()
+        with self._lock:
+            if not self._queue or not (force or self._ready_locked(now)):
+                return 0
+            batch = [
+                self._queue.popleft()
+                for _ in range(min(len(self._queue), self.max_batch_size))
+            ]
+        wait_s = max(now - p.enqueued_at for p in batch)
+        t0 = self._clock()
+        try:
+            # the stack itself can fail (ragged request dimensions) and
+            # must be delivered to the futures like any process failure
+            # — an escape here would kill the background loop and hang
+            # every pending result() forever
+            thetas = np.stack([p.theta for p in batch])
+            result = self._process(thetas)
+        except Exception as exc:  # noqa: BLE001 — delivered per-request
+            for p in batch:
+                p.future.set_exception(exc)
+            return len(batch)
+        if not isinstance(result, BatchResult):
+            result = BatchResult(values=result)
+        values = list(result.values)
+        if len(values) != len(batch):
+            err = RuntimeError(
+                f"process_batch returned {len(values)} values for a "
+                f"{len(batch)}-request batch"
+            )
+            for p in batch:
+                p.future.set_exception(err)
+            return len(batch)
+        seconds = self._clock() - t0
+        self.stats.record_batch(
+            batch_index=self._batch_index,
+            size=len(batch),
+            occupancy=len(batch) / self.max_batch_size,
+            wait_s=float(wait_s),
+            n_fallback=int(result.n_fallback),
+            seconds=float(seconds),
+        )
+        self._batch_index += 1
+        for p, v in zip(batch, values):
+            p.future.set_result(v)
+        return len(batch)
+
+    # ---- background loop (CLI only; not exercised by tier-1) --------
+
+    def start(self) -> None:
+        if self._thread is not None:
+            raise RuntimeError("batcher already started")
+        self._stopping = False
+        self._thread = threading.Thread(
+            target=self._loop, name="bdlz-serve-batcher", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self, drain: bool = True) -> None:
+        """Stop the loop; ``drain=True`` serves whatever is still queued."""
+        if self._thread is None:
+            return
+        with self._wake:
+            self._stopping = True
+            self._wake.notify()
+        self._thread.join()
+        self._thread = None
+        if drain:
+            while self.run_once(force=True):
+                pass
+
+    def _loop(self) -> None:  # pragma: no cover — threaded; CLI-driven
+        while True:
+            with self._wake:
+                if self._stopping:
+                    return
+                if not self._queue:
+                    self._wake.wait(timeout=0.1)
+                    continue
+                age = self._clock() - self._queue[0].enqueued_at
+                timeout = max(self.max_wait_s - age, 0.0)
+                if len(self._queue) < self.max_batch_size and timeout > 0:
+                    self._wake.wait(timeout=timeout)
+            self.run_once()
+
+
+def drain_results(futures: Sequence[Future]) -> "list[Any]":
+    """Resolve submitted futures in order (re-raising any failure)."""
+    return [f.result() for f in futures]

@@ -1,0 +1,175 @@
+"""The microbatching query front-end.
+
+    python -m bdlz_tpu.serve --config cfg.json --artifact emu_dir/ \
+        [--requests queries.jsonl | --bench N] [--max-batch 256] \
+        [--max-wait-ms 5] [--field DM_over_B] [--events events.jsonl]
+
+Requests are JSON lines, one query each, either an object mapping the
+artifact's axis names to values (``{"m_chi_GeV": 0.95, "T_p_GeV":
+100.0}``) or ``{"theta": [0.95, 100.0]}`` in artifact axis order; an
+optional ``"id"`` is echoed back.  Responses are JSON lines on stdout:
+``{"id", "value", "latency_s"}`` in request order (``latency_s`` is
+submit→result through the batcher, after a warm-up call so the first
+batch does not carry the XLA compile), followed by a ``serve_done``
+summary event on stderr (or the ``--events`` log) carrying the
+aggregate fallback/occupancy counters.  ``--bench N`` skips the
+request file and pushes N random in-domain queries through the
+batcher, reporting throughput — the quick way to see what a deployment
+would serve.
+
+The service loads the artifact with full validation (schema version,
+content hash, finite/positive table, identity vs --config) — a stale
+artifact fails HERE, loudly, not in a served number.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from typing import Optional
+
+import numpy as np  # host-side use only; jitted paths go through the backend.py xp seam (bdlz-lint R1 audit)
+
+
+def main(argv: Optional[list] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m bdlz_tpu.serve",
+        description="Microbatched yield-surface query service "
+        "(emulator fast path + exact out-of-domain fallback)",
+    )
+    ap.add_argument("--config", required=True,
+                    help="yields_config JSON the artifact was built for")
+    ap.add_argument("--artifact", required=True,
+                    help="emulator artifact directory (manifest.json + artifact.npz)")
+    ap.add_argument("--requests", default=None,
+                    help="JSON-lines request file ('-' = stdin)")
+    ap.add_argument("--bench", type=int, default=None, metavar="N",
+                    help="skip --requests; time N random in-domain queries")
+    ap.add_argument("--field", default="DM_over_B",
+                    help="served output field (default DM_over_B)")
+    ap.add_argument("--max-batch", type=int, default=256)
+    ap.add_argument("--max-wait-ms", type=float, default=5.0)
+    ap.add_argument("--events", default=None,
+                    help="JSON-lines event log path (default stderr)")
+    args = ap.parse_args(argv)
+
+    from bdlz_tpu.backend import ensure_x64
+
+    ensure_x64()
+
+    from bdlz_tpu.config import load_config, validate
+    from bdlz_tpu.emulator import load_artifact
+    from bdlz_tpu.serve.service import YieldService
+    from bdlz_tpu.utils.logging import EventLog
+
+    event_log = EventLog(path=args.events) if args.events else EventLog()
+    base = validate(load_config(args.config))
+    artifact = load_artifact(args.artifact)
+    service = YieldService(
+        artifact, base, field=args.field, max_batch_size=args.max_batch
+    )
+    event_log.emit(
+        "serve_start",
+        artifact=args.artifact,
+        axes=list(artifact.axis_names),
+        n_grid_points=artifact.n_points,
+        max_rel_err=artifact.manifest.get("max_rel_err"),
+        max_batch=args.max_batch,
+        max_wait_ms=args.max_wait_ms,
+    )
+
+    if args.bench is not None:
+        return _bench(service, int(args.bench), args, event_log)
+
+    if args.requests is None:
+        ap.error("one of --requests or --bench is required")
+
+    fh = sys.stdin if args.requests == "-" else open(args.requests, encoding="utf-8")
+    try:
+        requests = []
+        for ln, line in enumerate(fh, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                obj = json.loads(line)
+                theta = (
+                    np.asarray(obj["theta"], dtype=np.float64)
+                    if "theta" in obj
+                    else service.theta_from_mapping(
+                        {k: v for k, v in obj.items() if k != "id"}
+                    )
+                )
+            except Exception as exc:  # noqa: BLE001 — report per request
+                print(
+                    json.dumps({"id": None, "line": ln, "error": str(exc)})
+                )
+                continue
+            if theta.shape != (len(artifact.axis_names),):
+                print(json.dumps({
+                    "id": obj.get("id", ln),
+                    "error": f"theta has {theta.size} coordinates, this "
+                             f"artifact takes {len(artifact.axis_names)}",
+                }))
+                continue
+            requests.append((obj.get("id", ln), theta))
+    finally:
+        if fh is not sys.stdin:
+            fh.close()
+
+    # warm both jitted paths so the first request's latency_s measures
+    # serving, not the XLA compile
+    service.evaluate(np.array([[nodes[0] for nodes in artifact.axis_nodes]]))
+    batcher = service.make_batcher(max_wait_s=args.max_wait_ms / 1e3)
+    batcher.start()
+    # latency is stamped at SUBMIT — file parsing above is not queue time
+    futures = [(rid, time.monotonic(), batcher.submit(theta))
+               for rid, theta in requests]
+    try:
+        for rid, t0, fut in futures:
+            value = fut.result()
+            print(json.dumps({
+                "id": rid,
+                "value": float(value),
+                "latency_s": round(time.monotonic() - t0, 6),
+            }))
+    finally:
+        batcher.stop()
+    event_log.emit("serve_done", **service.stats.summary())
+    return 0
+
+
+def _bench(service, n: int, args, event_log) -> int:
+    """--bench: random in-domain traffic through the real batcher."""
+    rng = np.random.default_rng(0)
+    lo = np.array([nodes[0] for nodes in service.artifact.axis_nodes])
+    hi = np.array([nodes[-1] for nodes in service.artifact.axis_nodes])
+    thetas = rng.uniform(lo, hi, size=(n, len(lo)))
+    # warm both jitted programs before timing
+    service.evaluate(thetas[: min(n, service.max_batch_size)])
+    batcher = service.make_batcher(max_wait_s=args.max_wait_ms / 1e3)
+    batcher.start()
+    t0 = time.monotonic()
+    futures = [batcher.submit(t) for t in thetas]
+    values = [f.result() for f in futures]
+    seconds = time.monotonic() - t0
+    batcher.stop()
+    summary = service.stats.summary()
+    print(json.dumps({
+        "metric": "serve_bench_queries_per_sec",
+        "value": round(n / max(seconds, 1e-9), 1),
+        "n_queries": n,
+        "seconds": round(seconds, 4),
+        "finite": int(np.isfinite(np.asarray(values)).sum()),
+        **summary,
+    }))
+    event_log.emit(
+        "serve_bench_done", n_queries=n,
+        wall_seconds=round(seconds, 4), **summary,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

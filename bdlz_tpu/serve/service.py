@@ -1,0 +1,154 @@
+"""The yield query service: emulator fast path + exact-pipeline fallback.
+
+:class:`YieldService` owns the two evaluation paths a query can take:
+
+* **in-domain** — the artifact's jitted log-space interpolation kernel
+  (microseconds per batched point);
+* **out-of-domain** — the exact pipeline through the same engine the
+  artifact was built with (``emulator.build.make_exact_evaluator``),
+  so a query outside the box gets the REAL answer at exact-path cost
+  instead of a clamped-edge lie.  Non-finite exact output (absurd
+  corners) passes through as NaN per request, mask-and-report style.
+
+Batches are padded to a fixed bucket before hitting either jitted
+program, so one compile per path serves every batch size; the
+:class:`~bdlz_tpu.serve.batcher.MicroBatcher` composes with
+:meth:`YieldService.process_batch` for queue-fed serving.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import numpy as np  # host-side use only; jitted paths go through the backend.py xp seam (bdlz-lint R1 audit)
+
+from bdlz_tpu.emulator.artifact import (
+    EmulatorArtifact,
+    build_identity,
+    check_identity,
+)
+from bdlz_tpu.emulator.build import make_exact_evaluator
+from bdlz_tpu.emulator.grid import make_domain_fn, make_query_fn
+from bdlz_tpu.serve.batcher import BatchResult, MicroBatcher
+from bdlz_tpu.utils.profiling import ServeStats
+
+
+def _pad_rows(arr: np.ndarray, n: int) -> np.ndarray:
+    """Pad (B, d) to (n, d) by repeating the last row (masked out later)."""
+    if arr.shape[0] >= n:
+        return arr
+    return np.concatenate(
+        [arr, np.repeat(arr[-1:], n - arr.shape[0], axis=0)]
+    )
+
+
+class YieldService:
+    """Batched (Ω_DM/Ω_b)-style yield queries against one artifact.
+
+    ``base``/``static`` must be the physics the artifact was built for —
+    checked at construction via the artifact identity (axis fields
+    exempt: their per-query values override the base), so a service can
+    never silently pair a stale surface with its exact fallback.  The
+    fallback runs at the ARTIFACT's recorded n_y/engine: both paths
+    answer from the same surface definition.
+    """
+
+    def __init__(
+        self,
+        artifact: EmulatorArtifact,
+        base,
+        static=None,
+        field: str = "DM_over_B",
+        max_batch_size: int = 256,
+        mesh=None,
+    ):
+        from bdlz_tpu.config import static_choices_from_config
+
+        if static is None:
+            static = static_choices_from_config(base)
+        n_y = int(artifact.identity.get("n_y", 0))
+        impl = str(artifact.identity.get("impl", "tabulated"))
+        check_identity(artifact, build_identity(base, static, n_y, impl))
+        self.artifact = artifact
+        self.field = field
+        self.max_batch_size = int(max_batch_size)
+        self._query = make_query_fn(artifact, field=field)
+        self._in_domain = make_domain_fn(artifact)
+        self._exact = make_exact_evaluator(
+            base, static, n_y=n_y, impl=impl, mesh=mesh,
+            chunk_size=self.max_batch_size,
+        )
+        self.stats = ServeStats()
+
+    # ---- evaluation -------------------------------------------------
+
+    def evaluate(self, thetas) -> Tuple[np.ndarray, int]:
+        """(values, n_fallback) for a (B, d) batch of queries.
+
+        The emulator answers every in-domain request from one padded
+        jitted call; out-of-domain requests are regrouped into one
+        exact-pipeline call (padded to the same bucket) — the fallback
+        is per-REQUEST, so one stray query cannot drag a whole batch
+        onto the slow path.
+        """
+        thetas = np.atleast_2d(np.asarray(thetas, dtype=np.float64))
+        b = thetas.shape[0]
+        if thetas.shape[1] != len(self.artifact.axis_names):
+            raise ValueError(
+                f"queries must have {len(self.artifact.axis_names)} "
+                f"coordinates ({', '.join(self.artifact.axis_names)}), "
+                f"got shape {thetas.shape}"
+            )
+        bucket = self.max_batch_size
+        padded = _pad_rows(thetas, bucket)
+        inside = np.asarray(self._in_domain(padded))[:b]
+        # np.array (copy): the device buffer view is read-only, and the
+        # fallback writes exact values into the out-of-domain slots
+        values = np.array(self._query(padded), dtype=np.float64)[:b]
+        n_fallback = int((~inside).sum())
+        if n_fallback:
+            ood = _pad_rows(thetas[~inside], bucket)
+            axes = {
+                name: ood[:, k]
+                for k, name in enumerate(self.artifact.axis_names)
+            }
+            exact = self._exact(axes)[self.field][:n_fallback]
+            values[~inside] = exact
+        return values, n_fallback
+
+    # ---- batcher integration ---------------------------------------
+
+    def process_batch(self, thetas) -> BatchResult:
+        values, n_fallback = self.evaluate(thetas)
+        return BatchResult(values=list(values), n_fallback=n_fallback)
+
+    def make_batcher(
+        self,
+        max_wait_s: float = 0.005,
+        clock=None,
+        stats: Optional[ServeStats] = None,
+    ) -> MicroBatcher:
+        """A MicroBatcher wired to this service (shared stats object)."""
+        import time
+
+        return MicroBatcher(
+            self.process_batch,
+            max_batch_size=self.max_batch_size,
+            max_wait_s=max_wait_s,
+            clock=time.monotonic if clock is None else clock,
+            stats=self.stats if stats is None else stats,
+        )
+
+    def theta_from_mapping(self, point: Dict[str, float]) -> np.ndarray:
+        """(d,) query vector from an {axis_name: value} mapping."""
+        missing = [n for n in self.artifact.axis_names if n not in point]
+        if missing:
+            raise ValueError(f"query is missing axes {missing}")
+        unknown = sorted(set(point) - set(self.artifact.axis_names))
+        if unknown:
+            raise ValueError(
+                f"query has unknown axes {unknown}; this artifact takes "
+                f"{list(self.artifact.axis_names)}"
+            )
+        return np.asarray(
+            [float(point[n]) for n in self.artifact.axis_names]
+        )

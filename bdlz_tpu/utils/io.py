@@ -9,10 +9,40 @@ defaults, so a pure reference run produces a byte-identical file.
 from __future__ import annotations
 
 import json
+import os
+import tempfile
 from typing import Any, Dict
 
 from bdlz_tpu.config import REFERENCE_KEYS, Config, default_config
 from bdlz_tpu.models.yields_pipeline import YieldsResult
+
+
+def atomic_write_json(path: str, payload: Any, **dump_kwargs: Any) -> None:
+    """Write ``payload`` as JSON to ``path`` atomically (mkstemp + replace).
+
+    THE manifest-write primitive for every resumable artifact in the repo
+    (sweep chunk manifests, MCMC checkpoint manifests, emulator
+    artifacts): a direct ``json.dump`` into the final path can be torn by
+    a crash mid-write, and a torn manifest corrupts resume state — the
+    exact failure the manifests exist to survive.  The temp file lives in
+    the destination directory so ``os.replace`` is a same-filesystem
+    atomic rename (the pattern proven in ``validation.py``'s reference
+    cache); concurrent readers see either the old complete file or the
+    new complete file, never half a write.
+    """
+    d = os.path.dirname(os.path.abspath(path))
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "w", encoding="utf-8") as f:
+            json.dump(payload, f, **dump_kwargs)
+        os.replace(tmp, path)
+    except BaseException:
+        # never leave the temp file behind on a failed dump/rename
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
 
 
 def _scalar(v: Any) -> Any:

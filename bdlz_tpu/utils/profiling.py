@@ -63,6 +63,63 @@ class CompactionStats:
         return [dataclasses.asdict(r) for r in self.rounds]
 
 
+@dataclass(frozen=True)
+class ServeBatch:
+    """One dispatched micro-batch of the query service
+    (``bdlz_tpu/serve``): how full it ran, how long its oldest request
+    waited, how many requests missed the emulator domain and took the
+    exact-pipeline fallback, and how long the evaluation took."""
+
+    batch_index: int
+    size: int              # requests in the batch
+    occupancy: float       # size / max_batch_size
+    wait_s: float          # oldest request's queue wait at dispatch
+    n_fallback: int        # out-of-domain requests → exact pipeline
+    seconds: float         # evaluation wall time
+
+
+@dataclass
+class ServeStats:
+    """Per-batch record of a serving session (same shape as
+    :class:`CompactionStats`: record rows, collapse to a summary for
+    bench JSON / event logs).  ``occupancy`` is the quantity dynamic
+    batching exists to maximize; ``fallback_rate`` is the fraction of
+    traffic the emulator could not absorb — a rising rate means the
+    artifact's box no longer covers the query distribution."""
+
+    rows: List[ServeBatch] = field(default_factory=list)
+
+    def record_batch(self, **kw: Any) -> None:
+        self.rows.append(ServeBatch(**kw))
+
+    @property
+    def n_batches(self) -> int:
+        return len(self.rows)
+
+    def summary(self) -> Dict[str, Any]:
+        requests = sum(r.size for r in self.rows)
+        fallbacks = sum(r.n_fallback for r in self.rows)
+        return {
+            "batches": self.n_batches,
+            "requests": requests,
+            "fallbacks": fallbacks,
+            "fallback_rate": round(fallbacks / requests, 4) if requests else 0.0,
+            "mean_batch": round(requests / self.n_batches, 2) if self.rows else 0.0,
+            "mean_occupancy": (
+                round(sum(r.occupancy for r in self.rows) / self.n_batches, 4)
+                if self.rows else 0.0
+            ),
+            "max_wait_s": (
+                round(max(r.wait_s for r in self.rows), 6) if self.rows else 0.0
+            ),
+            "seconds": round(sum(r.seconds for r in self.rows), 4),
+        }
+
+    def as_rows(self) -> List[Dict[str, Any]]:
+        """The per-batch records as plain dicts (event logs, JSON)."""
+        return [dataclasses.asdict(r) for r in self.rows]
+
+
 @contextlib.contextmanager
 def trace(trace_dir: str | None):
     """Wrap a region in a jax.profiler trace (viewable in TensorBoard /

@@ -100,6 +100,49 @@ class GateFailure(ValueError):
     ValueErrors from misconfigured grids."""
 
 
+def relative_errors(got: np.ndarray, ref: np.ndarray) -> np.ndarray:
+    """Per-point relative error with the gate's zero-reference rule.
+
+    The shared scoring primitive behind every accuracy comparison in the
+    repo (:func:`population_max_rel` documents the rationale; the
+    emulator's refinement loop consumes the per-point values): where
+    ``ref != 0`` the error is ``|got/ref − 1|``; where ``ref == 0`` the
+    point is held to an ABSOLUTE tolerance scaled to the median nonzero
+    ``|ref|`` (ADVICE r5 — max|ref| would hand zero-reference points a
+    tolerance ~10 decades above the typical output scale), expressed
+    here as the pseudo-relative error ``|got| / median(|ref[nz]|)`` so
+    one ``errs <= tol`` threshold applies the rel and abs rules at once.
+    Non-finite ``got`` raises :class:`GateFailure` — a NaN must surface
+    as a failure, never rank as a small error.
+    """
+    got = np.asarray(got, dtype=np.float64)
+    ref = np.asarray(ref, dtype=np.float64)
+    bad = ~np.isfinite(got)
+    if bad.any():
+        raise GateFailure(
+            f"{int(bad.sum())}/{got.size} non-finite values under comparison"
+        )
+    bad_ref = ~np.isfinite(ref)
+    if bad_ref.any():
+        # a non-finite REFERENCE would NaN the scores, and NaN > tol is
+        # False — the comparison would silently pass instead of failing
+        raise GateFailure(
+            f"{int(bad_ref.sum())}/{ref.size} non-finite reference values "
+            "under comparison"
+        )
+    nz = ref != 0.0
+    if not nz.any():
+        raise GateFailure(
+            "comparison reference is identically zero — nothing to compare"
+        )
+    errs = np.empty(ref.shape)
+    errs[nz] = np.abs(got[nz] / ref[nz] - 1.0)
+    if (~nz).any():
+        abs_scale = float(np.median(np.abs(ref[nz])))
+        errs[~nz] = np.abs(got[~nz]) / abs_scale
+    return errs
+
+
 def population_max_rel(run_chunk, chunk: int, ref: np.ndarray) -> float:
     """Max rel err of a chunk-runner over a gate population vs ``ref``.
 
@@ -117,25 +160,18 @@ def population_max_rel(run_chunk, chunk: int, ref: np.ndarray) -> float:
     for lo in range(0, n, int(chunk)):
         hi = min(lo + int(chunk), n)
         got[lo:hi] = np.asarray(run_chunk(lo, hi))[: hi - lo]
-    bad = ~np.isfinite(got)
-    if bad.any():
-        raise GateFailure(
-            f"{int(bad.sum())}/{n} non-finite engine outputs over the "
-            "gate population"
-        )
-    nz = ref != 0.0
-    if not nz.any():
-        raise GateFailure(
-            "gate population reference is identically zero — nothing to "
-            "compare (empty or degenerate population?)"
-        )
+    # scoring through the shared primitive (one home for the rel +
+    # zero-reference rules; it raises on non-finite and all-zero refs).
     # ref==0 points can't contribute a relative error, but silently
     # dropping them would let an engine emit a large finite value at a
-    # zero-reference point and still pass (ADVICE r4).  Hold them to an
-    # absolute tolerance scaled to the MEDIAN nonzero |ref| — the
+    # zero-reference point and still pass (ADVICE r4): they are held to
+    # an absolute tolerance scaled to the MEDIAN nonzero |ref| — the
     # population spans ~15 decades, so max|ref| would hand zero-reference
     # points a tolerance ~10 decades above the typical output scale and
-    # let a grossly wrong engine value slip through (ADVICE r5).
+    # let a grossly wrong engine value slip through (ADVICE r5).  The
+    # gate's 1e-6 contract applies to their pseudo-relative scores.
+    errs = relative_errors(got, ref)
+    nz = ref != 0.0
     n_zero = int(n - nz.sum())
     if n_zero:
         abs_tol = 1e-6 * float(np.median(np.abs(ref[nz])))
@@ -153,7 +189,7 @@ def population_max_rel(run_chunk, chunk: int, ref: np.ndarray) -> float:
             f"{abs_tol:.3e} (max {worst:.3e}); excluded from max-rel",
             file=sys.stderr, flush=True,
         )
-    return float(np.max(np.abs(got[nz] / ref[nz] - 1.0)))
+    return float(np.max(errs[nz]))
 
 
 def engine_population_max_rel(

@@ -17,6 +17,9 @@ else
          "(pip install ruff to enable)" >&2
 fi
 
+# the whole package tree, including the emulator + serve layers (their
+# jitted query kernel / batcher hot path are prime R1/R3 surfaces —
+# tests/test_lint.py additionally pins those two packages per-file)
 echo "[lint] python -m bdlz_tpu.lint bdlz_tpu/"
 python -m bdlz_tpu.lint bdlz_tpu/ || rc=1
 

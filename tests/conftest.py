@@ -51,6 +51,40 @@ import pytest  # noqa: E402
 
 
 @pytest.fixture(scope="session")
+def tiny_emulator(tmp_path_factory):
+    """A tiny 3-axis (3 initial nodes per axis) emulator artifact.
+
+    Narrow box around the archived benchmark point, n_y=400, built AND
+    saved once per session — tier-1 exercises build→save→load→query plus
+    a real refinement pass (the lin-scale v_w axis carries genuine
+    log-curvature the build must split; the two log axes are power-law
+    exact) without the slow full-box build, which is a `slow` test.
+    Returns (base_config, artifact_dir, artifact, report).
+    """
+    from bdlz_tpu.config import config_from_dict
+    from bdlz_tpu.emulator import AxisSpec, build_emulator
+
+    base = config_from_dict({
+        "regime": "nonthermal",
+        "P_chi_to_B": 0.14925839040304145,
+        "source_shape_sigma_y": 9.0,
+        "incident_flux_scale": 1.07e-9,
+        "Y_chi_init": 4.90e-10,
+    })
+    spec = {
+        "m_chi_GeV": AxisSpec(0.9, 1.1, 3, "log"),
+        "T_p_GeV": AxisSpec(90.0, 110.0, 3, "log"),
+        "v_w": AxisSpec(0.25, 0.35, 3, "lin"),
+    }
+    out_dir = str(tmp_path_factory.mktemp("emu") / "artifact_dir")
+    artifact, report = build_emulator(
+        base, spec, rtol=1e-4, n_probe=8, n_holdout=24, max_rounds=6,
+        n_y=400, chunk_size=64, out_dir=out_dir, require_converged=True,
+    )
+    return base, out_dir, artifact, report
+
+
+@pytest.fixture(scope="session")
 def benchmark_config_path(tmp_path_factory):
     """A copy of the archived benchmark config (equal-mass point)."""
     import json

@@ -29,6 +29,10 @@ def test_bench_cpu_smoke():
         BDLZ_BENCH_ODE_POINTS="16",
         BDLZ_BENCH_LZ_POINTS="256",
         BDLZ_BENCH_LZ_TABLE_N="256",
+        # small emulator leg: the box still exercises real refinement
+        # (sigma_y), but queries/exact-sample sizes stay smoke-sized
+        BDLZ_BENCH_EMU_QUERIES="2048",
+        BDLZ_BENCH_EMU_EXACT_POINTS="64",
         PYTHONPATH=REPO,
     )
     out = subprocess.run(
@@ -56,7 +60,31 @@ def test_bench_cpu_smoke():
     names = {s["metric"] for s in secondary}
     assert {"esdirk_sweep_points_per_sec_per_chip",
             "lz_sweep_points_per_sec_per_chip",
-            "lz_coherent_sweep_points_per_sec_per_chip"} <= names
+            "lz_coherent_sweep_points_per_sec_per_chip",
+            "emulator_query_points_per_sec"} <= names
+    # the emulator metric schema round-trips: secondary line fields and
+    # the main JSON's "emulator" summary must agree, the build must hit
+    # its default tolerance on the held-out set, and batched queries
+    # must beat the exact per-point path by >= 100x (the serving claim)
+    emu = next(s for s in secondary
+               if s["metric"] == "emulator_query_points_per_sec")
+    assert {"value", "build_seconds", "refinement_rounds", "n_exact_evals",
+            "grid_points", "rtol_target", "max_rel_err", "spot_rel_err",
+            "converged", "exact_points_per_sec", "vs_exact",
+            "platform"} <= set(emu)
+    assert emu["converged"] is True
+    assert emu["max_rel_err"] <= emu["rtol_target"] == 1e-4
+    assert emu["spot_rel_err"] <= 1e-4      # independent of the build's gate
+    assert emu["refinement_rounds"] >= 2    # the adaptive loop actually ran
+    assert emu["vs_exact"] >= 100
+    assert d["emulator"] == {
+        "build_seconds": emu["build_seconds"],
+        "refinement_rounds": emu["refinement_rounds"],
+        "max_rel_err": emu["max_rel_err"],
+        "converged": emu["converged"],
+        "vs_exact": emu["vs_exact"],
+        "query_points_per_sec": emu["value"],
+    }
     for s in secondary:
         assert s["platform"] == "cpu"
         assert "tpu_unavailable" in s

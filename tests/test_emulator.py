@@ -1,0 +1,378 @@
+"""Yield-surface emulator tests (bdlz_tpu/emulator/).
+
+Tier-1 pins, via the tiny session fixture (3 initial nodes per axis,
+narrow box, n_y=400):
+
+* build→save→load→query round-trips, with the refinement loop actually
+  exercised (the lin-scale v_w axis must be split) and the held-out
+  error inside the fixture's 1e-4 tolerance;
+* every staleness/corruption path rejects LOUDLY with
+  ``EmulatorArtifactError``: schema-version skew, content-hash mismatch
+  (tampered knobs), NaN/inf and non-positive table cells;
+* the emulator-backed MCMC fast mode agrees with the exact logp and
+  enforces its preconditions;
+* manifest writes across the repo are atomic (shared utils helper).
+
+The wide-box build with heavy refinement is `slow`.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+from bdlz_tpu.config import config_from_dict, static_choices_from_config
+from bdlz_tpu.emulator import (
+    AxisSpec,
+    EmulatorArtifactError,
+    EmulatorBuildError,
+    artifact_hash,
+    build_emulator,
+    load_artifact,
+    make_domain_fn,
+    make_exact_evaluator,
+    make_query_fn,
+    save_artifact,
+)
+from bdlz_tpu.validation import GateFailure, relative_errors
+
+
+def _corrupt_field(src_dir, dst_dir, mutate, rehash=True):
+    """Copy an artifact dir, mutate one value cell, optionally re-hash.
+
+    ``rehash=True`` keeps the manifest hash CONSISTENT with the
+    corrupted table, so the load failure isolates the finiteness/
+    positivity check; ``rehash=False`` exercises the hash check itself.
+    """
+    art = load_artifact(src_dir)
+    values = {k: np.array(v) for k, v in art.values.items()}
+    mutate(values)
+    os.makedirs(dst_dir, exist_ok=True)
+    arrays = {f"axis_{n}": np.asarray(a) for n, a in
+              zip(art.axis_names, art.axis_nodes)}
+    arrays.update({f"field_{n}": v for n, v in values.items()})
+    np.savez(os.path.join(dst_dir, "artifact.npz"), **arrays)
+    manifest = dict(art.manifest)
+    if rehash:
+        manifest["hash"] = artifact_hash(
+            art.axis_names, art.axis_nodes, art.axis_scales, values,
+            art.identity,
+        )
+    with open(os.path.join(dst_dir, "manifest.json"), "w") as f:
+        json.dump(manifest, f)
+    return dst_dir
+
+
+class TestBuildAndQuery:
+    def test_fixture_converged_within_tolerance(self, tiny_emulator):
+        _, _, artifact, report = tiny_emulator
+        assert report.converged
+        # the acceptance tolerance, measured on a HELD-OUT random set
+        assert report.max_rel_err <= 1e-4
+        assert artifact.manifest["max_rel_err"] == report.max_rel_err
+        assert artifact.manifest["converged"] is True
+
+    def test_refinement_actually_ran(self, tiny_emulator):
+        _, _, artifact, report = tiny_emulator
+        # the lin-scale v_w axis carries real log-curvature: the build
+        # must have split it past its 3 initial nodes, while the two
+        # power-law log axes stay untouched
+        assert report.axis_nodes["v_w"] > 3
+        assert report.axis_nodes["m_chi_GeV"] == 3
+        assert len(report.rounds) >= 2
+
+    def test_save_load_query_round_trip(self, tiny_emulator):
+        base, out_dir, artifact, _ = tiny_emulator
+        loaded = load_artifact(out_dir)
+        assert loaded.axis_names == artifact.axis_names
+        assert loaded.axis_scales == artifact.axis_scales
+        for f in artifact.values:
+            np.testing.assert_array_equal(
+                loaded.values[f], artifact.values[f]
+            )
+        # queries at the grid nodes reproduce the stored values exactly
+        # (interpolation weights collapse onto one corner)
+        nodes = loaded.axis_nodes
+        corners = np.stack([
+            [nodes[0][0], nodes[1][0], nodes[2][0]],
+            [nodes[0][-1], nodes[1][-1], nodes[2][-1]],
+            [nodes[0][1], nodes[1][1], nodes[2][1]],
+        ])
+        got = np.asarray(make_query_fn(loaded)(corners))
+        want = [
+            loaded.values["DM_over_B"][0, 0, 0],
+            loaded.values["DM_over_B"][-1, -1, -1],
+            loaded.values["DM_over_B"][1, 1, 1],
+        ]
+        np.testing.assert_allclose(got, want, rtol=1e-12)
+
+    def test_query_matches_exact_at_random_points(self, tiny_emulator):
+        base, out_dir, _, report = tiny_emulator
+        loaded = load_artifact(out_dir)
+        rng = np.random.default_rng(123)   # not the build's seeds
+        n = 16
+        thetas = np.stack([
+            rng.uniform(0.9, 1.1, n),
+            rng.uniform(90.0, 110.0, n),
+            rng.uniform(0.25, 0.35, n),
+        ], axis=1)
+        emu = np.asarray(make_query_fn(loaded)(thetas))
+        exact = make_exact_evaluator(
+            base, static_choices_from_config(base),
+            n_y=loaded.identity["n_y"], impl=loaded.identity["impl"],
+            chunk_size=n,
+        )({"m_chi_GeV": thetas[:, 0], "T_p_GeV": thetas[:, 1],
+           "v_w": thetas[:, 2]})["DM_over_B"]
+        errs = relative_errors(emu, exact)
+        # fresh random points obey the same tolerance the held-out set
+        # was scored at (generalization, not memorization)
+        assert float(errs.max()) <= 1e-4
+
+    def test_domain_fn(self, tiny_emulator):
+        _, out_dir, _, _ = tiny_emulator
+        loaded = load_artifact(out_dir)
+        dom = make_domain_fn(loaded)
+        inside = np.array([[1.0, 100.0, 0.30]])
+        outside = np.array([[1.0, 100.0, 0.90], [5.0, 100.0, 0.30]])
+        assert bool(np.asarray(dom(inside))[0])
+        assert not np.asarray(dom(outside)).any()
+
+    def test_build_rejects_bad_specs(self, tiny_emulator):
+        base = tiny_emulator[0]
+        with pytest.raises(EmulatorBuildError, match="unknown emulator axes"):
+            build_emulator(base, {"bogus": AxisSpec(0.0, 1.0)})
+        with pytest.raises(EmulatorBuildError, match="at least one axis"):
+            build_emulator(base, {})
+        with pytest.raises(EmulatorBuildError, match=">= 2 initial nodes"):
+            build_emulator(base, {"v_w": AxisSpec(0.1, 0.9, 1)})
+        with pytest.raises(EmulatorBuildError, match="scale"):
+            build_emulator(base, {"v_w": AxisSpec(0.1, 0.9, 3, "cubic")})
+        with pytest.raises(EmulatorBuildError, match="lo > 0"):
+            build_emulator(base, {"v_w": AxisSpec(-0.1, 0.9, 3, "log")})
+
+
+class TestArtifactRejection:
+    def test_nan_cell_rejected_at_load(self, tiny_emulator, tmp_path):
+        _, out_dir, _, _ = tiny_emulator
+
+        def poison(values):
+            values["DM_over_B"][1, 1, 1] = np.nan
+
+        bad = _corrupt_field(out_dir, str(tmp_path / "nan"), poison)
+        with pytest.raises(EmulatorArtifactError, match="non-finite"):
+            load_artifact(bad)
+
+    def test_nonpositive_cell_rejected_at_load(self, tiny_emulator, tmp_path):
+        _, out_dir, _, _ = tiny_emulator
+
+        def poison(values):
+            values["Y_B"][0, 0, 0] = -1.0
+
+        bad = _corrupt_field(out_dir, str(tmp_path / "neg"), poison)
+        with pytest.raises(EmulatorArtifactError, match="non-positive"):
+            load_artifact(bad)
+
+    def test_tampered_table_fails_hash(self, tiny_emulator, tmp_path):
+        _, out_dir, _, _ = tiny_emulator
+
+        def poison(values):
+            values["DM_over_B"][0, 0, 0] *= 1.5
+
+        bad = _corrupt_field(
+            out_dir, str(tmp_path / "tamper"), poison, rehash=False
+        )
+        with pytest.raises(EmulatorArtifactError, match="content-hash"):
+            load_artifact(bad)
+
+    def test_changed_knobs_fail_hash(self, tiny_emulator, tmp_path):
+        """The satellite case: identity knobs edited after the build."""
+        _, out_dir, _, _ = tiny_emulator
+        dst = str(tmp_path / "knobs")
+        os.makedirs(dst)
+        import shutil
+
+        shutil.copy(os.path.join(out_dir, "artifact.npz"), dst)
+        with open(os.path.join(out_dir, "manifest.json")) as f:
+            manifest = json.load(f)
+        manifest["identity"]["n_y"] = 8000   # pretend a finer build
+        with open(os.path.join(dst, "manifest.json"), "w") as f:
+            json.dump(manifest, f)
+        with pytest.raises(EmulatorArtifactError, match="content-hash"):
+            load_artifact(dst)
+
+    def test_schema_version_skew_rejected(self, tiny_emulator, tmp_path):
+        _, out_dir, _, _ = tiny_emulator
+        dst = str(tmp_path / "schema")
+        os.makedirs(dst)
+        import shutil
+
+        shutil.copy(os.path.join(out_dir, "artifact.npz"), dst)
+        with open(os.path.join(out_dir, "manifest.json")) as f:
+            manifest = json.load(f)
+        manifest["schema_version"] += 1
+        with open(os.path.join(dst, "manifest.json"), "w") as f:
+            json.dump(manifest, f)
+        with pytest.raises(EmulatorArtifactError, match="schema_version"):
+            load_artifact(dst)
+
+    def test_missing_dir_rejected(self, tmp_path):
+        with pytest.raises(EmulatorArtifactError, match="cannot read"):
+            load_artifact(str(tmp_path / "nope"))
+
+    def test_save_rejects_nan_table(self, tiny_emulator, tmp_path):
+        _, out_dir, _, _ = tiny_emulator
+        art = load_artifact(out_dir)
+        values = {k: np.array(v) for k, v in art.values.items()}
+        values["Y_chi"][0, 0, 0] = np.inf
+        bad = art._replace(values=values)
+        with pytest.raises(EmulatorArtifactError, match="non-finite"):
+            save_artifact(str(tmp_path / "save_nan"), bad)
+
+
+class TestEmulatorLogprob:
+    def test_fast_mode_matches_exact_logp(self, tiny_emulator):
+        import jax.numpy as jnp
+
+        from bdlz_tpu.ops.kjma_table import make_f_table
+        from bdlz_tpu.sampling.likelihoods import make_pipeline_logprob
+
+        base, out_dir, _, _ = tiny_emulator
+        static = static_choices_from_config(base)
+        loaded = load_artifact(out_dir)
+        table = make_f_table(base.I_p, jnp)
+        keys = ("m_chi_GeV", "v_w")
+        n_y = int(loaded.identity["n_y"])
+        lp_exact = make_pipeline_logprob(
+            base, static, table, param_keys=keys, n_y=n_y
+        )
+        lp_emu = make_pipeline_logprob(
+            base, static, None, param_keys=keys, emulator=loaded
+        )
+        for th in ([0.95, 0.30], [1.05, 0.27], [1.0, 0.34]):
+            a = float(lp_exact(jnp.asarray(th)))
+            b = float(lp_emu(jnp.asarray(th)))
+            # logp error ~ curvature-amplified surface rel-err; at the
+            # fixture tolerance the two posteriors agree to ~1e-3 rel
+            assert abs(a - b) <= 1e-3 * max(abs(a), 1.0), (th, a, b)
+
+    def test_fast_mode_vmaps_and_scores_ood_minus_inf(self, tiny_emulator):
+        import jax
+        import jax.numpy as jnp
+
+        from bdlz_tpu.sampling.likelihoods import make_pipeline_logprob
+
+        base, out_dir, _, _ = tiny_emulator
+        static = static_choices_from_config(base)
+        lp = make_pipeline_logprob(
+            base, static, None, param_keys=("m_chi_GeV", "v_w"),
+            emulator=load_artifact(out_dir),
+        )
+        vals = np.asarray(jax.jit(jax.vmap(lp))(jnp.asarray(
+            [[0.95, 0.30], [5.0, 0.30], [1.0, 0.99]]
+        )))
+        assert np.isfinite(vals[0])
+        assert vals[1] == -np.inf and vals[2] == -np.inf
+
+    def test_fast_mode_preconditions(self, tiny_emulator):
+        from bdlz_tpu.sampling.likelihoods import make_pipeline_logprob
+
+        base, out_dir, _, _ = tiny_emulator
+        static = static_choices_from_config(base)
+        loaded = load_artifact(out_dir)
+        with pytest.raises(ValueError, match="not axes of the emulator"):
+            make_pipeline_logprob(
+                base, static, None, param_keys=("beta_over_H",),
+                emulator=loaded,
+            )
+        with pytest.raises(ValueError, match="mutually exclusive"):
+            make_pipeline_logprob(
+                base, static, None, param_keys=("v_w",),
+                emulator=loaded, lz_lambda1=0.01,
+            )
+
+    def test_stale_artifact_rejected(self, tiny_emulator):
+        import dataclasses
+
+        from bdlz_tpu.sampling.likelihoods import make_pipeline_logprob
+
+        base, out_dir, _, _ = tiny_emulator
+        base2 = dataclasses.replace(base, source_shape_sigma_y=10.0)
+        with pytest.raises(EmulatorArtifactError, match="identity mismatch"):
+            make_pipeline_logprob(
+                base2, static_choices_from_config(base2), None,
+                param_keys=("m_chi_GeV", "v_w"),
+                emulator=load_artifact(out_dir),
+            )
+
+
+class TestSharedHelpers:
+    def test_relative_errors_zero_reference_rule(self):
+        ref = np.array([1.0, 2.0, 0.0, 4.0])
+        got = np.array([1.0, 2.2, 0.4, 4.0])
+        errs = relative_errors(got, ref)
+        np.testing.assert_allclose(errs[[0, 1, 3]], [0.0, 0.1, 0.0])
+        # zero-ref point held to the median-nonzero scale (median = 2)
+        assert errs[2] == pytest.approx(0.4 / 2.0)
+        with pytest.raises(GateFailure, match="non-finite"):
+            relative_errors(np.array([np.nan]), np.array([1.0]))
+        with pytest.raises(GateFailure, match="identically zero"):
+            relative_errors(np.array([1.0]), np.array([0.0]))
+
+    def test_atomic_write_json(self, tmp_path):
+        from bdlz_tpu.utils.io import atomic_write_json
+
+        path = str(tmp_path / "m.json")
+        atomic_write_json(path, {"a": 1})
+        atomic_write_json(path, {"a": 2})
+        with open(path) as f:
+            assert json.load(f) == {"a": 2}
+        # no temp droppings left next to the manifest
+        assert sorted(os.listdir(tmp_path)) == ["m.json"]
+        # unserializable payload: loud error, target untouched, no tmp
+        with pytest.raises(TypeError):
+            atomic_write_json(path, {"a": object()})
+        with open(path) as f:
+            assert json.load(f) == {"a": 2}
+        assert sorted(os.listdir(tmp_path)) == ["m.json"]
+
+    def test_sweep_and_checkpoint_manifests_use_atomic_writes(self):
+        """The two satellite call sites must go through the helper —
+        a direct json.dump into a manifest path is the torn-write bug
+        this PR removes."""
+        import inspect
+
+        from bdlz_tpu.parallel import sweep
+        from bdlz_tpu.sampling import checkpoint
+
+        for mod in (sweep, checkpoint):
+            src = inspect.getsource(mod)
+            assert "atomic_write_json" in src, mod.__name__
+            assert 'open(manifest_path, "w")' not in src, mod.__name__
+
+
+@pytest.mark.slow
+def test_full_build_wide_box_converges():
+    """The wide-box build with heavy sigma_y refinement (the bench box);
+    kept out of tier-1 — ~10 s of exact sweeps on CPU."""
+    base = config_from_dict({
+        "regime": "nonthermal",
+        "P_chi_to_B": 0.14925839040304145,
+        "source_shape_sigma_y": 9.0,
+        "incident_flux_scale": 1.07e-9,
+        "Y_chi_init": 4.90e-10,
+    })
+    spec = {
+        "m_chi_GeV": AxisSpec(0.1, 10.0, 3, "log"),
+        "T_p_GeV": AxisSpec(30.0, 300.0, 5, "log"),
+        "source_shape_sigma_y": AxisSpec(3.0, 18.0, 5, "lin"),
+        "beta_over_H": AxisSpec(50.0, 500.0, 5, "log"),
+    }
+    artifact, report = build_emulator(
+        base, spec, rtol=1e-4, n_probe=48, max_rounds=40, n_y=2000,
+        chunk_size=512, require_converged=True,
+    )
+    assert report.converged and report.max_rel_err <= 1e-4
+    # the curved axis was refined far past its 5 seed nodes; the
+    # power-law axes were not
+    assert report.axis_nodes["source_shape_sigma_y"] > 50
+    assert report.axis_nodes["m_chi_GeV"] == 3

@@ -124,3 +124,17 @@ def test_lint_sh_clean_including_batching_engine():
     ])
     offenders = "\n".join(f.render() for f in report.active)
     assert not report.active, f"stiff-engine findings:\n{offenders}"
+
+
+def test_emulator_and_serve_packages_clean():
+    """The emulator's jitted query kernel is a prime R1/R3 surface (host
+    np in a jit-reachable interpolation, device syncs in the batcher hot
+    path) — pinned per-package like the stiff engine, not only via the
+    package-wide sweep."""
+    report = lint_paths([
+        str(PACKAGE / "emulator"),
+        str(PACKAGE / "serve"),
+    ])
+    assert report.files_scanned >= 9
+    offenders = "\n".join(f.render() for f in report.active)
+    assert not report.active, f"emulator/serve findings:\n{offenders}"

@@ -1,0 +1,258 @@
+"""Serving-layer tests (bdlz_tpu/serve/).
+
+The batcher's dispatch policy is tested with a FAKE CLOCK and direct
+``run_once`` calls — no sleeping, no background threads in tier-1 (the
+threaded loop is the CLI's; the policy is what has behavior worth
+pinning).  The service tests ride the tiny session emulator fixture.
+"""
+import json
+
+import numpy as np
+import pytest
+
+from bdlz_tpu.emulator import load_artifact
+from bdlz_tpu.serve import BatchResult, MicroBatcher, YieldService
+from bdlz_tpu.utils.profiling import ServeStats
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _echo_batcher(max_batch_size=4, max_wait_s=0.010, process=None):
+    clock = FakeClock()
+    calls = []
+
+    def default_process(thetas):
+        calls.append(np.array(thetas))
+        return BatchResult(values=[float(t[0]) for t in thetas],
+                           n_fallback=0)
+
+    mb = MicroBatcher(
+        process or default_process,
+        max_batch_size=max_batch_size,
+        max_wait_s=max_wait_s,
+        clock=clock,
+        stats=ServeStats(),
+    )
+    return mb, clock, calls
+
+
+class TestMicroBatcherPolicy:
+    def test_partial_batch_waits_for_max_wait(self):
+        mb, clock, calls = _echo_batcher()
+        futs = [mb.submit([float(i)]) for i in range(3)]
+        # under max_batch and under max_wait: the policy holds
+        assert not mb.ready_at()
+        assert mb.run_once() == 0 and not calls
+        # the latency bound: oldest request's age crosses max_wait
+        clock.advance(0.011)
+        assert mb.ready_at()
+        assert mb.run_once() == 3
+        assert [f.result(timeout=0) for f in futs] == [0.0, 1.0, 2.0]
+        assert mb.pending() == 0
+
+    def test_full_batch_dispatches_immediately(self):
+        mb, clock, calls = _echo_batcher(max_batch_size=4)
+        futs = [mb.submit([float(i)]) for i in range(4)]
+        assert mb.ready_at()          # no clock advance needed
+        assert mb.run_once() == 4
+        assert len(calls) == 1 and calls[0].shape == (4, 1)
+        assert [f.result(timeout=0) for f in futs] == [0.0, 1.0, 2.0, 3.0]
+
+    def test_overfull_queue_dispatches_in_batch_size_chunks(self):
+        mb, clock, _ = _echo_batcher(max_batch_size=4)
+        futs = [mb.submit([float(i)]) for i in range(10)]
+        assert mb.run_once() == 4
+        assert mb.run_once() == 4
+        # tail is a partial batch: waits for age, or force-drains
+        assert mb.run_once() == 0
+        assert mb.run_once(force=True) == 2
+        assert [f.result(timeout=0) for f in futs] == [float(i) for i in range(10)]
+
+    def test_stats_rows(self):
+        mb, clock, _ = _echo_batcher(max_batch_size=4)
+        for i in range(4):
+            mb.submit([float(i)])
+        mb.run_once()
+        mb.submit([9.0])
+        clock.advance(0.02)
+        mb.run_once()
+        rows = mb.stats.as_rows()
+        assert [r["size"] for r in rows] == [4, 1]
+        assert rows[0]["occupancy"] == 1.0
+        assert rows[1]["occupancy"] == 0.25
+        assert rows[1]["wait_s"] == pytest.approx(0.02)
+        s = mb.stats.summary()
+        assert s["batches"] == 2 and s["requests"] == 5
+        assert s["mean_occupancy"] == pytest.approx(0.625)
+
+    def test_process_failure_delivered_per_request_queue_survives(self):
+        boom = RuntimeError("kernel exploded")
+
+        def bad_then_good(thetas):
+            if bad_then_good.fail:
+                bad_then_good.fail = False
+                raise boom
+            return [float(t[0]) for t in thetas]
+
+        bad_then_good.fail = True
+        mb, clock, _ = _echo_batcher(max_batch_size=2, process=bad_then_good)
+        f1, f2 = mb.submit([1.0]), mb.submit([2.0])
+        assert mb.run_once() == 2
+        with pytest.raises(RuntimeError, match="kernel exploded"):
+            f1.result(timeout=0)
+        with pytest.raises(RuntimeError, match="kernel exploded"):
+            f2.result(timeout=0)
+        # the queue is not wedged: the next batch serves normally
+        f3 = mb.submit([3.0])
+        clock.advance(1.0)
+        assert mb.run_once() == 1
+        assert f3.result(timeout=0) == 3.0
+
+    def test_ragged_batch_is_delivered_not_fatal(self):
+        """Mixed request dimensions make np.stack raise INSIDE the
+        dispatch: the failure must land on the batch's futures, not
+        escape and kill the background loop (which would hang every
+        pending result() forever)."""
+        mb, clock, _ = _echo_batcher(max_batch_size=2)
+        f1, f2 = mb.submit([1.0, 2.0]), mb.submit([1.0])
+        assert mb.run_once() == 2
+        for f in (f1, f2):
+            with pytest.raises(ValueError):
+                f.result(timeout=0)
+        f3 = mb.submit([3.0])
+        clock.advance(1.0)
+        assert mb.run_once() == 1
+        assert f3.result(timeout=0) == 3.0
+
+    def test_wrong_length_result_is_an_error_not_a_hang(self):
+        mb, clock, _ = _echo_batcher(
+            max_batch_size=2, process=lambda thetas: [1.0]
+        )
+        f1, f2 = mb.submit([1.0]), mb.submit([2.0])
+        mb.run_once()
+        for f in (f1, f2):
+            with pytest.raises(RuntimeError, match="returned 1 values"):
+                f.result(timeout=0)
+
+    def test_knob_validation(self):
+        with pytest.raises(ValueError, match="max_batch_size"):
+            MicroBatcher(lambda t: [], max_batch_size=0)
+        with pytest.raises(ValueError, match="max_wait_s"):
+            MicroBatcher(lambda t: [], max_wait_s=-1.0)
+
+
+class TestYieldService:
+    def test_out_of_domain_falls_back_to_exact(self, tiny_emulator):
+        base, out_dir, _, _ = tiny_emulator
+        svc = YieldService(load_artifact(out_dir), base, max_batch_size=8)
+        thetas = np.array([
+            [1.0, 100.0, 0.30],    # inside
+            [1.0, 100.0, 0.60],    # v_w outside the tiny box
+            [0.95, 95.0, 0.28],    # inside
+        ])
+        values, n_fallback = svc.evaluate(thetas)
+        assert n_fallback == 1
+        assert np.isfinite(values).all()
+        # the fallback answered with the EXACT pipeline, not a clamped
+        # table edge: compare against the exact evaluator directly
+        from bdlz_tpu.config import static_choices_from_config
+        from bdlz_tpu.emulator import make_exact_evaluator
+
+        art = svc.artifact
+        exact = make_exact_evaluator(
+            base, static_choices_from_config(base),
+            n_y=art.identity["n_y"], impl=art.identity["impl"],
+            chunk_size=8,
+        )({"m_chi_GeV": thetas[1:2, 0], "T_p_GeV": thetas[1:2, 1],
+           "v_w": thetas[1:2, 2]})["DM_over_B"]
+        np.testing.assert_allclose(values[1], exact[0], rtol=1e-12)
+
+    def test_batcher_integration_counts_fallbacks(self, tiny_emulator):
+        base, out_dir, _, _ = tiny_emulator
+        svc = YieldService(load_artifact(out_dir), base, max_batch_size=4)
+        clock = FakeClock()
+        mb = svc.make_batcher(max_wait_s=0.005, clock=clock)
+        futs = [
+            mb.submit([1.0, 100.0, 0.30]),
+            mb.submit([1.0, 100.0, 0.60]),   # out-of-domain
+            mb.submit([0.95, 95.0, 0.28]),
+        ]
+        clock.advance(0.006)
+        assert mb.run_once() == 3
+        assert all(np.isfinite(f.result(timeout=0)) for f in futs)
+        assert svc.stats.summary()["fallbacks"] == 1
+        assert svc.stats.summary()["fallback_rate"] == pytest.approx(
+            1 / 3, abs=1e-4   # summary rounds to 4 decimals
+        )
+
+    def test_query_shape_and_mapping_validation(self, tiny_emulator):
+        base, out_dir, _, _ = tiny_emulator
+        svc = YieldService(load_artifact(out_dir), base, max_batch_size=4)
+        with pytest.raises(ValueError, match="3 coordinates"):
+            svc.evaluate(np.zeros((2, 2)))
+        with pytest.raises(ValueError, match="missing axes"):
+            svc.theta_from_mapping({"m_chi_GeV": 1.0})
+        with pytest.raises(ValueError, match="unknown axes"):
+            svc.theta_from_mapping({
+                "m_chi_GeV": 1.0, "T_p_GeV": 100.0, "v_w": 0.3,
+                "bogus": 1.0,
+            })
+        theta = svc.theta_from_mapping(
+            {"m_chi_GeV": 1.0, "T_p_GeV": 100.0, "v_w": 0.3}
+        )
+        np.testing.assert_allclose(theta, [1.0, 100.0, 0.3])
+
+    def test_stale_physics_rejected_at_construction(self, tiny_emulator):
+        import dataclasses
+
+        from bdlz_tpu.emulator import EmulatorArtifactError
+
+        base, out_dir, _, _ = tiny_emulator
+        base2 = dataclasses.replace(base, incident_flux_scale=2e-9)
+        with pytest.raises(EmulatorArtifactError, match="identity mismatch"):
+            YieldService(load_artifact(out_dir), base2)
+
+
+class TestServeCLI:
+    def test_requests_file_round_trip(self, tiny_emulator, tmp_path, capsys):
+        base, out_dir, _, _ = tiny_emulator
+        cfg = tmp_path / "cfg.json"
+        cfg.write_text(json.dumps({
+            "regime": "nonthermal",
+            "P_chi_to_B": 0.14925839040304145,
+            "source_shape_sigma_y": 9.0,
+            "incident_flux_scale": 1.07e-9,
+            "Y_chi_init": 4.90e-10,
+        }))
+        reqs = tmp_path / "reqs.jsonl"
+        reqs.write_text("\n".join([
+            json.dumps({"id": "a", "m_chi_GeV": 1.0, "T_p_GeV": 100.0,
+                        "v_w": 0.30}),
+            json.dumps({"id": "b", "theta": [0.95, 95.0, 0.33]}),
+            json.dumps({"id": "ood", "m_chi_GeV": 1.0, "T_p_GeV": 100.0,
+                        "v_w": 0.60}),
+        ]) + "\n")
+        from bdlz_tpu.serve.serve_cli import main
+
+        rc = main([
+            "--config", str(cfg), "--artifact", out_dir,
+            "--requests", str(reqs), "--max-batch", "8",
+            "--max-wait-ms", "1",
+        ])
+        assert rc == 0
+        out_lines = [
+            json.loads(line)
+            for line in capsys.readouterr().out.strip().splitlines()
+        ]
+        assert [r["id"] for r in out_lines] == ["a", "b", "ood"]
+        assert all(np.isfinite(r["value"]) for r in out_lines)
+        assert all(r["latency_s"] >= 0 for r in out_lines)
