@@ -174,10 +174,21 @@ def can_use_quadrature(cfg: Config) -> bool:
 
 
 def run_point(cfg: Config, P_used: float, backend: str) -> YieldsResult:
-    """Evaluate one parameter point on the selected backend."""
+    """Evaluate one parameter point on the selected backend.
+
+    The per-point path is bit-pinned: the ``quad_panel_gl`` tri-state
+    resolves ``None`` → the reference trapezoid here (the archived
+    golden outputs are tied to that scheme), so default invocations stay
+    byte-identical.  An EXPLICIT ``quad_panel_gl: true`` (config key or
+    ``--quad on``) opts this point into the snapped-panel
+    Gauss–Legendre rule — the caller asserts convergence, as on the
+    sweep path's forced mode.
+    """
     xp = backend_mod.get_namespace(backend)
     pp = point_params_from_config(cfg, P_used)
     static = static_choices_from_config(cfg)
+    if static.quad_panel_gl is None:
+        static = static._replace(quad_panel_gl=False)  # bit-pinned default
     grid = make_kjma_grid(xp)
 
     if can_use_quadrature(cfg):
@@ -304,6 +315,14 @@ def main(argv: Optional[list] = None) -> None:
                     dest="lz_gamma_phi",
                     help="Diabatic-basis dephasing rate for --lz-method "
                          "dephased (framework addition).")
+    ap.add_argument("--quad", default=None, choices=("on", "off"),
+                    help="Override the config's quad_panel_gl knob for this "
+                         "point (framework addition): on = snapped-panel "
+                         "Gauss-Legendre y-quadrature (solvers/panels.py), "
+                         "off = the reference trapezoid.  Default: the "
+                         "config key; absent keys keep the bit-pinned "
+                         "trapezoid, so reference invocations are "
+                         "byte-identical.")
     ap.add_argument("--sanitize", action="store_true",
                     help="Runtime sanitizer (framework addition): "
                          "jax_debug_nans on the JAX path, finiteness "
@@ -339,6 +358,10 @@ def main(argv: Optional[list] = None) -> None:
         return
 
     cfg = load_config(args.config)
+    if args.quad is not None:
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, quad_panel_gl=args.quad == "on")
     backend = args.backend or cfg.backend
     cfg = validate(cfg, backend=backend)
     if args.sanitize:

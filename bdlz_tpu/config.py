@@ -123,6 +123,15 @@ class Config:
     ode_auto_h0: Optional[bool] = None
     ode_pi_controller: Optional[bool] = None
     ode_tabulated_av: Optional[bool] = None
+    # Spectral panel quadrature for the y-integral (solvers/panels.py):
+    # snapped-panel Gauss-Legendre instead of the 8000-node trapezoid,
+    # ~14x less integrand work at <=1e-9 agreement on audited
+    # populations.  None = "engine decides": run_sweep / the emulator
+    # build turn it ON after their per-population convergence audit
+    # (validation.panel_gl_population_audit) passes, the bit-pinned
+    # per-point paths keep it OFF.  Explicit True/False overrides both
+    # (True skips the audit - the caller asserts convergence).
+    quad_panel_gl: Optional[bool] = None
 
 
 def default_config() -> Dict[str, Any]:
@@ -171,7 +180,7 @@ def write_template(path: str, include_extensions: bool = False) -> None:
 #: *defaults* must also invalidate old checkpoints (omit-at-default
 #: would silently splice results computed at two different settings).
 #: The tri-state engine knobs (ode_auto_h0/ode_pi_controller/
-#: ode_tabulated_av) are NOT listed here because their None default is
+#: ode_tabulated_av/quad_panel_gl) are NOT listed here because their None default is
 #: resolved per-engine — the sweep layer folds the RESOLVED values into
 #: its manifest hash instead (run_sweep's esdirk hash_extra), which pins
 #: the same invariant without invalidating every non-stiff directory.
@@ -261,7 +270,8 @@ def validate(cfg: Config, backend: Optional[str] = None) -> Config:
         )
     if not (cfg.ode_rtol > 0.0 and cfg.ode_atol > 0.0):
         raise ConfigError("ode_rtol and ode_atol must be positive")
-    for k in ("ode_auto_h0", "ode_pi_controller", "ode_tabulated_av"):
+    for k in ("ode_auto_h0", "ode_pi_controller", "ode_tabulated_av",
+              "quad_panel_gl"):
         v = getattr(cfg, k)
         if v is not None and not isinstance(v, bool):
             raise ConfigError(f"{k} must be true, false, or null, got {v!r}")
@@ -311,6 +321,9 @@ class StaticChoices(NamedTuple):
     ode_auto_h0: Optional[bool] = None
     ode_pi_controller: Optional[bool] = None
     ode_tabulated_av: Optional[bool] = None
+    # None = per-engine default: the audited sweep/emulator paths resolve
+    # it (see Config.quad_panel_gl); bit-pinned paths resolve None -> off.
+    quad_panel_gl: Optional[bool] = None
 
 
 def resolve_Y_chi_init(cfg: Config) -> float:
@@ -366,4 +379,5 @@ def static_choices_from_config(cfg: Config) -> StaticChoices:
         ode_auto_h0=cfg.ode_auto_h0,
         ode_pi_controller=cfg.ode_pi_controller,
         ode_tabulated_av=cfg.ode_tabulated_av,
+        quad_panel_gl=cfg.quad_panel_gl,
     )

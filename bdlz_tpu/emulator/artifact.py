@@ -80,15 +80,29 @@ def build_identity(base, static, n_y: int, impl: str) -> Dict[str, Any]:
     not invalidate every existing artifact; resolved StaticChoices;
     n_y; engine) — an emulator is a cache of ``run_sweep`` output and
     must go stale exactly when a sweep directory would.
+
+    The quadrature tri-state is carried as its own ``quad_panel_gl``
+    key, present IFF the caller's static resolves it (True or False) —
+    surfaces computed under different y-quadrature schemes hash (and
+    therefore reject) differently, while a consumer whose static leaves
+    the knob ``None`` emits no key and is expected to ADOPT the
+    artifact's recorded scheme before checking (see
+    :func:`check_identity` / the serve + likelihood layers).  The knob
+    is normalized OUT of the static tuple so this key is its single
+    home in the identity.
     """
     from bdlz_tpu.config import config_identity_dict
 
-    return {
+    quad = static.quad_panel_gl
+    out = {
         "base": config_identity_dict(base),
-        "static": list(tuple(static)),
+        "static": list(tuple(static._replace(quad_panel_gl=None))),
         "n_y": int(n_y),
         "impl": str(impl),
     }
+    if quad is not None:
+        out["quad_panel_gl"] = bool(quad)
+    return out
 
 
 def artifact_hash(
@@ -337,9 +351,19 @@ def check_identity(
     irrelevant because they are artifact AXES (the per-point value
     overrides them) — the likelihood layer uses this so a caller whose
     base config differs only in a swept field is not falsely rejected.
+
+    The ``quad_panel_gl`` key is strict whenever the CALLER states a
+    scheme (an explicit True/False in their static): an artifact built
+    under the other y-quadrature is rejected.  A caller whose
+    expectation carries no key (tri-state ``None`` — "use whatever the
+    artifact used") matches either; such callers must adopt the
+    artifact's recorded scheme for their exact-fallback path, which the
+    serve/likelihood layers do.
     """
     stored = dict(artifact.identity)
     want = dict(expect)
+    if "quad_panel_gl" not in want:
+        stored.pop("quad_panel_gl", None)
     sb = dict(stored.get("base", {}))
     wb = dict(want.get("base", {}))
     for key in set(exempt_config_keys) | set(artifact.axis_names):

@@ -388,6 +388,28 @@ def build_emulator(
     scales: List[str] = [spec[k].scale for k in axis_names]
     rng = np.random.default_rng(seed)
 
+    # Resolve the quadrature tri-state ONCE, over the initial tensor
+    # grid, and pass the explicit bool to EVERY internal sweep (the
+    # initial population, the hyperplane refinements, the probe
+    # evaluator): per-call re-resolution could flip schemes between
+    # hyperplanes, splicing two quadratures into one surface.  The
+    # resolved value joins the artifact identity through the static
+    # (build_identity's quad_panel_gl key), so surfaces built under
+    # different quad schemes can never be confused.
+    from bdlz_tpu.validation import resolve_quad_panel_gl
+
+    audit_grid = None
+    if impl == "tabulated" and static.quad_panel_gl is None:
+        from bdlz_tpu.parallel.sweep import build_grid
+
+        audit_grid = build_grid(
+            base, {k: a for k, a in zip(axis_names, nodes)}, product=True,
+        )
+    quad_on, _ = resolve_quad_panel_gl(
+        audit_grid, static, impl, n_y, label="emulator",
+    )
+    static = static._replace(quad_panel_gl=quad_on)
+
     def grid_shape() -> Tuple[int, ...]:
         return tuple(len(a) for a in nodes)
 

@@ -72,6 +72,13 @@ STATIC_PARAM_NAMES = {
     "regime",
     "impl",
     "scale",  # emulator axis scale ("lin"/"log") — structural by construction
+    # panel-quadrature scheme structure (solvers/panels.py): the node
+    # count / panel count fix array shapes, `scheme` is the host-built
+    # rule object, `tabulated` picks the integrand at trace time
+    "n_nodes",
+    "n_panels",
+    "scheme",
+    "tabulated",
     "n_y",
     "nz",
     "n_mu",

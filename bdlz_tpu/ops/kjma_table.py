@@ -91,6 +91,21 @@ def make_f_table(
     return KJMATable(y0=-Y_CLAMP, inv_dy=1.0 / dy, values=F, I_p=I_p)
 
 
+def table_to_namespace(table: KJMATable, xp) -> KJMATable:
+    """Ship a (host-built) table's VALUES into another array namespace.
+
+    The one sanctioned way to reuse a host-NumPy table on a device
+    backend (the sweep engine and the bench both audit on the host table
+    and run on its device copy): only the dense value array converts —
+    the scalar metadata stays host-side — so the device table is the
+    SAME table, bit-for-bit, not a near-copy from a second build.
+    """
+    return KJMATable(
+        y0=table.y0, inv_dy=table.inv_dy,
+        values=xp.asarray(table.values), I_p=table.I_p,
+    )
+
+
 def _tracer_errors():
     """ONLY the tracer-concretization error types: a genuine failure in
     the host build (bad grid payload, None I_p) must propagate, not

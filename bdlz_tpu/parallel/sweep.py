@@ -293,7 +293,10 @@ def sweep_step(pp_chunk: PointParams, static: StaticChoices, table, mesh=None, n
     return step(pp_chunk, table)
 
 
-def _clamp_chunk_to_memory(chunk_size: int, n_y: int, mesh, impl: str) -> int:
+def _clamp_chunk_to_memory(
+    chunk_size: int, n_y: int, mesh, impl: str,
+    quad_nodes: "int | None" = None, double_buffer: bool = False,
+) -> int:
     """Clamp the per-chunk batch so the chunk's temporaries fit device HBM.
 
     An OOM'd TPU compile doesn't just fail the sweep — it has been
@@ -305,12 +308,23 @@ def _clamp_chunk_to_memory(chunk_size: int, n_y: int, mesh, impl: str) -> int:
       8000 nodes fits a 16 GB v5e; 16384 × 8000 needs ~20 GB and OOMs,
       i.e. ~1.2 MB/point ≈ 20 live f64 (n_y,)-buffers per point), so at
       the bench shapes 8192 passes untouched and 16384 clamps;
+    * tabulated with the panel-GL quadrature (``quad_nodes`` set) — the
+      same ~20 live f64 node-buffers per point, but over the scheme's
+      ``n_panels·n_nodes`` nodes instead of n_y (~14× smaller at the
+      defaults — the quadrature win is a memory win too);
     * direct — the per-point (n_y × nz=1200) KJMA integrand dominates
       (~3 live copies through the two trapezoid reductions), ~60× the
       tabulated footprint;
     * esdirk — no n_y grid at all; the RHS's (nz,) z-integral temporaries
       per lane per Newton stage, ~a few hundred KB/point, modelled
       generously.
+
+    ``double_buffer``: the overlapped chunk loop keeps TWO chunks'
+    transfer/result buffers resident at once (the next chunk's sharded
+    inputs are enqueued while the current one computes), so the per-point
+    cost gains one extra set of input+output rows (22 f64 fields).  The
+    compute working set is NOT doubled — the device executes chunks
+    serially — so the headroom term is the IO footprint only.
 
     Applies only on accelerator platforms; host CPU runs (tests,
     reference parity) are never clamped.  ``BDLZ_CHUNK_BYTES_BUDGET``
@@ -330,8 +344,15 @@ def _clamp_chunk_to_memory(chunk_size: int, n_y: int, mesh, impl: str) -> int:
         per_point_bytes = 3 * max(int(n_y), 1) * nz * 8
     elif impl in ("esdirk", "esdirk_lockstep"):
         per_point_bytes = 32 * nz * 8
+    elif quad_nodes:  # tabulated fast path on the panel-GL scheme
+        per_point_bytes = 20 * max(int(quad_nodes), 1) * 8
     else:  # tabulated / pallas fast paths
         per_point_bytes = 20 * max(int(n_y), 1) * 8
+    if double_buffer:
+        # one extra chunk's input (17 PointParams fields) + output (5
+        # YieldsResult fields) rows in flight while the current chunk
+        # computes
+        per_point_bytes += (len(PointParams._fields) + 5) * 8
     max_per_dev = max(budget // per_point_bytes, 1)
     max_chunk = max_per_dev * n_dev
     if chunk_size > max_chunk:
@@ -442,6 +463,23 @@ def resolve_pallas_tier(
     return None, "; ".join(msgs)
 
 
+def _resolved_quad_nodes(static: StaticChoices, impl: str) -> "int | None":
+    """Node count of the panel-GL scheme when it is what will run, else None.
+
+    Only the tabulated engine implements the panel quadrature; the
+    tri-state must already be resolved (True) by the caller for this to
+    report a count — an unresolved None means the bit-pinned trapezoid.
+    """
+    if impl == "tabulated" and static.quad_panel_gl:
+        from bdlz_tpu.solvers.panels import (
+            N_PANELS_DEFAULT,
+            NODES_PER_PANEL_DEFAULT,
+        )
+
+        return N_PANELS_DEFAULT * NODES_PER_PANEL_DEFAULT
+    return None
+
+
 def make_chunk_runner(
     pp_all: PointParams,
     chunk: int,
@@ -468,7 +506,9 @@ def make_chunk_runner(
     import jax
     import jax.numpy as jnp
 
-    chunk = _clamp_chunk_to_memory(chunk, n_y, mesh, impl)
+    chunk = _clamp_chunk_to_memory(
+        chunk, n_y, mesh, impl, quad_nodes=_resolved_quad_nodes(static, impl)
+    )
     if impl == "pallas":
         from bdlz_tpu.ops.kjma_pallas import build_shifted_table
 
@@ -501,6 +541,13 @@ class SweepResult:
     out_dir: Optional[str]
     chunks: int
     resumed_chunks: int = 0
+    #: Quadrature scheme the engine actually ran: "panel_gl" (snapped-panel
+    #: Gauss–Legendre, audited), "trap" (the reference trapezoid), or None
+    #: for the stiff (ODE) engines where no y-quadrature exists.
+    quad_impl: Optional[str] = None
+    #: Integrand evaluations per point of that scheme (n_panels·n_nodes
+    #: for panel_gl, the floored n_y for trap, None for the stiff engines).
+    n_quad_nodes: Optional[int] = None
     outputs: Optional[Dict[str, np.ndarray]] = field(default=None, repr=False)
     #: Per-point failure mask (True = non-finite output, masked out), full
     #: grid order — not just the count, so callers can locate *which*
@@ -537,6 +584,7 @@ def run_sweep(
     lz_profile=None,
     lz_method: str = "local",
     lz_gamma_phi: float = 0.0,
+    overlap_chunks: bool = True,
 ) -> SweepResult:
     """Run a full sweep: grid build → per-chunk jitted sharded evaluation →
     (optional) chunk files + manifest with resume.
@@ -553,6 +601,22 @@ def run_sweep(
     v_w scans exercise the distributed-LZ physics end to end.
     ``lz_method`` picks the estimator (see ``lz.sweep_bridge``); the
     profile fingerprint joins the manifest hash.
+
+    ``static.quad_panel_gl`` (tri-state) selects the y-quadrature on the
+    tabulated engine: ``None`` (the default) runs the per-population
+    convergence audit (``validation.panel_gl_population_audit``) over
+    the FULL grid and turns the snapped-panel Gauss–Legendre fast path
+    on only when the audit passes — else it falls back to the reference
+    trapezoid loudly.  The RESOLVED scheme joins the manifest hash, so
+    resumed directories can never splice chunks computed under
+    different quadratures.
+
+    ``overlap_chunks`` double-buffers the chunk loop: chunk k+1 is
+    padded, sharded, and its jitted step dispatched while chunk k's
+    results are still being gathered — blocking only at collection.
+    Bit-identical to the serial loop (same programs, same inputs;
+    pinned in tests); automatically disabled when profiling
+    (``trace_dir``) or on the host-orchestrated esdirk engine.
     """
     import jax
     import jax.numpy as jnp
@@ -644,11 +708,36 @@ def run_sweep(
                 "fuse_exp requires the pallas engine, but this configuration "
                 f"forces impl={impl!r}"
             )
-    # Clamp AFTER engine resolution — footprints differ by ~60x between
-    # engines — and broadcast the decision so a per-host env divergence
-    # cannot make multi-controller processes disagree on chunk counts
-    # (which deadlocks the jitted-step launch pattern).
-    chunk_size = _clamp_chunk_to_memory(chunk_size, n_y, mesh, impl)
+    # Resolve the quadrature tri-state BEFORE the memory clamp (the
+    # panel scheme's footprint is ~14x smaller) and before the manifest
+    # hash (the resolved scheme is part of the sweep identity).  The
+    # audit is deterministic host NumPy over the full grid, so every
+    # multi-controller process resolves identically without a broadcast
+    # (same reasoning as resolve_engine_knobs below).
+    from bdlz_tpu.validation import resolve_quad_panel_gl
+
+    table_np = None
+    if impl == "tabulated" and static.quad_panel_gl is None:
+        # the audit needs the host table anyway; build it once and reuse
+        # it as the engine's device table below
+        from bdlz_tpu.ops.kjma_table import make_f_table as _mft_np
+
+        table_np = _mft_np(float(base.I_p), np, n=table_nodes)
+    quad_on, _ = resolve_quad_panel_gl(
+        pp_all, static, impl, n_y, table=table_np, label="sweep",
+    )
+    static = static._replace(quad_panel_gl=quad_on)
+    quad_nodes = _resolved_quad_nodes(static, impl)
+    # Clamp AFTER engine + quadrature resolution — footprints differ by
+    # ~60x between engines and ~20x between quadratures — and broadcast
+    # the decision so a per-host env divergence cannot make
+    # multi-controller processes disagree on chunk counts (which
+    # deadlocks the jitted-step launch pattern).
+    overlap = bool(overlap_chunks) and trace_dir is None and impl != "esdirk"
+    chunk_size = _clamp_chunk_to_memory(
+        chunk_size, n_y, mesh, impl, quad_nodes=quad_nodes,
+        double_buffer=overlap,
+    )
     from bdlz_tpu.parallel.multihost import broadcast_from_coordinator as _bcast
 
     chunk_size = int(np.asarray(_bcast(np.array([chunk_size])))[0])
@@ -656,7 +745,13 @@ def run_sweep(
     if impl in ("direct", "esdirk", "esdirk_lockstep"):
         aux = make_kjma_grid(jnp)
     else:
-        table = make_f_table(float(base.I_p), jnp, n=table_nodes)
+        if table_np is not None:
+            # reuse the audit's host-built table (same bytes, shipped)
+            from bdlz_tpu.ops.kjma_table import table_to_namespace
+
+            table = table_to_namespace(table_np, jnp)
+        else:
+            table = make_f_table(float(base.I_p), jnp, n=table_nodes)
         if impl == "pallas":
             from bdlz_tpu.ops.kjma_pallas import build_shifted_table
 
@@ -827,6 +922,23 @@ def run_sweep(
         # numerical engine.
         hash_extra = dict(hash_extra or {})
         hash_extra["esdirk"] = {"strategy": "repack", **esdirk_knobs}
+    if quad_on:
+        # The RESOLVED quadrature joins the identity (same reasoning as
+        # the esdirk knobs): panel-GL and trapezoid chunks agree only to
+        # ~1e-11 on audited grids — a resumed directory must never
+        # splice the two schemes.  Omit-at-default (trapezoid) so every
+        # pre-existing sweep directory keeps its hash.
+        from bdlz_tpu.solvers.panels import (
+            N_PANELS_DEFAULT,
+            NODES_PER_PANEL_DEFAULT,
+        )
+
+        hash_extra = dict(hash_extra or {})
+        hash_extra["quad"] = {
+            "panel_gl": True,
+            "n_panels": N_PANELS_DEFAULT,
+            "n_nodes": NODES_PER_PANEL_DEFAULT,
+        }
     h = grid_hash(base, axes, n_y, impl, extra=hash_extra)
     if out_dir is not None:
         import os
@@ -905,12 +1017,75 @@ def run_sweep(
             chunk_size=chunk_size, hash=h, use_table=use_table, impl=impl,
         )
 
+    # Double-buffered chunk loop: the jitted step call is an async
+    # dispatch, so chunk k+1's host-side pad + shard + device_put (and
+    # its step dispatch) runs while chunk k still computes — the host
+    # blocks only in _collect()'s gather.  `inflight` holds at most ONE
+    # dispatched-but-uncollected chunk; collection order stays strictly
+    # by chunk index, so the output/mask/manifest bookkeeping (and the
+    # multi-process collective order) is identical to the serial loop.
+    inflight: "dict | None" = None
+
+    def _gather(entry) -> Dict[str, np.ndarray]:
+        # np.asarray on a multi-process global array raises (shards on
+        # other hosts are non-addressable); gather_to_host allgathers
+        # in that case and is a plain asarray single-process.
+        full = gather_to_host(
+            {f: getattr(entry["res"], f) for f in fields}
+        )
+        return {f: full[f][: entry["n_valid"]] for f in fields}
+
+    def _collect() -> None:
+        nonlocal inflight, n_failed
+        if inflight is None:
+            return
+        entry, inflight = inflight, None
+        # serial (profiling) mode pre-gathers inside the trace window so
+        # per-chunk traces keep the pre-overlap step+sync scope, while
+        # the host-side IO below stays OUTSIDE the window as before
+        host = entry.get("host")
+        if host is None:
+            host = _gather(entry)
+        bad = ~np.isfinite(host["DM_over_B"])
+        n_failed += int(bad.sum())
+        if event_log is not None:
+            event_log.emit(
+                "chunk_done", chunk=entry["ci"], n_valid=entry["n_valid"],
+                n_failed=int(bad.sum()),
+                seconds=round(time.time() - entry["t0"], 4),
+            )
+            while _esdirk_stats_holder:
+                cs = _esdirk_stats_holder.pop(0)
+                event_log.emit(
+                    "esdirk_rounds", chunk=entry["ci"], **cs.summary(),
+                    per_round=cs.as_rows(),
+                )
+        else:
+            _esdirk_stats_holder.clear()
+        if entry["file"] and coordinator:
+            from bdlz_tpu.utils.io import atomic_write_json
+
+            np.savez(entry["file"], **host, failed=bad)
+            manifest["chunks"][str(entry["ci"])] = {
+                "file": entry["file"],
+                "n_valid": entry["n_valid"],
+                "n_failed": int(bad.sum()),
+            }
+            # atomic: a crash mid-write must not corrupt resume state
+            atomic_write_json(manifest_path, manifest)
+        if keep_outputs:
+            for f in fields:
+                collected[f].append(host[f])
+        if masks is not None:
+            masks.append(bad)
+
     for ci in range(n_chunks):
         lo, hi = ci * chunk_size, min((ci + 1) * chunk_size, n_total)
         n_valid = hi - lo
         chunk_file = f"{out_dir}/chunk_{ci:05d}.npz" if out_dir else None
 
         if plan[ci, 0]:
+            _collect()  # keep collected/masks appends in chunk order
             resumed += 1
             n_failed += int(plan[ci, 1])
             if masks is not None and ci in mask_cache:
@@ -953,49 +1128,33 @@ def run_sweep(
         t_chunk = time.time()
         with profiler_trace(trace_dir):
             res = step(pp_chunk, aux)
-            # np.asarray on a multi-process global array raises (shards on
-            # other hosts are non-addressable); gather_to_host allgathers
-            # in that case and is a plain asarray single-process.
-            full = gather_to_host({f: getattr(res, f) for f in fields})
-            host = {f: full[f][:n_valid] for f in fields}
-        bad = ~np.isfinite(host["DM_over_B"])
-        n_failed += int(bad.sum())
-        if event_log is not None:
-            event_log.emit(
-                "chunk_done", chunk=ci, n_valid=n_valid,
-                n_failed=int(bad.sum()), seconds=round(time.time() - t_chunk, 4),
-            )
-            while _esdirk_stats_holder:
-                cs = _esdirk_stats_holder.pop(0)
-                event_log.emit(
-                    "esdirk_rounds", chunk=ci, **cs.summary(),
-                    per_round=cs.as_rows(),
-                )
-        else:
-            _esdirk_stats_holder.clear()
-
-        if chunk_file and coordinator:
-            from bdlz_tpu.utils.io import atomic_write_json
-
-            np.savez(chunk_file, **host, failed=bad)
-            manifest["chunks"][str(ci)] = {
+            entry = {
+                "ci": ci, "res": res, "n_valid": n_valid, "t0": t_chunk,
                 "file": chunk_file,
-                "n_valid": n_valid,
-                "n_failed": int(bad.sum()),
             }
-            # atomic: a crash mid-write must not corrupt resume state
-            atomic_write_json(manifest_path, manifest)
-        if keep_outputs:
-            for f in fields:
-                collected[f].append(host[f])
-        if masks is not None:
-            masks.append(bad)
+            if not overlap:
+                # serial mode (profiling / esdirk): the device gather
+                # happens inside the trace window — exactly the
+                # pre-overlap scope — with bookkeeping IO after it
+                entry["host"] = _gather(entry)
+        if overlap:
+            _collect()        # block on chunk k-1 while chunk k computes
+            inflight = entry
+        else:
+            inflight = entry
+            _collect()
 
+    _collect()
     seconds = time.time() - t0
     outputs = (
         {f: np.concatenate(collected[f]) for f in fields} if keep_outputs else None
     )
     failed_mask = np.concatenate(masks) if masks else None
+    if impl in ("tabulated", "pallas", "direct"):
+        quad_impl = "panel_gl" if quad_on else "trap"
+        n_quad = quad_nodes if quad_on else max(int(n_y), 2000)
+    else:  # stiff engines: no y-quadrature
+        quad_impl, n_quad = None, None
     return SweepResult(
         n_points=n_total,
         n_failed=n_failed,
@@ -1004,6 +1163,8 @@ def run_sweep(
         out_dir=out_dir,
         chunks=n_chunks,
         resumed_chunks=resumed,
+        quad_impl=quad_impl,
+        n_quad_nodes=n_quad,
         outputs=outputs,
         failed_mask=failed_mask,
     )

@@ -221,7 +221,12 @@ def _make_emulator_logprob(
         )
     # Stale-artifact gate: the stored identity must match the caller's
     # physics.  Axis fields are exempt (their per-walker values override
-    # the base); n_y/impl are the artifact's own build record.
+    # the base); n_y/impl/quad are the artifact's own build record — a
+    # tri-state (None) caller adopts the artifact's recorded quadrature
+    # scheme, an explicit one is compared strictly.
+    q_art = emulator.identity.get("quad_panel_gl")
+    if static.quad_panel_gl is None and q_art is not None:
+        static = static._replace(quad_panel_gl=bool(q_art))
     check_identity(
         emulator,
         build_identity(

@@ -67,6 +67,12 @@ class YieldService:
             static = static_choices_from_config(base)
         n_y = int(artifact.identity.get("n_y", 0))
         impl = str(artifact.identity.get("impl", "tabulated"))
+        # the exact fallback must answer from the artifact's recorded
+        # quadrature scheme too: a None (tri-state) caller ADOPTS it; an
+        # explicit caller is checked strictly by check_identity below
+        q_art = artifact.identity.get("quad_panel_gl")
+        if static.quad_panel_gl is None and q_art is not None:
+            static = static._replace(quad_panel_gl=bool(q_art))
         check_identity(artifact, build_identity(base, static, n_y, impl))
         self.artifact = artifact
         self.field = field

@@ -61,7 +61,24 @@ def integrate_YB_quadrature(
     y_hi = xp.minimum(y_of_T(T_lo, pp.T_p_GeV, pp.beta_over_H, xp), Y_POS_CUT)
 
     ys = xp.linspace(y_lo, y_hi, n_y)
+    integrand = yb_integrand_direct(ys, pp, chi_stats, grid, xp)
+    YB = xp.trapezoid(integrand, ys)
+    sanitize.checkpoint(sanitize.BOUNDARY_SOLVER, Y_B=YB)
+    return xp.where(y_hi > y_lo, YB, 0.0)
 
+
+def yb_integrand_direct(
+    ys: Array, pp: PointParams, chi_stats: str, grid: KJMAGrid, xp
+) -> Array:
+    """dY_B/dy at the given y-nodes with the DIRECT (n_z-integrated) kernel.
+
+    The exact integrand body of :func:`integrate_YB_quadrature`
+    (operation order preserved — the NumPy backend's bit-reproducibility
+    contract pins the association order per call site), extracted so the
+    snapped-panel Gauss–Legendre path (`solvers/panels.py`) can evaluate
+    the SAME integrand on its own nodes: the equal-scheme NumPy
+    reference of the panel fast path runs through here.
+    """
     # Inverse map T(y) and the analytic Jacobian dT/dy (reference :252-255).
     B_safe = xp.maximum(pp.beta_over_H, 1e-30)
     denom = xp.maximum(1.0 + 2.0 * ys / B_safe, 1e-12)
@@ -83,11 +100,7 @@ def integrate_YB_quadrature(
     sanitize.checkpoint(sanitize.BOUNDARY_PERCOLATION, A_over_V=Av)
     SB = pp.P * Js * Av * source_window(ys, pp.sigma_y, xp)
     sanitize.checkpoint(sanitize.BOUNDARY_SOURCE, S_B=SB)
-
-    integrand = SB / (ss * Hs * Ts) * xp.abs(dTdy)
-    YB = xp.trapezoid(integrand, ys)
-    sanitize.checkpoint(sanitize.BOUNDARY_SOLVER, Y_B=YB)
-    return xp.where(y_hi > y_lo, YB, 0.0)
+    return SB / (ss * Hs * Ts) * xp.abs(dTdy)
 
 
 def quadrature_bounds(pp: PointParams, xp):

@@ -73,6 +73,15 @@ def main(argv=None) -> None:
     ap.add_argument("--fuse-exp", action="store_true", dest="fuse_exp",
                     help="With --impl pallas: evaluate the merged exponential "
                          "inside the kernel (accurate f32 Cody-Waite exp)")
+    ap.add_argument("--quad", default="auto", choices=("auto", "on", "off"),
+                    help="y-quadrature on the tabulated engine: auto (default "
+                         "— snapped-panel Gauss-Legendre after the "
+                         "per-population convergence audit passes, else the "
+                         "reference trapezoid, loudly), on (force the panel "
+                         "rule, skipping the audit), off (pin the reference "
+                         "trapezoid).  Overrides the config's quad_panel_gl "
+                         "tri-state; the resolved scheme joins the resume "
+                         "manifest hash")
     ap.add_argument("--lz-profile", default=None, dest="lz_profile",
                     help="Bounce-profile CSV: derive each point's P_chi_to_B "
                          "from its own wall speed through the two-channel LZ "
@@ -155,9 +164,13 @@ def main(argv=None) -> None:
 
         event_log = EventLog(path=args.events)
 
+    static = static_choices_from_config(cfg)
+    if args.quad != "auto":
+        static = static._replace(quad_panel_gl=args.quad == "on")
+
     interpret = args.impl == "pallas" and jax.devices()[0].platform == "cpu"
     res = run_sweep(
-        cfg, axes, static_choices_from_config(cfg),
+        cfg, axes, static,
         mesh=mesh, chunk_size=args.chunk, n_y=args.n_y, out_dir=args.out,
         event_log=event_log, trace_dir=args.profile_dir,
         impl=args.impl, interpret=interpret, fuse_exp=args.fuse_exp,
@@ -198,6 +211,8 @@ def main(argv=None) -> None:
         "seconds": round(res.seconds, 3),
         "points_per_sec": round(res.points_per_sec, 1),
         "resumed_chunks": res.resumed_chunks,
+        "quad_impl": res.quad_impl,
+        "n_quad_nodes": res.n_quad_nodes,
         "out_dir": res.out_dir,
         "closest_to_planck": closest,
     }))

@@ -92,6 +92,239 @@ def build_audit_population(base, n: int, seed: int = 0) -> AuditPopulation:
     return AuditPopulation(grid=grid, axes=axes, counts=counts)
 
 
+class PanelAuditResult(NamedTuple):
+    """Outcome of one per-population panel-quadrature convergence audit."""
+
+    ok: bool
+    reason: str                       # "" when ok; the loud fallback cause
+    n_sampled: int
+    n_seam_inside: int                # points with the T=m/3 seam in-window
+    max_rel_vs_trap: "float | None"   # GL(m) vs the reference trapezoid
+    max_err_half: "float | None"      # ladder: GL(m/2) vs GL(m)
+    max_err_quarter: "float | None"   # ladder: GL(m/4) vs GL(m)
+    n_quad_nodes: int
+
+
+def _audit_sample_indices(
+    grid, y_lo: np.ndarray, y_hi: np.ndarray, n_sample: int
+) -> np.ndarray:
+    """Deterministic audit sample: an even stride plus the population's
+    adversarial extremes (the corners the bench gate also pins — deepest
+    Maxwell–Boltzmann, most relativistic, widest/narrowest window and
+    source, boundary-layer proxy m/(T_p·β̂))."""
+    n = int(np.asarray(grid.m_chi_GeV).shape[0])
+    m = np.asarray(grid.m_chi_GeV, dtype=np.float64)
+    Tp = np.asarray(grid.T_p_GeV, dtype=np.float64)
+    beta = np.asarray(grid.beta_over_H, dtype=np.float64)
+    sigma = np.asarray(grid.sigma_y, dtype=np.float64)
+    span = np.asarray(y_hi - y_lo, dtype=np.float64)
+    stride = np.linspace(0, n - 1, min(int(n_sample), n)).astype(np.int64)
+    corners = np.array([
+        0, n - 1,
+        int(np.argmax(m / Tp)), int(np.argmin(m / Tp)),
+        int(np.argmax(m / (Tp * np.maximum(beta, 1e-30)))),
+        int(np.argmax(span)), int(np.argmin(span)),
+        int(np.argmax(sigma)), int(np.argmin(sigma)),
+        int(np.argmin(np.abs(3.0 * Tp - m))),
+    ])
+    return np.unique(np.concatenate([stride, corners]))
+
+
+def panel_gl_population_audit(
+    grid,
+    chi_stats: str,
+    n_y: int = 8000,
+    table=None,
+    n_sample: int = 24,
+    rel_tol: float = 1e-9,
+    decay_ratio_max: float = 0.25,
+    decay_floor: float = 1e-10,
+) -> PanelAuditResult:
+    """Decide whether snapped-panel Gauss–Legendre may replace the
+    trapezoid for THIS population (the ``quad_panel_gl: None`` resolver).
+
+    Three checks, all on host NumPy (scheme decisions never depend on the
+    accelerator), all of which must pass before the knob may default on
+    — else the caller falls back to the trapezoid LOUDLY:
+
+    * **no in-window T = m/3 seam**, checked on EVERY point (vectorized —
+      the one hazard a sample could miss): the seam is a jump
+      discontinuity that the panel rule integrates *correctly* but the
+      reference trapezoid does not (O(h)·jump, measured up to ~8e-4 at
+      n_y = 8000), so seam-crossing populations cannot keep the 1e-6
+      reference contract under a scheme change and stay on the
+      reference scheme.  Callers who want the (more accurate) panel
+      values anyway set ``quad_panel_gl=True`` explicitly.
+    * **node-ladder spectral decay** on a deterministic adversarial
+      sample: halving the per-panel node count must collapse the error
+      (``err(m/2) ≤ max(decay_ratio_max · err(m/4), decay_floor)`` — the
+      floor marks "already at the convergence plateau", where the decay
+      ratio is roundoff noise).  A
+      stalled ladder means an unresolved feature (e.g. the deep-MB
+      ``√(1+2y/β̂)`` boundary layer) — spectral quadrature without
+      spectral decay is node-count guessing, exactly what this PR
+      replaces.
+    * **agreement with the reference trapezoid at the caller's n_y** on
+      the same sample (``≤ rel_tol``, default 1e-9): the panel rule must
+      reproduce the scheme it replaces where that scheme is converged.
+
+    ``table`` is the host-NumPy :class:`~bdlz_tpu.ops.kjma_table.KJMATable`
+    (built here from the grid's uniform I_p when omitted); the audit runs
+    the TABULATED integrand — the same one the sweep engine evaluates.
+    """
+    from bdlz_tpu.ops.kjma_table import make_f_table
+    from bdlz_tpu.solvers.panels import (
+        integrate_YB_panel_gl,
+        make_panel_scheme,
+        y_branch_seam,
+    )
+    from bdlz_tpu.solvers.quadrature import (
+        integrate_YB_quadrature_tabulated,
+        quadrature_bounds,
+    )
+
+    n = int(np.asarray(grid.m_chi_GeV).shape[0])
+    if n == 0:
+        return PanelAuditResult(
+            False, "empty population", 0, 0, None, None, None, 0
+        )
+    I_p = np.asarray(grid.I_p, dtype=np.float64)
+    if np.ptp(I_p) != 0.0:
+        # the tabulated integrand is per-I_p; mixed-I_p populations are
+        # routed to the direct engine upstream and never reach the panel
+        # path — refuse rather than audit a scheme that cannot run
+        return PanelAuditResult(
+            False, "population sweeps I_p (per-I_p table unavailable)",
+            0, 0, None, None, None, 0,
+        )
+    grid_np = type(grid)(*(np.asarray(f, dtype=np.float64) for f in grid))
+    y_lo, y_hi = quadrature_bounds(grid_np, np)
+    y_seam = y_branch_seam(grid_np, np)
+    seam_inside = (y_seam > y_lo) & (y_seam < y_hi) & (y_hi > y_lo)
+    n_seam = int(seam_inside.sum())
+    scheme = make_panel_scheme(np)
+    if n_seam:
+        return PanelAuditResult(
+            False,
+            f"T=m/3 branch seam inside the y-window for {n_seam}/{n} "
+            "points: the reference trapezoid carries O(h) jump error "
+            "there, so the 1e-6 reference contract pins the scheme "
+            "(set quad_panel_gl=true explicitly for the converged panel "
+            "values)",
+            0, n_seam, None, None, None, scheme.n_quad_nodes,
+        )
+
+    sample = _audit_sample_indices(grid_np, y_lo, y_hi, n_sample)
+    if table is None:
+        table = make_f_table(float(I_p.reshape(-1)[0]), np)
+    half = make_panel_scheme(np, n_nodes=max(scheme.nodes.shape[0] // 2, 2))
+    quarter = make_panel_scheme(
+        np, n_nodes=max(scheme.nodes.shape[0] // 4, 2)
+    )
+    vals = {k: np.empty(len(sample)) for k in ("trap", "m", "h", "q")}
+    with np.errstate(all="ignore"):
+        for row, i in enumerate(sample):
+            # np.float64 fields, NOT python floats: absurd corners (the
+            # mask-and-report population) must flow inf/NaN into the
+            # GateFailure branch below like the engine path does, not
+            # raise OverflowError out of python-scalar powers
+            pp_i = type(grid_np)(
+                *(np.float64(np.asarray(f)[i]) for f in grid_np)
+            )
+            vals["trap"][row] = float(integrate_YB_quadrature_tabulated(
+                pp_i, chi_stats, table, np, n_y=int(n_y)
+            ))
+            for key, sch in (("m", scheme), ("h", half), ("q", quarter)):
+                vals[key][row] = float(integrate_YB_panel_gl(
+                    pp_i, chi_stats, table, np, scheme=sch
+                ))
+    try:
+        errs_trap = relative_errors(vals["m"], vals["trap"])
+        err_h = relative_errors(vals["h"], vals["m"])
+        err_q = relative_errors(vals["q"], vals["m"])
+    except GateFailure as exc:
+        return PanelAuditResult(
+            False, f"audit sample not scoreable: {exc}", len(sample),
+            0, None, None, None, scheme.n_quad_nodes,
+        )
+    stalled = err_h > np.maximum(decay_ratio_max * err_q, decay_floor)
+    max_trap = float(errs_trap.max())
+    res = PanelAuditResult(
+        ok=True, reason="", n_sampled=len(sample), n_seam_inside=0,
+        max_rel_vs_trap=max_trap,
+        max_err_half=float(err_h.max()),
+        max_err_quarter=float(err_q.max()),
+        n_quad_nodes=scheme.n_quad_nodes,
+    )
+    if stalled.any():
+        i_bad = int(sample[int(np.argmax(err_h / np.maximum(err_q, 1e-300)))])
+        return res._replace(ok=False, reason=(
+            f"node ladder is not spectrally decaying on "
+            f"{int(stalled.sum())}/{len(sample)} sampled points (worst at "
+            f"flat index {i_bad}: err(m/2)={float(err_h.max()):.2e} vs "
+            f"err(m/4)={float(err_q.max()):.2e}) — unresolved integrand "
+            "feature; staying on the trapezoid"
+        ))
+    if max_trap > rel_tol:
+        i_bad = int(sample[int(np.argmax(errs_trap))])
+        return res._replace(ok=False, reason=(
+            f"panel rule disagrees with the n_y={int(n_y)} reference "
+            f"trapezoid by {max_trap:.2e} > {rel_tol:.0e} (worst at flat "
+            f"index {i_bad}); staying on the trapezoid"
+        ))
+    return res
+
+
+def resolve_quad_panel_gl(
+    grid, static, impl: str, n_y: int, table=None, label: str = "sweep",
+) -> "tuple[bool, PanelAuditResult | None]":
+    """THE tri-state resolver for ``static.quad_panel_gl`` — one home for
+    the resolve/audit/announce sequence so run_sweep, the emulator build,
+    and the bench cannot drift in how the knob defaults on.
+
+    Non-tabulated engines resolve False (warning if the caller explicitly
+    asked for the panel rule); an explicit True/False passes through
+    (True = the caller asserts convergence, no audit); ``None`` runs
+    :func:`panel_gl_population_audit` over ``grid`` and announces the
+    outcome on stderr — the fallback is always LOUD.  Returns
+    ``(resolved, audit)`` with ``audit`` None unless it ran; the caller
+    is responsible for ``static._replace(quad_panel_gl=resolved)``.
+    """
+    import sys
+
+    q = static.quad_panel_gl
+    if impl != "tabulated":
+        if q:
+            print(
+                f"[{label}] quad_panel_gl requires the tabulated engine; "
+                f"ignoring it for impl={impl!r}",
+                file=sys.stderr,
+            )
+        return False, None
+    if q is not None:
+        return bool(q), None
+    audit = panel_gl_population_audit(
+        grid, static.chi_stats, n_y=int(n_y), table=table,
+    )
+    if audit.ok:
+        print(
+            f"[{label}] quad_panel_gl on: audit passed over "
+            f"{audit.n_sampled} sampled points (vs trapezoid "
+            f"{audit.max_rel_vs_trap:.1e}, ladder "
+            f"{audit.max_err_half:.1e}/{audit.max_err_quarter:.1e}) — "
+            f"{audit.n_quad_nodes} nodes/point instead of "
+            f"{max(int(n_y), 2000)}",
+            file=sys.stderr,
+        )
+    else:
+        print(
+            f"[{label}] quad_panel_gl off (audit fallback to trapezoid): "
+            f"{audit.reason}",
+            file=sys.stderr,
+        )
+    return audit.ok, audit
+
+
 class GateFailure(ValueError):
     """An accuracy gate could not produce a trustworthy number.
 
@@ -230,6 +463,7 @@ def _reference_code_fingerprint() -> str:
     import bdlz_tpu.physics.percolation
     import bdlz_tpu.physics.source
     import bdlz_tpu.physics.thermo
+    import bdlz_tpu.solvers.panels
     import bdlz_tpu.solvers.quadrature
 
     h = hashlib.sha256()
@@ -237,7 +471,7 @@ def _reference_code_fingerprint() -> str:
         bdlz_tpu.constants, bdlz_tpu.models.yields_pipeline,
         bdlz_tpu.ops.kjma_table, bdlz_tpu.physics.percolation,
         bdlz_tpu.physics.source, bdlz_tpu.physics.thermo,
-        bdlz_tpu.solvers.quadrature,
+        bdlz_tpu.solvers.panels, bdlz_tpu.solvers.quadrature,
     ):
         h.update(inspect.getsource(mod).encode())
     return h.hexdigest()[:16]
@@ -346,7 +580,12 @@ def reference_ratios(grid, static, n_y: "int | None" = None) -> np.ndarray:
     engine run at a non-default n_y (e.g. BDLZ_BENCH_NY) measures
     backend error at EQUAL discretization, not y-grid truncation — the
     adversarial clip-edge windows amplify truncation far past 1e-6 at
-    coarse n_y (docs/perf_notes.md "y-grid truncation error").
+    coarse n_y (docs/perf_notes.md "y-grid truncation error").  The same
+    equal-scheme principle covers the panel-quadrature fast path: with
+    ``static.quad_panel_gl`` resolved True the reference runs the SAME
+    snapped-panel Gauss–Legendre rule over the direct integrand
+    (``point_yields`` dispatches on the static), so the gate measures
+    backend drift, never the trapezoid-vs-panel scheme difference.
     """
     from bdlz_tpu.models.yields_pipeline import point_yields
     from bdlz_tpu.physics.percolation import make_kjma_grid

@@ -24,10 +24,19 @@ Env knobs: BDLZ_BENCH_POINTS (default 262144), BDLZ_BENCH_CHUNK (default
 single v5e chip's 16G HBM), BDLZ_BENCH_NY (default 8000),
 BDLZ_BENCH_IMPL=pallas|tabulated (default: pallas on TPU — the MXU
 interpolation kernel in ops/kjma_pallas.py, with automatic fallback if it
-fails the gate — tabulated on CPU), BDLZ_BENCH_PLATFORM=cpu to force the
-host platform (debug only), BDLZ_BENCH_RELAY_WAIT_S (default 600 — how
-long to wait for a dead accelerator relay to recover before benching CPU;
-the JSON stamps platform/tpu_unavailable/relay_waited_s either way),
+fails the gate — tabulated on CPU), BDLZ_BENCH_QUAD=auto|on|off (default
+auto — the tabulated engine's y-quadrature: snapped-panel Gauss–Legendre
+(solvers/panels.py, ~14x less integrand work) when the per-population
+convergence audit passes on the bench grid, else the reference
+trapezoid; an A/B sub-metric line "quad_gl_sweep_points_per_sec_per_chip"
+records the measured vs_trapezoid speedup and the panel path's gate
+error every round), BDLZ_BENCH_QUAD_POINTS (A/B subset size),
+BDLZ_BENCH_PLATFORM=cpu to force the host platform (debug only),
+BDLZ_RELAY_WAIT_S / --relay-wait (how long to wait for a dead
+accelerator relay to recover before benching CPU: flag > BDLZ_RELAY_WAIT_S
+> legacy BDLZ_BENCH_RELAY_WAIT_S > default — 60 s when JAX_PLATFORMS=cpu
+says this process never wanted the accelerator, 600 s otherwise; the
+JSON stamps platform/tpu_unavailable/relay_waited_s either way),
 BDLZ_BENCH_ODE_POINTS (grid size for the secondary stiff ESDIRK sweep
 metric, printed as its own line before the main one; default 1024 on
 TPU, 64 on the CPU-fallback path — the line A/Bs the lane-repacking
@@ -50,7 +59,35 @@ import sys
 import time
 
 
-def main() -> None:
+def _relay_wait_default() -> float:
+    """Bounded relay wait: flag > BDLZ_RELAY_WAIT_S > legacy env > default.
+
+    The default is 60 s when ``JAX_PLATFORMS=cpu`` — a process that has
+    already pinned the host platform only reaches the wait through the
+    axon plugin's force-registration, and burning the old 600 s default
+    there stalls every CPU-pinned round for ten minutes before producing
+    the exact same flagged CPU number (BENCH_r05: relay_waited_s=600.0).
+    """
+    for env in ("BDLZ_RELAY_WAIT_S", "BDLZ_BENCH_RELAY_WAIT_S"):
+        raw = os.environ.get(env)
+        if raw:
+            return float(raw)
+    return 60.0 if os.environ.get("JAX_PLATFORMS") == "cpu" else 600.0
+
+
+def main(argv=None) -> None:
+    import argparse
+
+    ap = argparse.ArgumentParser(description="bdlz_tpu sweep benchmark")
+    ap.add_argument(
+        "--relay-wait", type=float, default=None, dest="relay_wait",
+        help="Seconds to wait for a dead accelerator relay before "
+             "benching host CPU (default: BDLZ_RELAY_WAIT_S, else the "
+             "legacy BDLZ_BENCH_RELAY_WAIT_S, else 60 when "
+             "JAX_PLATFORMS=cpu / 600 otherwise)",
+    )
+    args = ap.parse_args(argv)
+
     from bdlz_tpu.utils.platform import axon_registered, wait_for_relay
 
     force_cpu = os.environ.get("BDLZ_BENCH_PLATFORM") == "cpu"
@@ -63,7 +100,10 @@ def main() -> None:
     # that can recover (observed), so the bench *waits* for it (bounded)
     # instead of silently downgrading the round's metric to a CPU number.
     if not force_cpu and axon_registered():
-        max_wait = float(os.environ.get("BDLZ_BENCH_RELAY_WAIT_S", 600))
+        max_wait = (
+            args.relay_wait if args.relay_wait is not None
+            else _relay_wait_default()
+        )
         t_wait = time.time()
         alive = wait_for_relay(max_wait_s=max_wait, poll_s=15.0)
         relay_waited = round(time.time() - t_wait, 1)
@@ -138,36 +178,81 @@ def main() -> None:
 
     mesh = make_mesh(shape=(n_dev, 1))
     sharding = batch_sharding(mesh)
-    table = make_f_table(base.I_p, jnp)
+    # host-built table once; the jnp copy ships the same bytes (the
+    # audit below and the engines must share one table identity)
+    from bdlz_tpu.ops.kjma_table import table_to_namespace
 
-    def make_run_chunk(impl: str, reduce=None, pp=None):
+    table_np = make_f_table(base.I_p, np)
+    table = table_to_namespace(table_np, jnp)
+
+    # --- y-quadrature resolution (the tabulated engine's tri-state) ----
+    # BDLZ_BENCH_QUAD=auto runs the SHARED resolver (the same audit +
+    # announcement run_sweep and the emulator build use) over the bench
+    # grid; the snapped-panel Gauss-Legendre fast path only defaults on
+    # when the audit passes, else the bench stays on the reference
+    # trapezoid loudly.  "on"/"off" pin it.
+    from bdlz_tpu.solvers.panels import (
+        N_PANELS_DEFAULT,
+        NODES_PER_PANEL_DEFAULT,
+    )
+    from bdlz_tpu.validation import resolve_quad_panel_gl
+
+    quad_mode = os.environ.get("BDLZ_BENCH_QUAD", "auto")
+    quad_audit = None
+    if quad_mode == "auto":
+        quad_on, quad_audit = resolve_quad_panel_gl(
+            pp_all, static, "tabulated", n_y, table=table_np,
+            label="bench",
+        )
+    else:
+        quad_on = quad_mode == "on"
+    n_quad_gl = N_PANELS_DEFAULT * NODES_PER_PANEL_DEFAULT
+    # `static` keeps the config tri-state (None -> trapezoid on every
+    # bit-pinned path, incl. the gate references); `static_gl` is the
+    # panel scheme.  Every gate below compares an engine against the
+    # NumPy reference run at the engine's OWN scheme (the established
+    # equal-discretization rule).
+    static_gl = static._replace(quad_panel_gl=True)
+
+    def static_for(impl_: str):
+        """The static (incl. resolved quadrature) an engine runs with."""
+        return static_gl if (impl_ == "tabulated" and quad_on) else static
+
+    def make_run_chunk(impl: str, reduce=None, pp=None, static_run=None):
         # shared engine-runner (pallas aux pairing, interpret-on-CPU,
         # memory clamp, pad + shard + evaluate) —
         # bdlz_tpu.parallel.sweep.make_chunk_runner, also used by
         # scripts/impl_shootout.py so the two tools measure the same
         # thing; ``pp`` defaults to the bench grid (the LZ metric passes
-        # its P-derived variant)
+        # its P-derived variant), ``static_run`` to the engine's
+        # resolved-quadrature static
         nonlocal chunk
         from bdlz_tpu.parallel.sweep import make_chunk_runner
 
         fuse = os.environ.get("BDLZ_BENCH_FUSE_EXP", "0") == "1"
         run_chunk, chunk = make_chunk_runner(
-            pp_all if pp is None else pp, chunk, static, mesh, sharding,
+            pp_all if pp is None else pp, chunk,
+            static_for(impl) if static_run is None else static_run,
+            mesh, sharding,
             table, impl=impl, n_y=n_y, fuse_exp=fuse, reduce=reduce,
         )
         return run_chunk
 
-    def accuracy_gate(run_chunk, pp=None):
+    def accuracy_gate(run_chunk, pp=None, static_run=None):
         """Max rel err of a point sample vs the NumPy reference path.
 
         The first chunk evaluation doubles as compile warm-up; any
         compile/runtime failure propagates to the caller for fallback.
         ``pp`` must be the grid ``run_chunk`` was built over (default:
-        the bench grid).  Sampled indices are grouped by chunk and each
-        needed chunk is evaluated ONCE (VERDICT r4 weak #5 — the old
-        per-index loop re-ran a full chunk per sampled corner).
+        the bench grid) and ``static_run`` the static it runs with —
+        the reference is evaluated at the SAME static (same n_y, same
+        quadrature scheme), so the gate measures backend drift, not
+        scheme differences.  Sampled indices are grouped by chunk and
+        each needed chunk is evaluated ONCE (VERDICT r4 weak #5 — the
+        old per-index loop re-ran a full chunk per sampled corner).
         """
         pp = pp_all if pp is None else pp
+        static_run = static if static_run is None else static_run
         n_pts = int(np.asarray(pp.m_chi_GeV).shape[0])
         rng = np.random.default_rng(0)
         sample = rng.choice(n_pts, size=min(8, n_pts), replace=False)
@@ -186,7 +271,10 @@ def main() -> None:
         sample = np.unique(np.concatenate([sample, corners]))
         grid_np = make_kjma_grid(np)
         # equal-discretization reference (same n_y as the benched engine)
-        static_gate = static._replace(n_y=n_y) if static.n_y != n_y else static
+        static_gate = (
+            static_run._replace(n_y=n_y) if static_run.n_y != n_y
+            else static_run
+        )
         max_rel = 0.0
         # chunk 0 always runs (compile warm-up contract), then one
         # evaluation per chunk that holds a sampled index
@@ -215,10 +303,23 @@ def main() -> None:
     n_gate = int(os.environ.get("BDLZ_BENCH_GATE_POINTS", 128))
     gate_pop = build_audit_population(base, n_gate, seed=1)
     # cached: bit-deterministic, and the collector's phases share one
-    # hardware window — don't re-pay the scalar reference loop per tool
-    gate_ref = reference_ratios_cached(gate_pop.grid, static, n_y=n_y)
+    # hardware window — don't re-pay the scalar reference loop per tool.
+    # One reference per SCHEME (trap/panel-GL), computed lazily: the
+    # gate always compares an engine against the NumPy reference at the
+    # engine's own quadrature (equal-scheme rule — the trapezoid
+    # reference is O(h)-wrong at the population's T=m/3 seam corners,
+    # so cross-scheme comparison would measure the reference's error).
+    _gate_refs: dict = {}
 
-    def population_gate(impl: str, reduce=None) -> float:
+    def gate_ref_for(st):
+        key = bool(st.quad_panel_gl)
+        if key not in _gate_refs:
+            _gate_refs[key] = reference_ratios_cached(
+                gate_pop.grid, st, n_y=n_y
+            )
+        return _gate_refs[key]
+
+    def population_gate(impl: str, reduce=None, static_run=None) -> float:
         """Max rel err of the benched engine over the audit population.
 
         Raises ``validation.GateFailure`` on non-finite engine output
@@ -226,8 +327,10 @@ def main() -> None:
         from bdlz_tpu.validation import engine_population_max_rel
 
         fuse = os.environ.get("BDLZ_BENCH_FUSE_EXP", "0") == "1"
+        static_run = static_for(impl) if static_run is None else static_run
         return engine_population_max_rel(
-            gate_pop.grid, gate_ref, static, mesh, sharding, table,
+            gate_pop.grid, gate_ref_for(static_run), static_run, mesh,
+            sharding, table,
             impl=impl, n_y=n_y, fuse_exp=fuse, reduce=reduce,
         )
 
@@ -278,7 +381,10 @@ def main() -> None:
 
         run_chunk = make_run_chunk(impl)
         try:
-            max_rel = max(accuracy_gate(run_chunk), population_gate(impl))
+            max_rel = max(
+                accuracy_gate(run_chunk, static_run=static_for(impl)),
+                population_gate(impl),
+            )
         except GateFailure as exc:
             # non-finite gate output on the LAST-RESORT engine: report
             # the failure in-band (null rel err + gate_error) rather
@@ -300,6 +406,93 @@ def main() -> None:
 
     pps = n_total / seconds
     per_chip = pps / n_dev
+
+    main_static = static_for(impl)
+    quad_impl_main = "panel_gl" if main_static.quad_panel_gl else "trap"
+    n_quad_main = (
+        n_quad_gl if main_static.quad_panel_gl else max(n_y, 2000)
+    )
+
+    # --- secondary metric: the panel-quadrature A/B (quad_gl) ----------
+    # Times the tabulated engine under BOTH y-quadratures on a bounded
+    # subset of the bench grid: vs_trapezoid is the measured panel-GL
+    # speedup, rel_err_vs_reference the panel path's own gate (engine vs
+    # the equal-scheme NumPy reference over the adversarial population),
+    # and scheme_vs_trapezoid_rel_err the honest scheme difference on
+    # the subset — the "<=1e-9 vs the 8000-node trapezoid" claim,
+    # measured every round.
+    def quad_gl_metric():
+        from bdlz_tpu.validation import relative_errors
+
+        n_sub = int(os.environ.get(
+            "BDLZ_BENCH_QUAD_POINTS", min(n_total, 2 * chunk)
+        ))
+        n_sub = max(min(n_sub, n_total), 1)
+        pp_sub = jax.tree.map(lambda a: np.asarray(a)[:n_sub], pp_all)
+        run_gl = make_run_chunk("tabulated", pp=pp_sub, static_run=static_gl)
+        run_tr = make_run_chunk("tabulated", pp=pp_sub, static_run=static)
+
+        def timed(run):
+            vals = np.empty(n_sub)
+            out = run(0, min(chunk, n_sub))  # compile warm-up
+            out.block_until_ready()
+            t1 = time.time()
+            done = 0
+            while done < n_sub:
+                hi = min(done + chunk, n_sub)
+                out = run(done, hi)
+                vals[done:hi] = np.asarray(out)[: hi - done]
+                done = hi
+            jax.block_until_ready(out)
+            return vals, time.time() - t1
+
+        vals_gl, sec_gl = timed(run_gl)
+        vals_tr, sec_tr = timed(run_tr)
+        scheme_rel = float(np.max(relative_errors(vals_gl, vals_tr)))
+        gl_gate = max(
+            accuracy_gate(run_gl, pp=pp_sub, static_run=static_gl),
+            population_gate("tabulated", static_run=static_gl),
+        )
+        per_chip_gl = round(n_sub / sec_gl / n_dev, 2)
+        per_chip_tr = round(n_sub / sec_tr / n_dev, 2)
+        payload = {
+            "metric": "quad_gl_sweep_points_per_sec_per_chip",
+            "value": per_chip_gl,
+            "unit": "param-points/sec/chip (tabulated engine, snapped-"
+                    "panel Gauss-Legendre y-quadrature A/B vs the "
+                    "n_y=%d trapezoid)" % n_y,
+            "n_points": n_sub,
+            "quad_impl": "panel_gl",
+            "n_quad_nodes": n_quad_gl,
+            "vs_trapezoid": round(per_chip_gl / max(per_chip_tr, 1e-9), 1),
+            "trapezoid_points_per_sec_per_chip": per_chip_tr,
+            "rel_err_vs_reference": float(f"{gl_gate:.3e}"),
+            "scheme_vs_trapezoid_rel_err": float(f"{scheme_rel:.3e}"),
+            "resolved_on": bool(quad_on),
+            "audit": None if quad_audit is None else {
+                "ok": quad_audit.ok,
+                "reason": quad_audit.reason or None,
+                "n_sampled": quad_audit.n_sampled,
+                "max_rel_vs_trap": quad_audit.max_rel_vs_trap,
+                "max_err_half": quad_audit.max_err_half,
+                "max_err_quarter": quad_audit.max_err_quarter,
+            },
+            "platform": jax.devices()[0].platform,
+            "tpu_unavailable": tpu_unavailable,
+        }
+        print(json.dumps(payload))
+        return {
+            k: payload[k] for k in (
+                "value", "vs_trapezoid", "rel_err_vs_reference",
+                "scheme_vs_trapezoid_rel_err", "resolved_on",
+            )
+        }
+
+    quad_gl_summary = None
+    try:
+        quad_gl_summary = quad_gl_metric()
+    except Exception as exc:  # noqa: BLE001 — secondary metric is best-effort
+        print(f"[bench] quad_gl metric unavailable: {exc}", file=sys.stderr)
 
     # --- secondary metric: the stiff (ESDIRK) sweep engine ---
     # Sweeps touching sigma_v/washout/depletion auto-route to the vmapped
@@ -431,6 +624,11 @@ def main() -> None:
                     else float(f"{rel_ref['lockstep']:.3e}")
                 ),
                 "compaction": stats,
+                # no y-quadrature exists on the stiff path; nulls keep
+                # the "every sweep metric line names its quadrature"
+                # schema uniform
+                "quad_impl": None,
+                "n_quad_nodes": None,
                 "platform": jax.devices()[0].platform,
                 "tpu_unavailable": tpu_unavailable,
             })
@@ -597,7 +795,7 @@ def main() -> None:
         pp_lz = pp_lz_base._replace(P=jnp.asarray(P_lz))
         run_lz = make_run_chunk(impl, reduce=pallas_reduce, pp=pp_lz)
         # warm-up + the shared spot-gate, on the SAME derived P
-        lz_rel = accuracy_gate(run_lz, pp=pp_lz)
+        lz_rel = accuracy_gate(run_lz, pp=pp_lz, static_run=static_for(impl))
         t1 = time.time()
         done = 0
         while done < n_lz:
@@ -618,6 +816,8 @@ def main() -> None:
                 "seconds": round(lz_seconds, 3),
                 "rel_err_vs_reference": float(f"{lz_rel:.3e}"),
                 "impl": impl,
+                "quad_impl": quad_impl_main,
+                "n_quad_nodes": n_quad_main,
                 "platform": jax.devices()[0].platform,
                 "tpu_unavailable": tpu_unavailable,
             })
@@ -680,6 +880,12 @@ def main() -> None:
                 **({"gate_error": gate_error} if gate_error else {}),
                 "gate_points": n_gate,
                 "impl": impl,
+                # the y-quadrature the MAIN timed engine ran with, plus
+                # the per-round panel-GL A/B summary (null = A/B leg
+                # failed; its secondary line carries the full detail)
+                "quad_impl": quad_impl_main,
+                "n_quad_nodes": n_quad_main,
+                "quad_gl": quad_gl_summary,
                 # self-describing when the PALLAS path ran at an
                 # explicitly-set or non-default kernel block (the
                 # collector's COL_BLOCK sweep, incl. its 8 leg); absent

@@ -229,6 +229,77 @@ class TestArtifactRejection:
             save_artifact(str(tmp_path / "save_nan"), bad)
 
 
+class TestQuadSchemeIdentity:
+    """Surfaces computed under different y-quadrature schemes must never
+    be confused: the resolved ``quad_panel_gl`` rides the artifact
+    identity (and therefore its content hash), tri-state consumers adopt
+    the recorded scheme, explicit consumers are compared strictly."""
+
+    def test_artifact_records_resolved_quadrature(self, tiny_emulator):
+        from bdlz_tpu.config import StaticChoices
+
+        base, out_dir, _, _ = tiny_emulator
+        art = load_artifact(out_dir)
+        # the fixture's narrow benchmark box is smooth: the build's audit
+        # must have admitted the panel-GL fast path and recorded it
+        assert art.identity.get("quad_panel_gl") is True
+        # the knob is normalized OUT of the static tuple — the identity
+        # key is its single home
+        assert StaticChoices(*art.identity["static"]).quad_panel_gl is None
+
+    def test_cross_scheme_artifact_rejected(self, tiny_emulator):
+        from bdlz_tpu.emulator import build_identity, check_identity
+
+        base, out_dir, _, _ = tiny_emulator
+        art = load_artifact(out_dir)
+        static = static_choices_from_config(base)
+        n_y = int(art.identity["n_y"])
+        impl = str(art.identity["impl"])
+        # explicit-trapezoid consumer vs a panel-GL surface: rejected
+        with pytest.raises(EmulatorArtifactError, match="identity mismatch"):
+            check_identity(art, build_identity(
+                base, static._replace(quad_panel_gl=False), n_y, impl,
+            ))
+        # matching explicit scheme: accepted
+        check_identity(art, build_identity(
+            base, static._replace(quad_panel_gl=True), n_y, impl,
+        ))
+        # tri-state (None) consumer: wildcard — adopts the artifact's
+        assert static.quad_panel_gl is None
+        check_identity(art, build_identity(base, static, n_y, impl))
+
+    def test_quad_scheme_changes_artifact_hash(self, tiny_emulator):
+        """Identical tables under different recorded schemes hash
+        differently — a copied .npz cannot masquerade as the other
+        scheme's surface."""
+        base, out_dir, _, _ = tiny_emulator
+        art = load_artifact(out_dir)
+        ident_other = dict(art.identity)
+        ident_other["quad_panel_gl"] = False
+        h_gl = artifact_hash(art.axis_names, art.axis_nodes,
+                             art.axis_scales, art.values, art.identity)
+        h_tr = artifact_hash(art.axis_names, art.axis_nodes,
+                             art.axis_scales, art.values, ident_other)
+        assert h_gl == art.manifest["hash"]
+        assert h_gl != h_tr
+
+    def test_service_adopts_artifact_scheme(self, tiny_emulator):
+        from bdlz_tpu.serve.service import YieldService
+
+        base, out_dir, _, _ = tiny_emulator
+        # tri-state consumer constructs fine (adopts panel-GL fallback)
+        YieldService(load_artifact(out_dir), base, max_batch_size=16)
+        # explicit-trapezoid consumer is refused the panel-GL surface
+        with pytest.raises(EmulatorArtifactError, match="identity mismatch"):
+            YieldService(
+                load_artifact(out_dir), base,
+                static=static_choices_from_config(base)._replace(
+                    quad_panel_gl=False
+                ),
+                max_batch_size=16,
+            )
+
+
 class TestEmulatorLogprob:
     def test_fast_mode_matches_exact_logp(self, tiny_emulator):
         import jax.numpy as jnp

@@ -126,6 +126,22 @@ def test_lint_sh_clean_including_batching_engine():
     assert not report.active, f"stiff-engine findings:\n{offenders}"
 
 
+def test_panel_quadrature_module_clean():
+    """solvers/panels.py builds its node/weight tables with host NumPy
+    and runs its edge-snapping inside jit/vmap — exactly the R1/R2/R3
+    surface bdlz-lint polices — so the new module is pinned per-file
+    (scripts/lint.sh covers it via the package sweep too), along with
+    the quadrature module it extends and the validation audit that
+    gates it."""
+    report = lint_paths([
+        str(PACKAGE / "solvers" / "panels.py"),
+        str(PACKAGE / "solvers" / "quadrature.py"),
+        str(PACKAGE / "validation.py"),
+    ])
+    offenders = "\n".join(f.render() for f in report.active)
+    assert not report.active, f"panel-quadrature findings:\n{offenders}"
+
+
 def test_emulator_and_serve_packages_clean():
     """The emulator's jitted query kernel is a prime R1/R3 surface (host
     np in a jit-reachable interpolation, device syncs in the batcher hot

@@ -1,0 +1,405 @@
+"""Spectral panel quadrature (solvers/panels.py) + its audit-gated wiring.
+
+The satellite battery the PR promises: GL-vs-trapezoid agreement over the
+adversarial gate population (including zero-reference and seam-straddling
+points), the spectral-decay audit, tri-state knob resolution through
+run_sweep / the CLIs, and chunk double-buffer bit-parity with the serial
+loop."""
+import numpy as np
+import pytest
+
+from bdlz_tpu.config import (
+    config_from_dict,
+    point_params_from_config,
+    static_choices_from_config,
+)
+from bdlz_tpu.ops.kjma_table import make_f_table
+from bdlz_tpu.parallel import build_grid, make_mesh, run_sweep
+from bdlz_tpu.solvers.panels import (
+    N_PANELS_DEFAULT,
+    NODES_PER_PANEL_DEFAULT,
+    integrate_YB_panel_gl,
+    make_panel_scheme,
+    panel_edges,
+    y_branch_seam,
+    y_washout_turn_on,
+)
+from bdlz_tpu.solvers.quadrature import (
+    integrate_YB_quadrature_tabulated,
+    quadrature_bounds,
+)
+from bdlz_tpu.validation import (
+    build_audit_population,
+    panel_gl_population_audit,
+    relative_errors,
+)
+
+BENCH_OVER = {
+    "regime": "nonthermal",
+    "P_chi_to_B": 0.14925839040304145,
+    "source_shape_sigma_y": 9.0,
+    "incident_flux_scale": 1.07e-9,
+    "Y_chi_init": 4.90e-10,
+}
+
+
+@pytest.fixture(scope="module")
+def base_cfg():
+    return config_from_dict(dict(BENCH_OVER))
+
+
+@pytest.fixture(scope="module")
+def table_np(base_cfg):
+    return make_f_table(base_cfg.I_p, np)
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    import jax
+
+    assert len(jax.devices()) == 8
+    return make_mesh(shape=(4, 2))
+
+
+def _point(grid, i):
+    return type(grid)(*(float(np.asarray(f)[i]) for f in grid))
+
+
+class TestPanelScheme:
+    def test_edges_snap_breakpoints(self, base_cfg):
+        """Every in-window analytic breakpoint lands EXACTLY on a panel
+        edge, and edges stay sorted within the clipped support."""
+        cfg = config_from_dict(dict(BENCH_OVER))
+        pp = point_params_from_config(cfg, cfg.P_chi_to_B)
+        # a seam-in-window point: m = 3·T_p·1.05 puts T=m/3 mid-window
+        pp = pp._replace(m_chi_GeV=3.0 * pp.T_p_GeV * 1.05)
+        y_lo, y_hi = quadrature_bounds(pp, np)
+        edges = np.asarray(panel_edges(pp, y_lo, y_hi, N_PANELS_DEFAULT, np))
+        assert edges.shape == (N_PANELS_DEFAULT + 1,)
+        assert np.all(np.diff(edges) >= 0)
+        assert edges[0] == y_lo and edges[-1] == y_hi
+        seam = float(y_branch_seam(pp, np))
+        wash = float(y_washout_turn_on(pp.I_p, np))
+        assert y_lo < seam < y_hi
+        assert seam in edges               # the jump is a panel edge
+        assert wash in edges               # the washout turn-on too
+
+    def test_out_of_window_breakpoints_do_not_distort(self, base_cfg):
+        """Breakpoints outside [y_lo, y_hi] (the common case — the bench
+        grid's seam sits at y ~ 4000) leave the uniform edges untouched."""
+        pp = point_params_from_config(base_cfg, base_cfg.P_chi_to_B)
+        y_lo, y_hi = quadrature_bounds(pp, np)
+        assert float(y_branch_seam(pp, np)) > y_hi  # seam outside
+        edges = np.asarray(panel_edges(pp, y_lo, y_hi, 16, np))
+        wash = float(y_washout_turn_on(pp.I_p, np))
+        uniform = y_lo + (y_hi - y_lo) / 16 * np.arange(17)
+        moved = np.flatnonzero(edges != uniform)
+        # only the washout snap (in-window) may move an edge
+        assert len(moved) <= 1
+        assert wash in edges
+
+    def test_scheme_shape_validation(self):
+        with pytest.raises(ValueError, match="n_panels"):
+            make_panel_scheme(np, n_panels=0)
+        s = make_panel_scheme(np, n_panels=4, n_nodes=8)
+        assert s.n_quad_nodes == 32
+        # Gauss-Legendre exactness sanity: degree-15 polynomial, 8 nodes
+        assert float(np.sum(s.weights * s.nodes**14)) == pytest.approx(
+            2.0 / 15.0, rel=1e-12
+        )
+
+    def test_empty_window_returns_exact_zero(self, base_cfg, table_np):
+        """T-windows mapping to an empty clipped y-interval must return
+        EXACTLY 0.0 — the zero-reference gate points compare bitwise."""
+        pp = point_params_from_config(base_cfg, base_cfg.P_chi_to_B)
+        # whole T-window above T_p at large beta: y(T_lo) < Y_NEG_CUT
+        # while y_lo clips AT the cut -> y_hi < y_lo (empty interval)
+        pp = pp._replace(
+            beta_over_H=400.0, T_min_over_Tp=10.0, T_max_over_Tp=12.0
+        )
+        y_lo, y_hi = quadrature_bounds(pp, np)
+        assert y_hi < y_lo  # genuinely empty after support clipping
+        gl = float(integrate_YB_panel_gl(pp, "fermion", table_np, np))
+        tr = float(integrate_YB_quadrature_tabulated(pp, "fermion", table_np, np))
+        assert gl == 0.0 == tr
+
+
+class TestAgreement:
+    def test_gl_matches_trapezoid_on_bench_grid(self, base_cfg, table_np):
+        """<=1e-9 vs the 8000-node reference trapezoid over a bench-grid
+        slice (the acceptance claim, measured at ~1e-11 in practice)."""
+        grid = build_grid(base_cfg, {
+            "m_chi_GeV": np.geomspace(0.1, 10.0, 5),
+            "T_p_GeV": np.geomspace(30.0, 300.0, 5),
+            "v_w": [0.05, 0.9],
+        })
+        n = len(np.asarray(grid.m_chi_GeV))
+        gl = np.empty(n)
+        tr = np.empty(n)
+        for i in range(n):
+            pp = _point(grid, i)
+            gl[i] = integrate_YB_panel_gl(pp, "fermion", table_np, np)
+            tr[i] = integrate_YB_quadrature_tabulated(
+                pp, "fermion", table_np, np, n_y=8000
+            )
+        assert float(np.max(relative_errors(gl, tr))) <= 1e-9
+
+    def test_adversarial_population_seam_and_zero_points(self, base_cfg,
+                                                         table_np):
+        """Over the audit population: non-seam points agree with the
+        trapezoid; seam-straddling points CONVERGE (self-consistent under
+        node refinement) even where the trapezoid carries O(h) jump error;
+        zero-reference (empty-window) points are exactly 0 on both."""
+        pop = build_audit_population(base_cfg, 64, seed=1)
+        grid = pop.grid
+        n = len(np.asarray(grid.m_chi_GeV))
+        grid_np = type(grid)(*(np.asarray(f, dtype=np.float64) for f in grid))
+        y_lo, y_hi = quadrature_bounds(grid_np, np)
+        seam = np.asarray(y_branch_seam(grid_np, np))
+        seam_in = (seam > y_lo) & (seam < y_hi)
+        assert seam_in.any()  # the population does straddle the seam
+        dense = make_panel_scheme(np, n_panels=2 * N_PANELS_DEFAULT,
+                                  n_nodes=NODES_PER_PANEL_DEFAULT)
+        for i in range(0, n, 3):
+            pp = _point(grid, i)
+            gl = float(integrate_YB_panel_gl(pp, "fermion", table_np, np))
+            tr = float(integrate_YB_quadrature_tabulated(
+                pp, "fermion", table_np, np, n_y=8000
+            ))
+            if tr == 0.0:
+                assert gl == 0.0  # zero-reference: bitwise agreement
+                continue
+            if seam_in[i]:
+                # the trapezoid is O(h)-wrong at a jump; the panel rule
+                # must instead be stable under its own refinement
+                gl2 = float(integrate_YB_panel_gl(
+                    pp, "fermion", table_np, np, scheme=dense
+                ))
+                assert gl == pytest.approx(gl2, rel=5e-7)
+            else:
+                assert gl == pytest.approx(tr, rel=5e-7), i
+
+
+class TestAudit:
+    def test_smooth_population_passes(self, base_cfg, table_np):
+        grid = build_grid(base_cfg, {
+            "m_chi_GeV": np.geomspace(0.1, 10.0, 6),
+            "T_p_GeV": np.geomspace(30.0, 300.0, 6),
+        })
+        a = panel_gl_population_audit(grid, "fermion", n_y=8000,
+                                      table=table_np)
+        assert a.ok, a.reason
+        assert a.max_rel_vs_trap <= 1e-9
+        # spectral decay: halving the nodes collapses the error by far
+        # more than the 0.25 admission ratio
+        assert a.max_err_half <= 0.25 * a.max_err_quarter
+        assert a.n_quad_nodes == N_PANELS_DEFAULT * NODES_PER_PANEL_DEFAULT
+
+    def test_seam_population_fails_loudly(self, base_cfg, table_np):
+        pop = build_audit_population(base_cfg, 64, seed=1)
+        a = panel_gl_population_audit(pop.grid, "fermion", n_y=8000,
+                                      table=table_np)
+        assert not a.ok
+        assert "seam" in a.reason
+        assert a.n_seam_inside > 0
+
+    def test_swept_I_p_refused(self, base_cfg, table_np):
+        grid = build_grid(base_cfg, {"I_p": [0.3, 0.4]})
+        a = panel_gl_population_audit(grid, "fermion", table=table_np)
+        assert not a.ok and "I_p" in a.reason
+
+
+class TestKnobResolution:
+    AXES = {"m_chi_GeV": np.geomspace(0.1, 2.0, 12).tolist()}
+
+    def test_auto_resolves_on_for_smooth_grid(self, base_cfg, mesh8):
+        static = static_choices_from_config(base_cfg)
+        assert static.quad_panel_gl is None  # config default: tri-state
+        res = run_sweep(base_cfg, self.AXES, static, mesh=mesh8, chunk_size=8)
+        assert res.quad_impl == "panel_gl"
+        assert res.n_quad_nodes == N_PANELS_DEFAULT * NODES_PER_PANEL_DEFAULT
+
+    def test_auto_falls_back_on_seam_grid(self, base_cfg, mesh8, capsys):
+        """A sweep whose grid crosses the T=m/3 seam must stay on the
+        trapezoid, loudly."""
+        static = static_choices_from_config(base_cfg)
+        axes = {"m_chi_GeV": [250.0, 300.0]}  # m ~ 3*T_p: seam in-window
+        res = run_sweep(base_cfg, axes, static, mesh=mesh8, chunk_size=8)
+        assert res.quad_impl == "trap"
+        assert "audit fallback" in capsys.readouterr().err
+
+    def test_explicit_off_pins_trapezoid(self, base_cfg, mesh8):
+        static = static_choices_from_config(base_cfg)
+        res = run_sweep(
+            base_cfg, self.AXES, static._replace(quad_panel_gl=False),
+            mesh=mesh8, chunk_size=8,
+        )
+        assert res.quad_impl == "trap"
+        assert res.n_quad_nodes == 8000
+
+    def test_explicit_on_skips_audit_even_on_seam_grid(self, base_cfg, mesh8):
+        static = static_choices_from_config(base_cfg)
+        axes = {"m_chi_GeV": [250.0, 300.0]}
+        res = run_sweep(
+            base_cfg, axes, static._replace(quad_panel_gl=True),
+            mesh=mesh8, chunk_size=8,
+        )
+        assert res.quad_impl == "panel_gl"
+        assert res.n_failed == 0
+
+    def test_stiff_impl_ignores_quad(self, base_cfg, mesh8, capsys):
+        import dataclasses
+
+        cfg = dataclasses.replace(base_cfg, T_min_over_Tp=0.2)
+        static = static_choices_from_config(cfg)
+        res = run_sweep(
+            cfg, {"Gamma_wash_over_H": [0.01, 0.1]},
+            static._replace(quad_panel_gl=True), mesh=mesh8, chunk_size=8,
+        )
+        assert res.quad_impl is None and res.n_quad_nodes is None
+        assert "requires the tabulated engine" in capsys.readouterr().err
+
+    def test_gl_sweep_matches_trap_sweep(self, base_cfg, mesh8):
+        static = static_choices_from_config(base_cfg)
+        r_gl = run_sweep(base_cfg, self.AXES, static, mesh=mesh8, chunk_size=8)
+        r_tr = run_sweep(
+            base_cfg, self.AXES, static._replace(quad_panel_gl=False),
+            mesh=mesh8, chunk_size=8,
+        )
+        assert r_gl.quad_impl == "panel_gl" and r_tr.quad_impl == "trap"
+        np.testing.assert_allclose(
+            r_gl.outputs["DM_over_B"], r_tr.outputs["DM_over_B"], rtol=1e-9
+        )
+
+    def test_resume_invalidated_by_quad_change(self, base_cfg, mesh8,
+                                               tmp_path):
+        """Panel-GL and trapezoid chunks must never be spliced: the
+        resolved scheme joins the manifest hash."""
+        static = static_choices_from_config(base_cfg)
+        out = str(tmp_path / "sweep")
+        r1 = run_sweep(base_cfg, self.AXES, static, mesh=mesh8,
+                       chunk_size=16, out_dir=out)
+        assert r1.quad_impl == "panel_gl"
+        # same resolution resumes
+        r2 = run_sweep(base_cfg, self.AXES, static, mesh=mesh8,
+                       chunk_size=16, out_dir=out)
+        assert r2.resumed_chunks == r2.chunks
+        # pinned trapezoid recomputes from scratch
+        r3 = run_sweep(
+            base_cfg, self.AXES, static._replace(quad_panel_gl=False),
+            mesh=mesh8, chunk_size=16, out_dir=out,
+        )
+        assert r3.resumed_chunks == 0
+
+
+class TestDoubleBuffer:
+    def test_overlap_bit_parity_with_serial_loop(self, base_cfg, mesh8):
+        """The double-buffered chunk loop runs the same programs on the
+        same inputs — outputs must be BIT-identical to the serial loop."""
+        static = static_choices_from_config(base_cfg)
+        axes = {"m_chi_GeV": np.geomspace(0.1, 2.0, 24).tolist()}
+        r_ov = run_sweep(base_cfg, axes, static, mesh=mesh8, chunk_size=8)
+        r_ser = run_sweep(base_cfg, axes, static, mesh=mesh8, chunk_size=8,
+                          overlap_chunks=False)
+        for f in r_ov.outputs:
+            np.testing.assert_array_equal(
+                r_ov.outputs[f], r_ser.outputs[f], err_msg=f
+            )
+
+    def test_overlap_parity_with_resume_and_failures(self, base_cfg, mesh8,
+                                                     tmp_path):
+        """Overlap + chunk files + failed points + partial resume all
+        reproduce the serial loop's bookkeeping exactly."""
+        import os
+
+        static = static_choices_from_config(base_cfg)
+        axes = {"incident_flux_scale": [1.07e-9, np.inf] * 6}
+        out = str(tmp_path / "ov")
+        r1 = run_sweep(base_cfg, dict(axes), static, mesh=mesh8,
+                       chunk_size=4, out_dir=out)
+        assert r1.n_failed == 6
+        os.remove(f"{out}/chunk_00001.npz")  # force one recompute
+        r2 = run_sweep(base_cfg, dict(axes), static, mesh=mesh8,
+                       chunk_size=4, out_dir=out)
+        r3 = run_sweep(base_cfg, dict(axes), static, mesh=mesh8,
+                       chunk_size=4, out_dir=str(tmp_path / "ser"),
+                       overlap_chunks=False)
+        assert r2.n_failed == r3.n_failed == 6
+        np.testing.assert_array_equal(r2.failed_mask, r3.failed_mask)
+        np.testing.assert_array_equal(
+            r2.outputs["DM_over_B"], r3.outputs["DM_over_B"]
+        )
+
+
+class TestJitVmapParity:
+    def test_jit_vmap_matches_numpy_scalar_loop(self, base_cfg, table_np):
+        import jax
+        import jax.numpy as jnp
+
+        table_j = make_f_table(base_cfg.I_p, jnp)
+        grid = build_grid(base_cfg, {"m_chi_GeV": np.geomspace(0.1, 10, 8)})
+        fn = jax.jit(jax.vmap(
+            lambda p: integrate_YB_panel_gl(p, "fermion", table_j, jnp),
+            in_axes=(0,),
+        ))
+        got = np.asarray(fn(jax.tree.map(jnp.asarray, grid)))
+        ref = np.array([
+            float(integrate_YB_panel_gl(_point(grid, i), "fermion",
+                                        table_np, np))
+            for i in range(8)
+        ])
+        np.testing.assert_allclose(got, ref, rtol=1e-13)
+
+
+def test_cli_quad_flag_per_point(base_cfg, tmp_path, capsys, monkeypatch):
+    """--quad on routes the per-point CLI through the panel rule; the
+    default invocation stays byte-identical (bit-pinned trapezoid)."""
+    import dataclasses
+    import json
+
+    from bdlz_tpu.cli import main as cli_main
+
+    monkeypatch.chdir(tmp_path)
+    cfg_path = tmp_path / "cfg.json"
+    cfg_path.write_text(json.dumps(dataclasses.asdict(base_cfg)))
+
+    def ratio():
+        capsys.readouterr()  # drop the printed block; read the artifact
+        return float(json.loads(
+            (tmp_path / "yields_out.json").read_text()
+        )["final"]["DM_over_B"])
+
+    cli_main(["--config", str(cfg_path)])
+    r_default = ratio()
+    cli_main(["--config", str(cfg_path), "--quad", "on"])
+    r_gl = ratio()
+    # the default stays on the bit-pinned trapezoid (the archived golden
+    # ratio); --quad on agrees to the panel rule's convergence level
+    assert r_default == pytest.approx(5.6889263349, rel=1e-9)
+    assert r_gl == pytest.approx(r_default, rel=1e-9)
+    assert r_gl != r_default  # a different scheme, not a no-op
+
+
+def test_sweep_cli_quad_flag(base_cfg, tmp_path, capsys):
+    import dataclasses
+    import json
+
+    from bdlz_tpu.sweep_cli import main as sweep_main
+
+    cfg = tmp_path / "cfg.json"
+    cfg.write_text(json.dumps(dataclasses.asdict(base_cfg)))
+    for flag, want in (("auto", "panel_gl"), ("off", "trap"),
+                       ("on", "panel_gl")):
+        sweep_main([
+            "--config", str(cfg),
+            "--axis", "m_chi_GeV=geom:0.1:2:8",
+            "--chunk", "8", "--quad", flag,
+        ])
+        summary = json.loads(
+            capsys.readouterr().out.strip().splitlines()[-1]
+        )
+        assert summary["quad_impl"] == want, flag
+        assert summary["n_quad_nodes"] == (
+            N_PANELS_DEFAULT * NODES_PER_PANEL_DEFAULT
+            if want == "panel_gl" else 8000
+        )

@@ -168,8 +168,13 @@ class TestYieldService:
         from bdlz_tpu.emulator import make_exact_evaluator
 
         art = svc.artifact
+        # at the artifact's FULL recorded scheme — n_y, engine, AND the
+        # resolved y-quadrature the service adopts for its fallback
+        static_art = static_choices_from_config(base)._replace(
+            quad_panel_gl=bool(art.identity.get("quad_panel_gl", False))
+        )
         exact = make_exact_evaluator(
-            base, static_choices_from_config(base),
+            base, static_art,
             n_y=art.identity["n_y"], impl=art.identity["impl"],
             chunk_size=8,
         )({"m_chi_GeV": thetas[1:2, 0], "T_p_GeV": thetas[1:2, 1],
