@@ -132,6 +132,23 @@ class Config:
     # per-point paths keep it OFF.  Explicit True/False overrides both
     # (True skips the audit - the caller asserts convergence).
     quad_panel_gl: Optional[bool] = None
+    # ---- robustness / fault-injection knobs (bdlz_tpu/faults.py,
+    # utils/retry.py; docs/robustness.md) ----
+    # Tri-state gate for deterministic fault injection: None = on iff a
+    # plan is configured (fault_plan or BDLZ_FAULT_PLAN), False = force
+    # off, True = require a plan.  Default: OFF, zero overhead.
+    fault_injection: Optional[bool] = None
+    # The fault plan itself: JSON text or a path to a JSON file (see
+    # faults.FaultPlan); None = no injected faults.
+    fault_plan: Optional[str] = None
+    # Tri-state self-healing gate (ode_* pattern): None = engine decides
+    # (the chunked sweep / serve engines retry-bisect-quarantine, the
+    # bit-pinned per-point paths are unaffected), False = raise-through.
+    retry_enabled: Optional[bool] = None
+    # Bounded-retry budget and base backoff (doubled per retry with
+    # deterministic jitter; tests inject a no-op sleep).
+    retry_max_attempts: int = 3
+    retry_backoff_s: float = 0.05
 
 
 def default_config() -> Dict[str, Any]:
@@ -186,6 +203,17 @@ def write_template(path: str, include_extensions: bool = False) -> None:
 #: the same invariant without invalidating every non-stiff directory.
 RESULT_AFFECTING_EXTENSIONS = ("ode_method", "ode_rtol", "ode_atol")
 
+#: Config fields that must NEVER enter a resume/artifact identity even
+#: when non-default: retry/fault handling is host-side orchestration —
+#: it cannot change a single output bit (with faults disabled, pinned),
+#: and keying it in would stale every sweep manifest / emulator artifact
+#: the moment an operator tunes a retry knob or arms a fault plan.
+#: The StaticChoices twin is ROBUSTNESS_STATIC_FIELDS below.
+ROBUSTNESS_CONFIG_FIELDS = (
+    "fault_injection", "fault_plan", "retry_enabled",
+    "retry_max_attempts", "retry_backoff_s",
+)
+
 
 def config_identity_dict(cfg: Config) -> Dict[str, Any]:
     """The config as a resume-identity payload.
@@ -202,7 +230,7 @@ def config_identity_dict(cfg: Config) -> Dict[str, Any]:
     defaults = default_config()
     out: Dict[str, Any] = {k: getattr(cfg, k) for k in REFERENCE_KEYS}
     for k in defaults:
-        if k in REFERENCE_KEYS:
+        if k in REFERENCE_KEYS or k in ROBUSTNESS_CONFIG_FIELDS:
             continue
         if k in RESULT_AFFECTING_EXTENSIONS or getattr(cfg, k) != defaults[k]:
             out[k] = getattr(cfg, k)
@@ -271,10 +299,19 @@ def validate(cfg: Config, backend: Optional[str] = None) -> Config:
     if not (cfg.ode_rtol > 0.0 and cfg.ode_atol > 0.0):
         raise ConfigError("ode_rtol and ode_atol must be positive")
     for k in ("ode_auto_h0", "ode_pi_controller", "ode_tabulated_av",
-              "quad_panel_gl"):
+              "quad_panel_gl", "fault_injection", "retry_enabled"):
         v = getattr(cfg, k)
         if v is not None and not isinstance(v, bool):
             raise ConfigError(f"{k} must be true, false, or null, got {v!r}")
+    if cfg.retry_max_attempts < 1:
+        raise ConfigError("retry_max_attempts must be >= 1")
+    if cfg.retry_backoff_s < 0.0:
+        raise ConfigError("retry_backoff_s must be >= 0")
+    if cfg.fault_plan is not None and not isinstance(cfg.fault_plan, str):
+        raise ConfigError(
+            f"fault_plan must be JSON text or a file path, got "
+            f"{cfg.fault_plan!r}"
+        )
     return cfg
 
 
@@ -324,6 +361,19 @@ class StaticChoices(NamedTuple):
     # None = per-engine default: the audited sweep/emulator paths resolve
     # it (see Config.quad_panel_gl); bit-pinned paths resolve None -> off.
     quad_panel_gl: Optional[bool] = None
+    # Robustness knobs (see Config): orchestration-only — they change
+    # failure handling, never numerics, so they are EXCLUDED from every
+    # result identity (ROBUSTNESS_STATIC_FIELDS).
+    retry_enabled: Optional[bool] = None
+    fault_injection: Optional[bool] = None
+
+
+#: StaticChoices fields that must NOT enter result identities (emulator
+#: artifact hashes, refcache keys): retry/fault handling is host-side
+#: orchestration — with faults disabled it cannot change a single output
+#: bit, and folding it in would gratuitously invalidate every
+#: pre-existing artifact.
+ROBUSTNESS_STATIC_FIELDS = ("retry_enabled", "fault_injection")
 
 
 def resolve_Y_chi_init(cfg: Config) -> float:
@@ -380,4 +430,6 @@ def static_choices_from_config(cfg: Config) -> StaticChoices:
         ode_pi_controller=cfg.ode_pi_controller,
         ode_tabulated_av=cfg.ode_tabulated_av,
         quad_panel_gl=cfg.quad_panel_gl,
+        retry_enabled=cfg.retry_enabled,
+        fault_injection=cfg.fault_injection,
     )

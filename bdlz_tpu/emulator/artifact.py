@@ -26,7 +26,6 @@ from __future__ import annotations
 import hashlib
 import json
 import os
-import tempfile
 from typing import Any, Dict, List, Mapping, NamedTuple, Sequence, Tuple
 
 import numpy as np  # host-side use only; jitted paths go through the backend.py xp seam (bdlz-lint R1 audit)
@@ -91,12 +90,19 @@ def build_identity(base, static, n_y: int, impl: str) -> Dict[str, Any]:
     is normalized OUT of the static tuple so this key is its single
     home in the identity.
     """
-    from bdlz_tpu.config import config_identity_dict
+    from bdlz_tpu.config import ROBUSTNESS_STATIC_FIELDS, config_identity_dict
 
     quad = static.quad_panel_gl
+    st = static._replace(quad_panel_gl=None)
     out = {
         "base": config_identity_dict(base),
-        "static": list(tuple(static._replace(quad_panel_gl=None))),
+        # robustness knobs (retry/fault gates) are orchestration-only
+        # and excluded: with faults off they cannot change a value bit,
+        # and keying them in would stale every pre-existing artifact
+        "static": [
+            v for f, v in zip(type(st)._fields, st)
+            if f not in ROBUSTNESS_STATIC_FIELDS
+        ],
         "n_y": int(n_y),
         "impl": str(impl),
     }
@@ -225,19 +231,9 @@ def save_artifact(out_dir: str, artifact: EmulatorArtifact) -> str:
         arrays[f"axis_{name}"] = np.asarray(nodes, dtype=np.float64)
     for name, vals in artifact.values.items():
         arrays[f"field_{name}"] = np.asarray(vals, dtype=np.float64)
-    # suffix must end in ".npz" or np.savez APPENDS it and the rename
-    # would ship an empty temp file as the artifact
-    fd, tmp = tempfile.mkstemp(dir=out_dir, suffix=".tmp.npz")
-    os.close(fd)
-    try:
-        np.savez(tmp, **arrays)
-        os.replace(tmp, npz_path)
-    except BaseException:
-        try:
-            os.remove(tmp)
-        except OSError:
-            pass
-        raise
+    from bdlz_tpu.utils.io import atomic_savez
+
+    atomic_savez(npz_path, **arrays)
 
     manifest = dict(artifact.manifest)
     manifest["schema_version"] = SCHEMA_VERSION

@@ -78,6 +78,11 @@ class BuildReport(NamedTuple):
     n_exact_evals: int             # total exact-pipeline points paid
     build_seconds: float
     axis_nodes: Dict[str, int]     # final per-axis node counts
+    #: Probes dropped because their exact evaluation stayed dead after
+    #: the retry budget (infrastructure quarantine, never physics NaN —
+    #: that still aborts the build loudly).  The refinement continues
+    #: around them; the count is mirrored into the artifact manifest.
+    quarantined_probes: int = 0
 
 
 def _axis_nodes(spec: AxisSpec) -> np.ndarray:
@@ -120,13 +125,18 @@ def _draw_probes(
 def _exact_fields(
     base, axes: Mapping[str, np.ndarray], static, *, product: bool,
     mesh, chunk_size: int, n_y: int, impl: str,
+    fault_plan=None, retry=None,
 ) -> Tuple[Dict[str, np.ndarray], int]:
     """Exact pipeline over a product grid via the production sweep engine.
 
-    Returns (field -> flat array in C grid order, n_points).  A failed
-    (non-finite) point inside the requested box is an
-    :class:`EmulatorBuildError`: the emulator masks nothing — a surface
-    with holes must be rebuilt over a domain where the pipeline works.
+    Chunk-level healing (retry → bisect → quarantine) is inherited from
+    ``run_sweep``; transient infrastructure faults therefore cost
+    retries, not the build.  A point that stays failed — physics
+    non-finite OR irreducibly quarantined — is an
+    :class:`EmulatorBuildError`: the table masks nothing — a log-space
+    surface with holes must be rebuilt over a domain where the pipeline
+    works (probes, by contrast, are droppable and tolerate quarantine;
+    see ``build_emulator``).
     """
     from bdlz_tpu.parallel.sweep import run_sweep
 
@@ -134,20 +144,27 @@ def _exact_fields(
     res = run_sweep(
         base, dict(axes), static, mesh=mesh, chunk_size=chunk_size,
         n_y=n_y, out_dir=None, keep_outputs=True, impl=impl,
+        fault_plan=fault_plan, retry=retry,
     )
     n_pts = res.n_points
     if res.n_failed:
         bad = np.argwhere(np.asarray(res.failed_mask))[:, 0]
+        quarantined = (
+            f", {res.n_quarantined} of them infrastructure-quarantined"
+            if res.n_quarantined else ""
+        )
         raise EmulatorBuildError(
             f"{res.n_failed}/{n_pts} exact pipeline points failed "
-            f"(non-finite) inside the emulator box (first flat index "
-            f"{int(bad[0])}); shrink the box or fix the configuration"
+            f"(non-finite) inside the emulator box{quarantined} (first "
+            f"flat index {int(bad[0])}); shrink the box or fix the "
+            "configuration"
         )
     return dict(res.outputs), n_pts
 
 
 def make_exact_evaluator(
     base, static, *, n_y: int, impl: str, mesh=None, chunk_size: int = 2048,
+    retry=None, fault_plan=None, quarantine_sink=None,
 ):
     """Zipped exact-pipeline evaluator through the production engine.
 
@@ -159,6 +176,15 @@ def make_exact_evaluator(
     rejection on top.  The step/aux pairing matches ``run_sweep``'s, so
     emulator refinement compares against exactly the engine that filled
     the table, and chunks are padded to one fixed shape (one compile).
+
+    Robustness seams (all OFF by default — the evaluator stays
+    raise-through for the serve layer, which does its own isolation):
+    with a ``retry`` policy each chunk call is retried with
+    deterministic backoff, and a chunk that stays dead is QUARANTINED —
+    NaN outputs plus a True region in the boolean mask handed to
+    ``quarantine_sink`` after every ``evaluate`` call — instead of
+    killing the caller.  ``fault_plan`` fires injected ``probe`` faults
+    keyed by the evaluator's chunk-call counter.
     """
     import jax
     import jax.numpy as jnp
@@ -167,6 +193,7 @@ def make_exact_evaluator(
     from bdlz_tpu.ops.kjma_table import make_f_table
     from bdlz_tpu.parallel.sweep import _pad_chunk, build_grid, make_sweep_step
     from bdlz_tpu.physics.percolation import make_kjma_grid
+    from bdlz_tpu.utils.retry import call_with_retry
 
     interpret = impl == "pallas" and jax.devices()[0].platform == "cpu"
     step = make_sweep_step(
@@ -182,6 +209,8 @@ def make_exact_evaluator(
     else:
         aux = make_kjma_grid(jnp)
 
+    calls = [0]  # the probe-fault key: one count per chunk dispatch
+
     def evaluate(axes: Mapping[str, Any]) -> Dict[str, np.ndarray]:
         pp = build_grid(base, dict(axes), product=False)
         n = int(np.asarray(pp.m_chi_GeV).shape[0])
@@ -189,11 +218,40 @@ def make_exact_evaluator(
         out: Dict[str, List[np.ndarray]] = {
             f: [] for f in YieldsResult._fields
         }
+        qmask = np.zeros(n, dtype=bool)
         for lo in range(0, n, chunk):
             hi = min(lo + chunk, n)
-            res = step(_pad_chunk(pp, lo, hi, chunk), aux)
+            # the fault key is the LOGICAL chunk call — retries share it,
+            # so a keyed "raise" spec stays persistent across the retry
+            call_idx = calls[0]
+            calls[0] += 1
+
+            def one_chunk(lo=lo, hi=hi, call_idx=call_idx):
+                if fault_plan is not None:
+                    fault_plan.fire("probe", call_idx)
+                res = step(_pad_chunk(pp, lo, hi, chunk), aux)
+                return {
+                    f: np.asarray(getattr(res, f))[: hi - lo]
+                    for f in YieldsResult._fields
+                }
+
+            try:
+                host = (
+                    call_with_retry(one_chunk, retry, label=f"probe{lo}")
+                    if retry is not None else one_chunk()
+                )
+            except Exception:  # noqa: BLE001 — quarantined when allowed
+                if quarantine_sink is None:
+                    raise
+                host = {
+                    f: np.full(hi - lo, np.nan)
+                    for f in YieldsResult._fields
+                }
+                qmask[lo:hi] = True
             for f in YieldsResult._fields:
-                out[f].append(np.asarray(getattr(res, f))[: hi - lo])
+                out[f].append(host[f])
+        if quarantine_sink is not None:
+            quarantine_sink(qmask)
         return {f: np.concatenate(v) for f, v in out.items()}
 
     return evaluate
@@ -339,6 +397,8 @@ def build_emulator(
     out_dir: Optional[str] = None,
     event_log=None,
     require_converged: bool = False,
+    fault_plan=None,
+    retry=None,
 ) -> Tuple[EmulatorArtifact, BuildReport]:
     """Build (and optionally save) an error-controlled yield-surface emulator.
 
@@ -388,6 +448,16 @@ def build_emulator(
     scales: List[str] = [spec[k].scale for k in axis_names]
     rng = np.random.default_rng(seed)
 
+    # Robustness resolution (docs/robustness.md): grid sweeps inherit
+    # chunk-level healing through run_sweep; the probe evaluator gets a
+    # retry + quarantine seam of its own so one dead probe chunk drops
+    # those probes (recorded) instead of killing the build.
+    from bdlz_tpu.faults import FaultPlan
+    from bdlz_tpu.utils.retry import resolve_engine_retry
+
+    faults = FaultPlan.resolve(fault_plan, base)
+    retry_policy = resolve_engine_retry(retry, base, static)
+
     # Resolve the quadrature tri-state ONCE, over the initial tensor
     # grid, and pass the explicit bool to EVERY internal sweep (the
     # initial population, the hyperplane refinements, the probe
@@ -417,6 +487,7 @@ def build_emulator(
     flat, n_exact = _exact_fields(
         base, {k: a for k, a in zip(axis_names, nodes)}, static,
         product=True, mesh=mesh, chunk_size=chunk_size, n_y=n_y, impl=impl,
+        fault_plan=faults, retry=retry_policy,
     )
     values = {f: np.asarray(flat[f]).reshape(grid_shape()) for f in FIELDS}
     _check_positive(values)
@@ -424,26 +495,37 @@ def build_emulator(
 
     # ONE compiled probe evaluator for every refinement round and the
     # held-out pass (re-building it per round would re-jit per round)
+    qsink: List[np.ndarray] = []
     exact_eval = make_exact_evaluator(
         base, static, n_y=n_y, impl=impl, mesh=mesh,
         chunk_size=min(int(chunk_size), int(n_probe)),
+        retry=retry_policy, fault_plan=faults,
+        quarantine_sink=qsink.append,
     )
+    n_quarantined_probes = 0
 
     def exact_zip(axes):
+        qsink.clear()
         flat = exact_eval(axes)
+        q = (
+            qsink[-1] if qsink
+            else np.zeros(len(next(iter(flat.values()))), dtype=bool)
+        )
         # every SCORED field must be finite, not just the ratio: a probe
         # whose rho overflows while DM_over_B stays finite would
         # otherwise NaN its error score, and NaN > tol is False — the
-        # probe would silently pass and the build falsely converge
+        # probe would silently pass and the build falsely converge.
+        # Quarantined probes are exempt: infrastructure failure is the
+        # CALLER's droppable case, physics NaN stays fatal.
         for fname in FIELDS:
-            bad = ~np.isfinite(flat[fname])
+            bad = ~np.isfinite(flat[fname]) & ~q
             if bad.any():
                 raise EmulatorBuildError(
                     f"{int(bad.sum())}/{len(bad)} exact probe points have "
                     f"non-finite {fname} inside the emulator box; shrink "
                     "the box or fix the configuration"
                 )
-        return flat
+        return flat, q
 
     # The probe POOL accumulates across rounds: every probe's exact value
     # is paid once and cached, and convergence means the WHOLE pool is
@@ -458,14 +540,28 @@ def build_emulator(
     for r in range(int(max_rounds) + 1):
         probe_cols = _draw_probes(spec, int(n_probe), rng)
         probes = np.stack([probe_cols[k] for k in axis_names], axis=1)
-        exact = exact_zip(probe_cols)
+        exact, q_probe = exact_zip(probe_cols)
         n_exact += int(n_probe)
+        if q_probe.any():
+            # tolerate quarantined probes: they never enter the pool (a
+            # NaN exact value cannot steer refinement), the build keeps
+            # refining around them, and the drop is recorded
+            n_quarantined_probes += int(q_probe.sum())
+            probes = probes[~q_probe]
+            exact = {f: exact[f][~q_probe] for f in FIELDS}
         pool_probes = np.concatenate([pool_probes, probes])
         for f in FIELDS:
             pool_exact[f] = np.concatenate([pool_exact[f], exact[f]])
-        emu = _emulated_fields(nodes, scales, log_values, pool_probes)
-        errs = _probe_errors(emu, pool_exact)
-        failing = np.flatnonzero(errs > refine_tol)
+        if pool_probes.shape[0]:
+            emu = _emulated_fields(nodes, scales, log_values, pool_probes)
+            errs = _probe_errors(emu, pool_exact)
+            failing = np.flatnonzero(errs > refine_tol)
+        else:
+            # every probe so far was infrastructure-quarantined: nothing
+            # to score this round (and nothing to converge on — the
+            # convergence test below requires a non-empty pool)
+            errs = np.zeros(0)
+            failing = np.zeros(0, dtype=np.int64)
 
         # Curvature-driven split candidates (sup-norm control): every
         # interval whose a-posteriori estimate exceeds the internal
@@ -493,12 +589,12 @@ def build_emulator(
             "pool_size": int(pool_probes.shape[0]),
             "n_failing": int(len(failing)),
             "n_est_splits": sum(len(v) for v in curv.values()),
-            "max_rel_err": float(errs.max()),
+            "max_rel_err": float(errs.max(initial=0.0)),
             "grid_shape": list(grid_shape()),
         }
         if event_log is not None:
             event_log.emit("emulator_refine_round", **row)
-        if not len(failing) and not curv:
+        if pool_probes.shape[0] and not len(failing) and not curv:
             rounds.append(row)
             converged = True
             break
@@ -537,6 +633,15 @@ def build_emulator(
             for _, mid in sorted(cands, reverse=True)[: max(room, 0)]:
                 inserts.setdefault(k, set()).add(mid)
         if not inserts:
+            if not pool_probes.shape[0]:
+                # nothing split AND nothing scored (every probe so far
+                # quarantined): keep drawing — a later round's probes
+                # may land after the infrastructure recovers
+                rounds.append({
+                    **row,
+                    "note": "pool empty (probes quarantined); redrawing",
+                })
+                continue
             rounds.append({**row, "note": "no refinable interval left"})
             break
 
@@ -551,6 +656,7 @@ def build_emulator(
             flat, n_new = _exact_fields(
                 base, axes_eval, static, product=True, mesh=mesh,
                 chunk_size=chunk_size, n_y=n_y, impl=impl,
+                fault_plan=faults, retry=retry_policy,
             )
             n_exact += n_new
             slab_shape = tuple(
@@ -576,8 +682,18 @@ def build_emulator(
         spec, n_holdout, np.random.default_rng(seed + 10_000)
     )
     held = np.stack([held_cols[k] for k in axis_names], axis=1)
-    exact = exact_zip(held_cols)
+    exact, q_held = exact_zip(held_cols)
     n_exact += n_holdout
+    if q_held.any():
+        n_quarantined_probes += int(q_held.sum())
+        held = held[~q_held]
+        exact = {f: exact[f][~q_held] for f in FIELDS}
+        if held.shape[0] == 0:
+            raise EmulatorBuildError(
+                "every held-out probe was infrastructure-quarantined; "
+                "the recorded max_rel_err would be meaningless — fix the "
+                "environment and rebuild"
+            )
     held_errs = _probe_errors(
         _emulated_fields(nodes, scales, log_values, held), exact
     )
@@ -600,6 +716,7 @@ def build_emulator(
         n_exact_evals=int(n_exact),
         build_seconds=round(seconds, 3),
         axis_nodes={k: len(a) for k, a in zip(axis_names, nodes)},
+        quarantined_probes=int(n_quarantined_probes),
     )
     artifact = EmulatorArtifact(
         axis_names=tuple(axis_names),
@@ -614,6 +731,7 @@ def build_emulator(
             "refinement_rounds": len(rounds),
             "build_seconds": report.build_seconds,
             "n_exact_evals": report.n_exact_evals,
+            "quarantined_probes": int(n_quarantined_probes),
             "axis_scales": {k: spec[k].scale for k in axis_names},
             "domain": {
                 k: [float(a[0]), float(a[-1])]
@@ -625,6 +743,7 @@ def build_emulator(
         event_log.emit(
             "emulator_build_done", converged=bool(converged),
             max_rel_err=max_rel_err, n_exact_evals=n_exact,
+            quarantined_probes=int(n_quarantined_probes),
             seconds=report.build_seconds,
             grid_shape=list(grid_shape()),
         )

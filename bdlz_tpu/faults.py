@@ -1,0 +1,260 @@
+"""Deterministic fault injection for the sweep/serve execution stack.
+
+At production scale partial failure is the steady state: an XLA error,
+an OOM'd compile, device loss, or torn storage must cost the points it
+actually poisoned, not the whole sweep or serve batch.  The healing
+machinery that guarantees that (retry → bisect → quarantine in
+``parallel/sweep.py``; per-request isolation + deadlines in ``serve/``)
+is only trustworthy if its failure paths are *exercised* — so this
+module provides the failures, deterministically.
+
+A :class:`FaultPlan` is a list of :class:`FaultSpec` entries keyed on
+``(site, index)``; every decision is a pure host-side function of the
+plan (plus a per-spec fire counter for transient faults), so a plan
+resolved from the same config/env is IDENTICAL on every process of a
+multi-controller run — injected faults can never make the fleet diverge
+on which jitted shapes it launches.
+
+Sites and what their keys mean:
+
+``step``
+    The sweep engine's per-chunk dispatch.  ``key`` = chunk index for
+    kinds ``raise`` (persistent) / ``transient`` (fails ``times``
+    attempts, then recovers); ``point`` = *global* flat grid index for
+    kinds ``poison`` (the dispatch raises whenever the evaluated range
+    contains the point — what the bisect isolates) and ``nan`` (the
+    point's outputs are NaN-poisoned after a successful step — flows
+    into the ordinary physics failure mask).
+``chunk_write``
+    Chunk ``.npz`` persistence; ``key`` = chunk index; kind ``torn``
+    truncates the file AFTER the (atomic) write — simulating storage
+    corruption the resume path must detect-and-recompute.
+``probe``
+    The emulator's exact probe evaluator; ``key`` = evaluator call
+    counter (kinds ``raise``/``transient``).
+``serve_exact``
+    The serve stack's exact out-of-domain fallback; ``key`` = fallback
+    call counter (kinds ``raise``/``transient``).
+``clock``
+    Slow collections: :meth:`FaultPlan.delay_s` reports seconds a call
+    site should add through its *injectable* clock/sleep seam (kind
+    ``slow``); tier-1 never really sleeps.
+
+Resolution (:meth:`FaultPlan.resolve`) follows the tri-state knob
+pattern: ``Config.fault_injection`` ``None`` enables injection iff a
+plan is configured (``Config.fault_plan`` or the ``BDLZ_FAULT_PLAN``
+env var — a JSON string or a path to one); ``False`` forces it off;
+``True`` requires a plan.  The default is **off** with zero overhead:
+every call-site hook is guarded on ``plan is not None``.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, NamedTuple, Optional
+
+VALID_SITES = ("step", "chunk_write", "probe", "serve_exact", "clock")
+VALID_KINDS = ("raise", "transient", "poison", "nan", "torn", "slow")
+
+#: Env var a plan is resolved from when neither the caller nor the
+#: config carries one (JSON text, or a path to a JSON file).
+FAULT_PLAN_ENV = "BDLZ_FAULT_PLAN"
+
+
+class FaultError(RuntimeError):
+    """An injected (non-transient) infrastructure fault."""
+
+
+class TransientFaultError(FaultError):
+    """An injected fault that recovers after its ``times`` budget."""
+
+
+class FaultPlanError(ValueError):
+    """A malformed fault plan (unknown site/kind, missing keys)."""
+
+
+class FaultSpec(NamedTuple):
+    """One injected fault: where it fires, how, and how often."""
+
+    site: str
+    kind: str
+    key: Optional[int] = None     # chunk/call index; None = every index
+    point: Optional[int] = None   # global point index (poison/nan kinds)
+    times: Optional[int] = None   # transient budget; None = persistent
+    delay_s: float = 0.0          # kind "slow"
+
+
+def _spec_from_obj(obj: Dict[str, Any]) -> FaultSpec:
+    site = obj.get("site")
+    kind = obj.get("kind")
+    if site not in VALID_SITES:
+        raise FaultPlanError(
+            f"fault site {site!r} is not one of {VALID_SITES}"
+        )
+    if kind not in VALID_KINDS:
+        raise FaultPlanError(
+            f"fault kind {kind!r} is not one of {VALID_KINDS}"
+        )
+    if kind in ("poison", "nan") and obj.get("point") is None:
+        raise FaultPlanError(f"kind {kind!r} needs a 'point' (global index)")
+    if kind == "transient" and obj.get("times") is None:
+        raise FaultPlanError("kind 'transient' needs 'times' (fail budget)")
+    known = {"site", "kind", "key", "point", "times", "delay_s", "chunk",
+             "call"}
+    unknown = sorted(set(obj) - known)
+    if unknown:
+        raise FaultPlanError(f"unknown fault-spec key(s) {unknown}")
+    key = obj.get("key", obj.get("chunk", obj.get("call")))
+    return FaultSpec(
+        site=site,
+        kind=kind,
+        key=None if key is None else int(key),
+        point=None if obj.get("point") is None else int(obj["point"]),
+        times=None if obj.get("times") is None else int(obj["times"]),
+        delay_s=float(obj.get("delay_s", 0.0)),
+    )
+
+
+class FaultPlan:
+    """A deterministic set of injected faults (see module docstring)."""
+
+    def __init__(self, specs: List[FaultSpec]):
+        self.specs = list(specs)
+        # per-spec fire counters, the ONLY mutable state: transient
+        # faults stop firing once their budget is spent.  Counters are
+        # advanced identically on every process (same plan, same call
+        # sequence), so the fleet stays in lockstep.
+        self._fired = [0] * len(self.specs)
+
+    # ---- construction -----------------------------------------------
+
+    @classmethod
+    def from_obj(cls, obj: Any) -> "FaultPlan":
+        if isinstance(obj, dict):
+            obj = obj.get("faults", [])
+        if not isinstance(obj, list):
+            raise FaultPlanError(
+                "fault plan must be a list of specs or {'faults': [...]}"
+            )
+        return cls([_spec_from_obj(dict(s)) for s in obj])
+
+    @classmethod
+    def from_json(cls, text_or_path: str) -> "FaultPlan":
+        """Parse a plan from JSON text, or from a path to a JSON file."""
+        text = text_or_path
+        if not text_or_path.lstrip().startswith(("{", "[")):
+            with open(text_or_path, "r", encoding="utf-8") as f:
+                text = f.read()
+        try:
+            return cls.from_obj(json.loads(text))
+        except json.JSONDecodeError as exc:
+            raise FaultPlanError(f"fault plan is not valid JSON: {exc}") from exc
+
+    @classmethod
+    def resolve(cls, explicit=None, base=None) -> "Optional[FaultPlan]":
+        """Tri-state resolution: explicit ▸ config ▸ env; default OFF.
+
+        ``explicit`` may be a FaultPlan, a JSON string/path, or None.
+        ``base`` (a Config) contributes ``fault_injection`` (tri-state
+        gate) and ``fault_plan`` (JSON string/path).  Returns ``None``
+        when injection is disabled — the call sites' zero-overhead path.
+        """
+        gate = None if base is None else getattr(base, "fault_injection", None)
+        if gate is False:
+            return None
+        plan = explicit
+        if plan is None and base is not None:
+            plan = getattr(base, "fault_plan", None)
+        if plan is None:
+            plan = os.environ.get(FAULT_PLAN_ENV) or None
+        if isinstance(plan, str):
+            plan = cls.from_json(plan)
+        if gate is True and plan is None:
+            raise FaultPlanError(
+                "fault_injection=true but no fault plan is configured "
+                f"(set fault_plan or {FAULT_PLAN_ENV})"
+            )
+        return plan
+
+    # ---- decision hooks (all host-side, all deterministic) ----------
+
+    def _matches(self, spec: FaultSpec, site: str, key: int) -> bool:
+        return spec.site == site and (spec.key is None or spec.key == int(key))
+
+    def fire(self, site: str, key: int) -> None:
+        """Raise if a ``raise``/``transient`` spec matches (site, key)."""
+        for i, spec in enumerate(self.specs):
+            if spec.kind not in ("raise", "transient"):
+                continue
+            if not self._matches(spec, site, key):
+                continue
+            if spec.kind == "transient":
+                if self._fired[i] >= int(spec.times):
+                    continue  # budget spent: recovered
+                self._fired[i] += 1
+                raise TransientFaultError(
+                    f"injected transient fault at {site}[{key}] "
+                    f"({self._fired[i]}/{spec.times})"
+                )
+            raise FaultError(f"injected fault at {site}[{key}]")
+
+    def check_range(self, site: str, lo: int, hi: int) -> None:
+        """Raise if a ``poison`` point lies inside [lo, hi) — the hook the
+        bisect drives down to the irreducible point."""
+        for spec in self.specs:
+            if spec.site == site and spec.kind == "poison":
+                p = int(spec.point)
+                if lo <= p < hi:
+                    raise FaultError(
+                        f"injected poison point {p} in {site}[{lo}:{hi}]"
+                    )
+
+    def nan_points(self, site: str, lo: int, hi: int) -> List[int]:
+        """Global indices in [lo, hi) whose outputs should be NaN-poisoned."""
+        return sorted(
+            int(spec.point)
+            for spec in self.specs
+            if spec.site == site and spec.kind == "nan"
+            and lo <= int(spec.point) < hi
+        )
+
+    def corrupt_file(self, site: str, key: int, path: str) -> bool:
+        """Tear ``path`` (truncate to half) if a ``torn`` spec matches.
+
+        Fires once per spec (a torn file stays torn; re-tearing every
+        rewrite would make recompute-on-resume unable to heal it).
+        Returns True when the file was torn.
+        """
+        for i, spec in enumerate(self.specs):
+            if spec.kind != "torn" or not self._matches(spec, site, key):
+                continue
+            if self._fired[i]:
+                continue
+            self._fired[i] += 1
+            size = os.path.getsize(path)
+            with open(path, "r+b") as f:
+                f.truncate(max(size // 2, 1))
+            return True
+        return False
+
+    def delay_s(self, site: str, key: int) -> float:
+        """Seconds a ``slow`` spec injects at (site, key) — to be applied
+        through the call site's injectable clock/sleep, never a real sleep."""
+        total = 0.0
+        for spec in self.specs:
+            if spec.kind == "slow" and self._matches(spec, site, key):
+                total += float(spec.delay_s)
+        return total
+
+    def describe(self) -> List[Dict[str, Any]]:
+        """The plan as plain dicts (event logs, bench JSON)."""
+        out = []
+        for spec in self.specs:
+            d: Dict[str, Any] = {"site": spec.site, "kind": spec.kind}
+            for k in ("key", "point", "times"):
+                if getattr(spec, k) is not None:
+                    d[k] = getattr(spec, k)
+            if spec.delay_s:
+                d["delay_s"] = spec.delay_s
+            out.append(d)
+        return out

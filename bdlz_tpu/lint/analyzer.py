@@ -79,6 +79,15 @@ STATIC_PARAM_NAMES = {
     "n_panels",
     "scheme",
     "tabulated",
+    # robustness knobs (bdlz_tpu/faults.py, utils/retry.py): plans and
+    # policies are host-side orchestration objects, never tracer-valued.
+    # Deliberately only the SPECIFIC knob names — generic words like
+    # "policy" or "retry" would exempt unrelated future parameters from
+    # the tracer rules.
+    "fault_plan",
+    "fault_injection",
+    "retry_enabled",
+    "retry_policy",
     "n_y",
     "nz",
     "n_mu",

@@ -363,8 +363,10 @@ def main(argv=None) -> None:
             )
     if args.out:
         if is_coordinator():
-            np.savez(args.out, chain=full_chain, logp=full_logp,
-                     param_names=list(params))
+            from bdlz_tpu.utils.io import atomic_savez
+
+            atomic_savez(args.out, chain=full_chain, logp=full_logp,
+                         param_names=list(params))
         summary["out"] = args.out
     if is_coordinator():
         print(json.dumps(summary))

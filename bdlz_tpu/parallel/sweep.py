@@ -548,12 +548,23 @@ class SweepResult:
     #: Integrand evaluations per point of that scheme (n_panels·n_nodes
     #: for panel_gl, the floored n_y for trap, None for the stiff engines).
     n_quad_nodes: Optional[int] = None
+    #: Points quarantined by the self-healing path (persistent chunk
+    #: failure bisected down to the irreducible sub-range): their outputs
+    #: are NaN and they are COUNTED INSIDE ``n_failed`` too — quarantine
+    #: extends the physics failure mask to infrastructure failures.
+    n_quarantined: int = 0
+    #: Chunk re-dispatches the healing path paid (retries + bisect probes).
+    n_retries: int = 0
     outputs: Optional[Dict[str, np.ndarray]] = field(default=None, repr=False)
     #: Per-point failure mask (True = non-finite output, masked out), full
     #: grid order — not just the count, so callers can locate *which*
     #: parameter corners failed (SURVEY §5 mask-and-report).  None only
     #: when resumed chunks' files were unavailable for mask recovery.
     failed_mask: Optional[np.ndarray] = field(default=None, repr=False)
+    #: Per-point quarantine mask (True = infrastructure quarantine, a
+    #: subset of ``failed_mask``), full grid order; None when resumed
+    #: chunks' files were unavailable for mask recovery.
+    quarantined_mask: Optional[np.ndarray] = field(default=None, repr=False)
 
 
 def _pad_chunk(pp: PointParams, lo: int, hi: int, chunk: int) -> PointParams:
@@ -585,6 +596,8 @@ def run_sweep(
     lz_method: str = "local",
     lz_gamma_phi: float = 0.0,
     overlap_chunks: bool = True,
+    fault_plan=None,
+    retry=None,
 ) -> SweepResult:
     """Run a full sweep: grid build → per-chunk jitted sharded evaluation →
     (optional) chunk files + manifest with resume.
@@ -617,6 +630,24 @@ def run_sweep(
     Bit-identical to the serial loop (same programs, same inputs;
     pinned in tests); automatically disabled when profiling
     (``trace_dir``) or on the host-orchestrated esdirk engine.
+
+    **Self-healing** (docs/robustness.md): when the resolved retry
+    policy is enabled (``retry_enabled`` tri-state, default ON here), a
+    chunk whose step/collect *raises* is retried with bounded
+    deterministic backoff; a persistent failure is bisected — always at
+    the sweep's one padded chunk shape, so no new jitted program is
+    ever introduced — and the irreducible points are quarantined into
+    the failure mask (NaN outputs, ``chunk_retry``/``chunk_quarantine``
+    events, ``quarantined`` key in the resume manifest).  Attempt
+    outcomes are fleet-agreed (``allreduce_min``, identity
+    single-process) so multi-controller processes follow one retry/
+    bisect plan, exactly like the broadcast resume plan; the double
+    buffer drains to serial during healing to preserve collection
+    order.  ``fault_plan`` / ``Config.fault_plan`` /
+    ``BDLZ_FAULT_PLAN`` inject deterministic faults
+    (:mod:`bdlz_tpu.faults`) to exercise all of this; disabled (the
+    default) every hook is skipped and behavior is byte-identical to
+    the unhealed engine.
     """
     import jax
     import jax.numpy as jnp
@@ -624,6 +655,17 @@ def run_sweep(
     from bdlz_tpu.models.yields_pipeline import YieldsResult
     from bdlz_tpu.ops.kjma_table import make_f_table
     from bdlz_tpu.physics.percolation import make_kjma_grid
+
+    # Robustness resolution (docs/robustness.md): the fault plan defaults
+    # OFF (explicit arg ▸ config ▸ BDLZ_FAULT_PLAN env) and the retry
+    # tri-state resolves to ON in this chunked engine; both are pure
+    # host-side functions of config/env, so every multi-controller
+    # process resolves identically without a broadcast.
+    from bdlz_tpu.faults import FaultPlan
+    from bdlz_tpu.utils.retry import backoff_delay, resolve_engine_retry
+
+    faults = FaultPlan.resolve(fault_plan, base)
+    retry_policy = resolve_engine_retry(retry, base, static)
 
     # With a profile the config's P is irrelevant (and may be None — the
     # natural way to use --lz-profile); give build_grid a placeholder that
@@ -922,6 +964,16 @@ def run_sweep(
         # numerical engine.
         hash_extra = dict(hash_extra or {})
         hash_extra["esdirk"] = {"strategy": "repack", **esdirk_knobs}
+    if faults is not None:
+        # An ARMED fault plan joins the identity: nan/poison injection
+        # changes the bits a chaos run writes into its chunk files, so a
+        # chaos directory must never be silently resumed by a clean run
+        # (or vice versa).  Omit-at-default — no plan, no key — so every
+        # clean sweep's hash is byte-identical to pre-robustness; the
+        # retry_* knobs stay excluded (orchestration cannot change
+        # output bits).
+        hash_extra = dict(hash_extra or {})
+        hash_extra["fault_plan"] = faults.describe()
     if quad_on:
         # The RESOLVED quadrature joins the identity (same reasoning as
         # the esdirk knobs): panel-GL and trapezoid chunks agree only to
@@ -976,8 +1028,10 @@ def run_sweep(
     # done if its .npz is present AND loadable; otherwise it is recomputed
     # with a warning instead of crashing the sweep (mask-and-report
     # extends to our own storage failures).
-    plan = np.zeros((n_chunks, 2), dtype=np.int64)  # [done, prior_n_failed]
+    # [done, prior_n_failed, prior_n_quarantined]
+    plan = np.zeros((n_chunks, 3), dtype=np.int64)
     mask_cache: Dict[int, np.ndarray] = {}  # validated masks, avoids re-reads
+    q_cache: Dict[int, np.ndarray] = {}     # quarantine masks, same lifetime
     if coordinator and manifest.get("chunks"):
         for ci in range(n_chunks):
             rec = manifest["chunks"].get(str(ci))
@@ -990,6 +1044,10 @@ def run_sweep(
                         data["failed"] if "failed" in data.files
                         else ~np.isfinite(data["DM_over_B"])
                     )
+                    qm = (
+                        data["quarantined"] if "quarantined" in data.files
+                        else None
+                    )
             except Exception as exc:
                 print(
                     f"[sweep] resume: chunk {ci} listed in manifest but "
@@ -999,13 +1057,22 @@ def run_sweep(
                 del manifest["chunks"][str(ci)]
                 continue
             mask_cache[ci] = np.asarray(mask, dtype=bool)
-            plan[ci] = (1, int(rec["n_failed"]))
+            q_cache[ci] = (
+                np.asarray(qm, dtype=bool) if qm is not None
+                else np.zeros(mask_cache[ci].shape, dtype=bool)
+            )
+            plan[ci] = (
+                1, int(rec["n_failed"]), int(rec.get("n_quarantined", 0)),
+            )
     plan = broadcast_from_coordinator(plan)
 
     fields = YieldsResult._fields
     collected = {f: [] for f in fields} if keep_outputs else None
     masks: Optional[list] = []
+    qmasks: Optional[list] = []
     n_failed = 0
+    n_quarantined = 0
+    n_retries = 0
     resumed = 0
     t0 = time.time()
 
@@ -1035,8 +1102,139 @@ def run_sweep(
         )
         return {f: full[f][: entry["n_valid"]] for f in fields}
 
+    # ---- self-healing machinery (retry → bisect → quarantine) --------
+    # Engaged ONLY when a chunk attempt raises (or a fault hook fires):
+    # the healthy path below is untouched, so with healing idle the
+    # sweep's outputs are byte-identical to the unhealed engine.
+    heal_on = retry_policy is not None
+    multiproc = jax.process_count() > 1
+
+    def _agree_ok(ok_local: int) -> int:
+        # Attempt-outcome agreement: injected faults are deterministic
+        # and identical fleet-wide, but a REAL infra failure could be
+        # one-sided — min() makes every process adopt the most
+        # conservative outcome, so the retry/bisect plan (like the
+        # resume plan) is one plan, fleet-wide.  Identity
+        # single-process: zero cost on the common path.
+        if not multiproc:
+            return int(ok_local)
+        from bdlz_tpu.parallel.multihost import allreduce_min
+
+        return int(np.asarray(allreduce_min(
+            np.array([ok_local], dtype=np.int64)
+        ))[0])
+
+    def _apply_nan_faults(host, lo_r, hi_r):
+        pts = (
+            faults.nan_points("step", lo_r, hi_r)
+            if faults is not None else []
+        )
+        if pts:
+            for f in fields:
+                arr = np.array(host[f])  # gathered views are read-only
+                for p in pts:
+                    arr[p - lo_r] = np.nan
+                host[f] = arr
+        return host
+
+    def _attempt_range(ci, lo_r, hi_r):
+        """One dispatch+gather attempt over [lo_r, hi_r), padded to the
+        sweep's ONE chunk shape — retries and bisect halves launch the
+        same jitted program, so healing can never introduce a shape the
+        fleet did not already agree on."""
+        ok, host, err = 1, None, None
+        try:
+            if faults is not None:
+                faults.fire("step", ci)
+                faults.check_range("step", lo_r, hi_r)
+            ppc = _pad_chunk(pp_all, lo_r, hi_r, chunk_size)
+            if mesh is not None:
+                from bdlz_tpu.parallel.mesh import batch_sharding
+                from bdlz_tpu.parallel.multihost import shard_global_chunk
+
+                ppc = shard_global_chunk(ppc, batch_sharding(mesh))
+            res = step(ppc, aux)
+            full = gather_to_host({f: getattr(res, f) for f in fields})
+            host = {f: full[f][: hi_r - lo_r] for f in fields}
+        except Exception as exc:  # noqa: BLE001 — healing path decides
+            ok, err = 0, exc
+        return _agree_ok(ok), host, err
+
+    def _quarantine_range(ci, lo_r, hi_r, err):
+        if event_log is not None:
+            event_log.emit(
+                "chunk_quarantine", chunk=ci, lo=lo_r, hi=hi_r,
+                n_points=hi_r - lo_r, error=repr(err),
+            )
+        return (
+            {f: np.full(hi_r - lo_r, np.nan) for f in fields},
+            np.ones(hi_r - lo_r, dtype=bool),
+        )
+
+    def _heal_budget(n: int) -> int:
+        """Attempt budget for healing one chunk: enough to retry and to
+        bisect-isolate a handful of poison points (each isolation costs
+        ~log2(n) probes), but BOUNDED — a chunk where *everything* fails
+        persistently (config bug, dead device) must wholesale-quarantine
+        after O(log n) probes, not grind through O(n) full-chunk
+        re-executions that would turn a seconds-long crash into hours."""
+        attempts = max(int(retry_policy.max_attempts), 1)
+        return attempts * 4 * (1 + max(int(n) - 1, 1).bit_length())
+
+    def _heal_range(ci, lo_r, hi_r, first_err, budget):
+        """Bounded retry with deterministic backoff; persistent failure
+        bisects (surviving halves kept) down to the irreducible points,
+        which are quarantined into the failure mask.  ``budget`` is a
+        1-element list of remaining attempts shared across the chunk's
+        whole heal tree; exhaustion quarantines the range wholesale."""
+        nonlocal n_retries
+        err = first_err
+        attempts = max(int(retry_policy.max_attempts), 1)
+        for attempt in range(1, attempts):
+            if budget[0] <= 0:
+                break
+            if event_log is not None:
+                event_log.emit(
+                    "chunk_retry", chunk=ci, lo=lo_r, hi=hi_r,
+                    attempt=attempt, error=repr(err),
+                )
+            retry_policy.sleep(
+                backoff_delay(retry_policy, f"chunk{ci}:{lo_r}", attempt - 1)
+            )
+            n_retries += 1
+            budget[0] -= 1
+            ok, host, err2 = _attempt_range(ci, lo_r, hi_r)
+            if ok:
+                return (
+                    _apply_nan_faults(host, lo_r, hi_r),
+                    np.zeros(hi_r - lo_r, dtype=bool),
+                )
+            err = err2 if err2 is not None else err
+        if hi_r - lo_r <= 1 or budget[0] <= 0:
+            return _quarantine_range(ci, lo_r, hi_r, err)
+        mid = lo_r + (hi_r - lo_r) // 2
+        parts = []
+        for a, b in ((lo_r, mid), (mid, hi_r)):
+            if budget[0] <= 0:
+                parts.append(_quarantine_range(ci, a, b, err))
+                continue
+            n_retries += 1
+            budget[0] -= 1
+            ok, host, err_h = _attempt_range(ci, a, b)
+            if ok:
+                parts.append((
+                    _apply_nan_faults(host, a, b),
+                    np.zeros(b - a, dtype=bool),
+                ))
+            else:
+                parts.append(_heal_range(ci, a, b, err_h, budget))
+        return (
+            {f: np.concatenate([p[0][f] for p in parts]) for f in fields},
+            np.concatenate([p[1] for p in parts]),
+        )
+
     def _collect() -> None:
-        nonlocal inflight, n_failed
+        nonlocal inflight, n_failed, n_quarantined
         if inflight is None:
             return
         entry, inflight = inflight, None
@@ -1045,13 +1243,35 @@ def run_sweep(
         # the host-side IO below stays OUTSIDE the window as before
         host = entry.get("host")
         if host is None:
-            host = _gather(entry)
+            collect_err = None
+            try:
+                host = _gather(entry)
+            except Exception as exc:  # noqa: BLE001 — healed below
+                if not heal_on:
+                    raise
+                collect_err = exc
+            if heal_on and multiproc:
+                ok = _agree_ok(0 if collect_err is not None else 1)
+                if ok == 0 and collect_err is None:
+                    collect_err = RuntimeError(
+                        "chunk gather failed on another process"
+                    )
+            if collect_err is not None:
+                host, entry["qmask"] = _heal_range(
+                    entry["ci"], entry["lo"], entry["hi"], collect_err,
+                    [_heal_budget(entry["hi"] - entry["lo"])],
+                )
+        host = _apply_nan_faults(host, entry["lo"], entry["hi"])
+        q = entry.get("qmask")
+        if q is None:
+            q = np.zeros(entry["n_valid"], dtype=bool)
+        n_quarantined += int(q.sum())
         bad = ~np.isfinite(host["DM_over_B"])
         n_failed += int(bad.sum())
         if event_log is not None:
             event_log.emit(
                 "chunk_done", chunk=entry["ci"], n_valid=entry["n_valid"],
-                n_failed=int(bad.sum()),
+                n_failed=int(bad.sum()), n_quarantined=int(q.sum()),
                 seconds=round(time.time() - entry["t0"], 4),
             )
             while _esdirk_stats_holder:
@@ -1063,21 +1283,44 @@ def run_sweep(
         else:
             _esdirk_stats_holder.clear()
         if entry["file"] and coordinator:
-            from bdlz_tpu.utils.io import atomic_write_json
+            from bdlz_tpu.utils.io import atomic_savez, atomic_write_json
 
-            np.savez(entry["file"], **host, failed=bad)
-            manifest["chunks"][str(entry["ci"])] = {
+            # atomic (mkstemp + replace): a crash mid-savez can never
+            # leave a torn chunk file that resume must detect-and-
+            # recompute; quarantine info rides the file only when
+            # present so clean-sweep chunk files keep their old layout
+            extra = {"quarantined": q} if q.any() else {}
+            atomic_savez(entry["file"], **host, failed=bad, **extra)
+            rec = {
                 "file": entry["file"],
                 "n_valid": entry["n_valid"],
                 "n_failed": int(bad.sum()),
             }
+            if q.any():
+                rec["n_quarantined"] = int(q.sum())
+                # in-chunk indices for operators, capped: a wholesale-
+                # quarantined 4096-point chunk must not bloat a manifest
+                # that is atomically rewritten after every chunk (the
+                # authoritative per-point mask lives in the .npz)
+                idx = np.flatnonzero(q)
+                if len(idx) <= 128:
+                    rec["quarantined"] = [int(i) for i in idx]
+                else:
+                    rec["quarantined_truncated"] = True
+            manifest["chunks"][str(entry["ci"])] = rec
             # atomic: a crash mid-write must not corrupt resume state
             atomic_write_json(manifest_path, manifest)
+            if faults is not None:
+                # torn-storage injection AFTER the atomic write: the
+                # resume path must detect the truncated zip and recompute
+                faults.corrupt_file("chunk_write", entry["ci"], entry["file"])
         if keep_outputs:
             for f in fields:
                 collected[f].append(host[f])
         if masks is not None:
             masks.append(bad)
+        if qmasks is not None:
+            qmasks.append(q)
 
     for ci in range(n_chunks):
         lo, hi = ci * chunk_size, min((ci + 1) * chunk_size, n_total)
@@ -1088,10 +1331,14 @@ def run_sweep(
             _collect()  # keep collected/masks appends in chunk order
             resumed += 1
             n_failed += int(plan[ci, 1])
+            n_quarantined += int(plan[ci, 2])
             if masks is not None and ci in mask_cache:
                 masks.append(mask_cache[ci])
+            if qmasks is not None and ci in q_cache:
+                qmasks.append(q_cache[ci])
             need_mask = masks is not None and ci not in mask_cache
-            if chunk_file and (keep_outputs or need_mask):
+            need_q = qmasks is not None and ci not in q_cache
+            if chunk_file and (keep_outputs or need_mask or need_q):
                 try:
                     with np.load(chunk_file) as data:
                         if keep_outputs:
@@ -1103,6 +1350,12 @@ def run_sweep(
                                 else ~np.isfinite(data["DM_over_B"])
                             )
                             masks.append(np.asarray(mask, dtype=bool))
+                        if need_q:
+                            qmasks.append(
+                                np.asarray(data["quarantined"], dtype=bool)
+                                if "quarantined" in data.files
+                                else np.zeros(n_valid, dtype=bool)
+                            )
                 except Exception as exc:
                     # The coordinator verified readability when building
                     # the plan; landing here means *this* process cannot
@@ -1115,29 +1368,56 @@ def run_sweep(
                             "with keep_outputs=True requires shared storage"
                         ) from exc
                     masks = None
+                    qmasks = None
             continue
 
-        pp_chunk = _pad_chunk(pp_all, lo, hi, chunk_size)
-        if mesh is not None:
-            from bdlz_tpu.parallel.mesh import batch_sharding
-            from bdlz_tpu.parallel.multihost import shard_global_chunk
-
-            # single-process: plain device_put; multi-process: each host
-            # contributes only its local shard of the global chunk
-            pp_chunk = shard_global_chunk(pp_chunk, batch_sharding(mesh))
         t_chunk = time.time()
-        with profiler_trace(trace_dir):
-            res = step(pp_chunk, aux)
-            entry = {
-                "ci": ci, "res": res, "n_valid": n_valid, "t0": t_chunk,
-                "file": chunk_file,
-            }
-            if not overlap:
-                # serial mode (profiling / esdirk): the device gather
-                # happens inside the trace window — exactly the
-                # pre-overlap scope — with bookkeeping IO after it
-                entry["host"] = _gather(entry)
-        if overlap:
+        entry = {
+            "ci": ci, "n_valid": n_valid, "t0": t_chunk,
+            "file": chunk_file, "lo": lo, "hi": hi,
+        }
+        dispatch_err = None
+        try:
+            if faults is not None:
+                faults.fire("step", ci)
+                faults.check_range("step", lo, hi)
+            pp_chunk = _pad_chunk(pp_all, lo, hi, chunk_size)
+            if mesh is not None:
+                from bdlz_tpu.parallel.mesh import batch_sharding
+                from bdlz_tpu.parallel.multihost import shard_global_chunk
+
+                # single-process: plain device_put; multi-process: each
+                # host contributes only its local shard of the global chunk
+                pp_chunk = shard_global_chunk(pp_chunk, batch_sharding(mesh))
+            with profiler_trace(trace_dir):
+                entry["res"] = step(pp_chunk, aux)
+                if not overlap:
+                    # serial mode (profiling / esdirk): the device gather
+                    # happens inside the trace window — exactly the
+                    # pre-overlap scope — with bookkeeping IO after it
+                    entry["host"] = _gather(entry)
+        except Exception as exc:  # noqa: BLE001 — healed below
+            if not heal_on:
+                raise
+            dispatch_err = exc
+        if heal_on and multiproc:
+            # dispatch-outcome agreement (identity single-process): a
+            # one-sided failure must put EVERY process on the healing
+            # path, or the fleet diverges on its launch/collect pattern
+            ok = _agree_ok(0 if dispatch_err is not None else 1)
+            if ok == 0 and dispatch_err is None:
+                dispatch_err = RuntimeError(
+                    "chunk dispatch failed on another process"
+                )
+        if dispatch_err is not None:
+            # self-healing: drain the double buffer to serial (collection
+            # order must hold), then retry → bisect → quarantine
+            _collect()
+            entry.pop("res", None)
+            entry["host"], entry["qmask"] = _heal_range(
+                ci, lo, hi, dispatch_err, [_heal_budget(hi - lo)],
+            )
+        if overlap and dispatch_err is None:
             _collect()        # block on chunk k-1 while chunk k computes
             inflight = entry
         else:
@@ -1150,6 +1430,7 @@ def run_sweep(
         {f: np.concatenate(collected[f]) for f in fields} if keep_outputs else None
     )
     failed_mask = np.concatenate(masks) if masks else None
+    quarantined_mask = np.concatenate(qmasks) if qmasks else None
     if impl in ("tabulated", "pallas", "direct"):
         quad_impl = "panel_gl" if quad_on else "trap"
         n_quad = quad_nodes if quad_on else max(int(n_y), 2000)
@@ -1165,6 +1446,9 @@ def run_sweep(
         resumed_chunks=resumed,
         quad_impl=quad_impl,
         n_quad_nodes=n_quad,
+        n_quarantined=n_quarantined,
+        n_retries=n_retries,
         outputs=outputs,
         failed_mask=failed_mask,
+        quarantined_mask=quarantined_mask,
     )

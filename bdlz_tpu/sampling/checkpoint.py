@@ -212,8 +212,13 @@ def run_ensemble_checkpointed(
         # same as the sweep manifest); resume assumes the checkpoint dir
         # is on a filesystem every process can read.
         if coordinator:
+            from bdlz_tpu.utils.io import atomic_savez
+
             seg_file = os.path.join(out_dir, f"seg_{k:05d}.npz")
-            np.savez(
+            # atomic (mkstemp + replace): a crash mid-savez must leave
+            # the previous complete segment, never a torn one resume
+            # would have to detect-and-recompute
+            atomic_savez(
                 seg_file,
                 chain=seg_chain, logp=seg_logp,
                 walkers=host_walkers, state_logp=host_logp0,

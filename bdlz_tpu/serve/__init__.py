@@ -6,6 +6,7 @@ to the exact pipeline, and per-batch observability rows
 bdlz_tpu.serve`` (``serve_cli.py``)."""
 from bdlz_tpu.serve.batcher import (  # noqa: F401
     BatchResult,
+    DeadlineExceeded,
     MicroBatcher,
     drain_results,
 )

@@ -38,12 +38,31 @@ class _Pending(NamedTuple):
     future: Future
 
 
+class DeadlineExceeded(RuntimeError):
+    """A request aged past the service deadline before its dispatch.
+
+    Typed so callers can tell "the service shed my request under load"
+    from an evaluation failure; delivered through the request's future
+    at dispatch time instead of letting the stale request age the batch.
+    """
+
+
 class BatchResult(NamedTuple):
     """What a process_batch callback returns: per-request values plus
-    how many of them took the out-of-domain exact fallback."""
+    how many of them took the out-of-domain exact fallback.
+
+    ``errors`` (optional, same length as ``values``) carries per-request
+    failures — a request with a non-None entry gets its exception
+    instead of a value, while its batchmates' results still deliver
+    (error isolation: one poisoned request must not fail the batch).
+    ``n_retries`` counts evaluation retries the batch paid (degraded-
+    mode accounting for :class:`~bdlz_tpu.utils.profiling.ServeStats`).
+    """
 
     values: Sequence[float]
     n_fallback: int = 0
+    errors: Optional[Sequence[Optional[BaseException]]] = None
+    n_retries: int = 0
 
 
 class MicroBatcher:
@@ -62,14 +81,38 @@ class MicroBatcher:
         max_wait_s: float = 0.005,
         clock: Callable[[], float] = time.monotonic,
         stats: Optional[ServeStats] = None,
+        deadline_s: Optional[float] = None,
+        fault_plan=None,
     ):
         if max_batch_size < 1:
             raise ValueError("max_batch_size must be >= 1")
         if max_wait_s < 0.0:
             raise ValueError("max_wait_s must be >= 0")
+        if deadline_s is not None and deadline_s <= 0.0:
+            raise ValueError("deadline_s must be > 0 (or None)")
+        if deadline_s is not None and deadline_s <= max_wait_s:
+            # a lone request only dispatches once it has aged max_wait_s,
+            # so this configuration would deterministically shed 100% of
+            # sparse traffic — reject it instead of silently serving
+            # nothing
+            raise ValueError(
+                f"deadline_s ({deadline_s}) must exceed max_wait_s "
+                f"({max_wait_s}): the wait policy ages every "
+                "non-full batch to max_wait_s before dispatch"
+            )
         self._process = process_batch
         self.max_batch_size = int(max_batch_size)
         self.max_wait_s = float(max_wait_s)
+        #: Per-request deadline: a request older than this at dispatch is
+        #: answered with DeadlineExceeded instead of aging the batch.
+        #: Measured on the SAME injectable clock as the wait policy, so
+        #: tier-1 drives expiry with a fake clock and never sleeps.
+        self.deadline_s = None if deadline_s is None else float(deadline_s)
+        #: Injected "slow collection" faults (bdlz_tpu.faults, site
+        #: "clock", keyed by batch index): the delay is applied THROUGH
+        #: the clock at dispatch — requests look older, deadlines fire —
+        #: never as a real sleep.
+        self._faults = fault_plan
         self._clock = clock
         self.stats = stats if stats is not None else ServeStats()
         self._queue: Deque[_Pending] = deque()
@@ -119,13 +162,36 @@ class MicroBatcher:
         shutdown path, so no request is ever dropped.
         """
         now = self._clock()
+        if self._faults is not None:
+            now += self._faults.delay_s("clock", self._batch_index)
         with self._lock:
             if not self._queue or not (force or self._ready_locked(now)):
                 return 0
+            # Expired requests are an age-ordered PREFIX of the queue:
+            # drain them before slicing the batch, so dead requests never
+            # consume dispatch slots that still-live ones behind them
+            # need (shedding load must not add latency to the survivors).
+            expired = []
+            if self.deadline_s is not None:
+                while self._queue and (
+                    now - self._queue[0].enqueued_at > self.deadline_s
+                ):
+                    expired.append(self._queue.popleft())
             batch = [
                 self._queue.popleft()
                 for _ in range(min(len(self._queue), self.max_batch_size))
             ]
+        n_expired = len(expired)
+        for p in expired:
+            age = now - p.enqueued_at
+            p.future.set_exception(DeadlineExceeded(
+                f"request aged {age:.6f}s past the "
+                f"{self.deadline_s:.6f}s service deadline before dispatch"
+            ))
+        if n_expired:
+            self.stats.record_deadline_kills(n_expired)
+        if not batch:
+            return n_expired
         wait_s = max(now - p.enqueued_at for p in batch)
         t0 = self._clock()
         try:
@@ -138,18 +204,22 @@ class MicroBatcher:
         except Exception as exc:  # noqa: BLE001 — delivered per-request
             for p in batch:
                 p.future.set_exception(exc)
-            return len(batch)
+            return len(batch) + n_expired
         if not isinstance(result, BatchResult):
             result = BatchResult(values=result)
         values = list(result.values)
-        if len(values) != len(batch):
+        errors = (
+            list(result.errors) if result.errors is not None
+            else [None] * len(values)
+        )
+        if len(values) != len(batch) or len(errors) != len(batch):
             err = RuntimeError(
                 f"process_batch returned {len(values)} values for a "
                 f"{len(batch)}-request batch"
             )
             for p in batch:
                 p.future.set_exception(err)
-            return len(batch)
+            return len(batch) + n_expired
         seconds = self._clock() - t0
         self.stats.record_batch(
             batch_index=self._batch_index,
@@ -158,11 +228,18 @@ class MicroBatcher:
             wait_s=float(wait_s),
             n_fallback=int(result.n_fallback),
             seconds=float(seconds),
+            n_retries=int(result.n_retries),
+            n_error=sum(e is not None for e in errors),
         )
         self._batch_index += 1
-        for p, v in zip(batch, values):
-            p.future.set_result(v)
-        return len(batch)
+        for p, v, e in zip(batch, values, errors):
+            # per-request error isolation: a poisoned request gets its
+            # exception, its batchmates still get their values
+            if e is not None:
+                p.future.set_exception(e)
+            else:
+                p.future.set_result(v)
+        return len(batch) + n_expired
 
     # ---- background loop (CLI only; not exercised by tier-1) --------
 

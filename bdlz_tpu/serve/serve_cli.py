@@ -50,6 +50,10 @@ def main(argv: Optional[list] = None) -> int:
                     help="served output field (default DM_over_B)")
     ap.add_argument("--max-batch", type=int, default=256)
     ap.add_argument("--max-wait-ms", type=float, default=5.0)
+    ap.add_argument("--deadline-ms", type=float, default=None,
+                    help="per-request deadline: a request older than this "
+                         "at dispatch is answered with DeadlineExceeded "
+                         "instead of aging its batch (default: none)")
     ap.add_argument("--events", default=None,
                     help="JSON-lines event log path (default stderr)")
     args = ap.parse_args(argv)
@@ -85,6 +89,12 @@ def main(argv: Optional[list] = None) -> int:
     if args.requests is None:
         ap.error("one of --requests or --bench is required")
 
+    # Per-line fault tolerance: a malformed or axis-missing request line
+    # is answered with a structured error record and the stream keeps
+    # draining — one poisoned line (or one failing request) must never
+    # kill the whole session.  Exit nonzero only when EVERY line failed.
+    n_lines = 0
+    n_ok = 0
     fh = sys.stdin if args.requests == "-" else open(args.requests, encoding="utf-8")
     try:
         requests = []
@@ -92,8 +102,17 @@ def main(argv: Optional[list] = None) -> int:
             line = line.strip()
             if not line:
                 continue
+            n_lines += 1
             try:
                 obj = json.loads(line)
+            except Exception as exc:  # noqa: BLE001 — report per request
+                # unparseable line: no client id to echo back
+                print(
+                    json.dumps({"id": None, "line": ln, "error": str(exc)})
+                )
+                continue
+            rid = obj.get("id", ln) if isinstance(obj, dict) else ln
+            try:
                 theta = (
                     np.asarray(obj["theta"], dtype=np.float64)
                     if "theta" in obj
@@ -103,17 +122,17 @@ def main(argv: Optional[list] = None) -> int:
                 )
             except Exception as exc:  # noqa: BLE001 — report per request
                 print(
-                    json.dumps({"id": None, "line": ln, "error": str(exc)})
+                    json.dumps({"id": rid, "line": ln, "error": str(exc)})
                 )
                 continue
             if theta.shape != (len(artifact.axis_names),):
                 print(json.dumps({
-                    "id": obj.get("id", ln),
+                    "id": rid,
                     "error": f"theta has {theta.size} coordinates, this "
                              f"artifact takes {len(artifact.axis_names)}",
                 }))
                 continue
-            requests.append((obj.get("id", ln), theta))
+            requests.append((rid, theta))
     finally:
         if fh is not sys.stdin:
             fh.close()
@@ -121,14 +140,30 @@ def main(argv: Optional[list] = None) -> int:
     # warm both jitted paths so the first request's latency_s measures
     # serving, not the XLA compile
     service.evaluate(np.array([[nodes[0] for nodes in artifact.axis_nodes]]))
-    batcher = service.make_batcher(max_wait_s=args.max_wait_ms / 1e3)
+    batcher = service.make_batcher(
+        max_wait_s=args.max_wait_ms / 1e3,
+        deadline_s=(
+            None if args.deadline_ms is None else args.deadline_ms / 1e3
+        ),
+    )
     batcher.start()
     # latency is stamped at SUBMIT — file parsing above is not queue time
     futures = [(rid, time.monotonic(), batcher.submit(theta))
                for rid, theta in requests]
     try:
         for rid, t0, fut in futures:
-            value = fut.result()
+            try:
+                value = fut.result()
+            except Exception as exc:  # noqa: BLE001 — report per request
+                # per-request failures (DeadlineExceeded, a dead exact
+                # fallback) answer THIS line; the rest keep serving
+                print(json.dumps({
+                    "id": rid,
+                    "error": f"{type(exc).__name__}: {exc}",
+                    "latency_s": round(time.monotonic() - t0, 6),
+                }))
+                continue
+            n_ok += 1
             print(json.dumps({
                 "id": rid,
                 "value": float(value),
@@ -137,7 +172,7 @@ def main(argv: Optional[list] = None) -> int:
     finally:
         batcher.stop()
     event_log.emit("serve_done", **service.stats.summary())
-    return 0
+    return 1 if (n_lines and n_ok == 0) else 0
 
 
 def _bench(service, n: int, args, event_log) -> int:

@@ -60,11 +60,23 @@ class YieldService:
         field: str = "DM_over_B",
         max_batch_size: int = 256,
         mesh=None,
+        retry=None,
+        fault_plan=None,
     ):
         from bdlz_tpu.config import static_choices_from_config
+        from bdlz_tpu.faults import FaultPlan
+        from bdlz_tpu.utils.retry import resolve_engine_retry
 
         if static is None:
             static = static_choices_from_config(base)
+        # Robustness seams (docs/robustness.md): the exact fallback is
+        # retried once with deterministic backoff and its failures are
+        # isolated to the requests that needed it (process_batch);
+        # injected faults (site "serve_exact") exercise both.  Default:
+        # healing on, injection off, zero overhead.
+        self._retry = resolve_engine_retry(retry, base, static)
+        self._faults = FaultPlan.resolve(fault_plan, base)
+        self._exact_calls = 0
         n_y = int(artifact.identity.get("n_y", 0))
         impl = str(artifact.identity.get("impl", "tabulated"))
         # the exact fallback must answer from the artifact's recorded
@@ -87,15 +99,57 @@ class YieldService:
 
     # ---- evaluation -------------------------------------------------
 
-    def evaluate(self, thetas) -> Tuple[np.ndarray, int]:
-        """(values, n_fallback) for a (B, d) batch of queries.
+    def _exact_guarded(self, axes, retries_box) -> Dict[str, np.ndarray]:
+        """The exact fallback behind its robustness seams.
 
-        The emulator answers every in-domain request from one padded
-        jitted call; out-of-domain requests are regrouped into one
-        exact-pipeline call (padded to the same bucket) — the fallback
-        is per-REQUEST, so one stray query cannot drag a whole batch
-        onto the slow path.
+        Retried ONCE with deterministic backoff when a retry policy is
+        resolved (a transient XLA/dispatch failure should cost one
+        backoff, not the request — a bounded slice of the policy's
+        budget, through the SHARED ``call_with_retry`` primitive so the
+        serve path cannot drift from the sweep's retry semantics);
+        injected ``serve_exact`` faults fire here, keyed by the
+        fallback call counter.  ``retries_box[0]`` counts the retries
+        paid — success or not, the degraded-mode accounting sees them.
+        A persistent failure re-raises to the caller, which decides
+        whether to isolate it per-request (:meth:`process_batch`) or
+        propagate (:meth:`evaluate`).
         """
+        from bdlz_tpu.utils.retry import call_with_retry
+
+        # the fault key is the LOGICAL fallback call — retries share it,
+        # so a keyed "raise" spec is truly persistent (only the
+        # "transient" kind's times budget distinguishes attempts)
+        call_idx = self._exact_calls
+        self._exact_calls += 1
+
+        def attempt():
+            if self._faults is not None:
+                self._faults.fire("serve_exact", call_idx)
+            return self._exact(axes)
+
+        if self._retry is None:
+            return attempt()
+
+        def count_retry(_attempt, _exc):
+            retries_box[0] += 1
+
+        return call_with_retry(
+            attempt,
+            # at-most-one retry per request (a serve batch must not grind
+            # through a long budget), but never MORE attempts than the
+            # operator's retry_max_attempts allows (1 = single-shot)
+            self._retry._replace(
+                max_attempts=min(2, self._retry.max_attempts)
+            ),
+            label=f"serve_exact{call_idx}",
+            on_retry=count_retry,
+        )
+
+    def _evaluate_isolated(self, thetas):
+        """(values, n_fallback, errors, n_retries) with per-request
+        exact-failure isolation: the emulator-path results always
+        return; a dead exact fallback poisons ONLY the out-of-domain
+        requests that needed it."""
         thetas = np.atleast_2d(np.asarray(thetas, dtype=np.float64))
         b = thetas.shape[0]
         if thetas.shape[1] != len(self.artifact.axis_names):
@@ -111,27 +165,60 @@ class YieldService:
         # fallback writes exact values into the out-of-domain slots
         values = np.array(self._query(padded), dtype=np.float64)[:b]
         n_fallback = int((~inside).sum())
+        errors: "list[Optional[BaseException]]" = [None] * b
+        retries_box = [0]
         if n_fallback:
             ood = _pad_rows(thetas[~inside], bucket)
             axes = {
                 name: ood[:, k]
                 for k, name in enumerate(self.artifact.axis_names)
             }
-            exact = self._exact(axes)[self.field][:n_fallback]
-            values[~inside] = exact
+            try:
+                exact_fields = self._exact_guarded(axes, retries_box)
+                values[~inside] = exact_fields[self.field][:n_fallback]
+            except Exception as exc:  # noqa: BLE001 — isolated per request
+                for i in np.flatnonzero(~inside):
+                    errors[int(i)] = exc
+                    values[int(i)] = np.nan
+        return values, n_fallback, errors, retries_box[0]
+
+    def evaluate(self, thetas) -> Tuple[np.ndarray, int]:
+        """(values, n_fallback) for a (B, d) batch of queries.
+
+        The emulator answers every in-domain request from one padded
+        jitted call; out-of-domain requests are regrouped into one
+        exact-pipeline call (padded to the same bucket) — the fallback
+        is per-REQUEST, so one stray query cannot drag a whole batch
+        onto the slow path.  A persistently failing exact fallback
+        (after its one retry) RAISES here — direct callers keep the
+        loud contract; the batcher path (:meth:`process_batch`)
+        isolates it per request instead.
+        """
+        values, n_fallback, errors, _ = self._evaluate_isolated(thetas)
+        for e in errors:
+            if e is not None:
+                raise e
         return values, n_fallback
 
     # ---- batcher integration ---------------------------------------
 
     def process_batch(self, thetas) -> BatchResult:
-        values, n_fallback = self.evaluate(thetas)
-        return BatchResult(values=list(values), n_fallback=n_fallback)
+        values, n_fallback, errors, n_retries = self._evaluate_isolated(
+            thetas
+        )
+        return BatchResult(
+            values=list(values),
+            n_fallback=n_fallback,
+            errors=errors if any(e is not None for e in errors) else None,
+            n_retries=n_retries,
+        )
 
     def make_batcher(
         self,
         max_wait_s: float = 0.005,
         clock=None,
         stats: Optional[ServeStats] = None,
+        deadline_s: Optional[float] = None,
     ) -> MicroBatcher:
         """A MicroBatcher wired to this service (shared stats object)."""
         import time
@@ -142,6 +229,8 @@ class YieldService:
             max_wait_s=max_wait_s,
             clock=time.monotonic if clock is None else clock,
             stats=self.stats if stats is None else stats,
+            deadline_s=deadline_s,
+            fault_plan=self._faults,
         )
 
     def theta_from_mapping(self, point: Dict[str, float]) -> np.ndarray:

@@ -208,6 +208,8 @@ def main(argv=None) -> None:
     print(json.dumps({
         "n_points": res.n_points,
         "n_failed": res.n_failed,
+        "n_quarantined": res.n_quarantined,
+        "n_retries": res.n_retries,
         "seconds": round(res.seconds, 3),
         "points_per_sec": round(res.points_per_sec, 1),
         "resumed_chunks": res.resumed_chunks,

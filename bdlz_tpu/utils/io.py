@@ -45,6 +45,37 @@ def atomic_write_json(path: str, payload: Any, **dump_kwargs: Any) -> None:
         raise
 
 
+def atomic_savez(path: str, **arrays: Any) -> None:
+    """``np.savez`` with the mkstemp + ``os.replace`` atomicity of
+    :func:`atomic_write_json`.
+
+    THE array-write primitive for every resumable/loadable artifact
+    (sweep chunk files, emulator tables, MCMC chain segments): a crash
+    mid-``np.savez`` into the final path leaves a torn zip that resume
+    must detect-and-recompute — atomic replacement means readers see
+    either the old complete file or the new complete file, never half a
+    write.  The temp name must end in ``.npz`` or ``np.savez`` APPENDS
+    the suffix and the rename misses (the lesson already learned in
+    ``emulator/artifact.py``).
+    """
+    import numpy as np  # host-side IO only (bdlz-lint R1 audit)
+
+    if not path.endswith(".npz"):
+        path += ".npz"  # np.savez's suffix rule, kept for callers' sake
+    d = os.path.dirname(os.path.abspath(path))
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp.npz")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez(f, **arrays)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
+
+
 def _scalar(v: Any) -> Any:
     """Coerce numpy/jax scalars to plain Python types for JSON."""
     if hasattr(v, "item"):

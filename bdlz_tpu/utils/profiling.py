@@ -76,6 +76,11 @@ class ServeBatch:
     wait_s: float          # oldest request's queue wait at dispatch
     n_fallback: int        # out-of-domain requests → exact pipeline
     seconds: float         # evaluation wall time
+    # degraded-mode accounting (docs/robustness.md): exact-fallback
+    # retries paid, and requests answered with a per-request error after
+    # the retry budget (the serve analog of sweep quarantine)
+    n_retries: int = 0
+    n_error: int = 0
 
 
 @dataclass
@@ -88,9 +93,16 @@ class ServeStats:
     artifact's box no longer covers the query distribution."""
 
     rows: List[ServeBatch] = field(default_factory=list)
+    #: Requests answered with ``DeadlineExceeded`` at dispatch instead of
+    #: aging their batch (counted here, not per row — a fully-expired
+    #: dispatch records no batch row at all).
+    deadline_kills: int = 0
 
     def record_batch(self, **kw: Any) -> None:
         self.rows.append(ServeBatch(**kw))
+
+    def record_deadline_kills(self, n: int) -> None:
+        self.deadline_kills += int(n)
 
     @property
     def n_batches(self) -> int:
@@ -99,6 +111,7 @@ class ServeStats:
     def summary(self) -> Dict[str, Any]:
         requests = sum(r.size for r in self.rows)
         fallbacks = sum(r.n_fallback for r in self.rows)
+        errors = sum(r.n_error for r in self.rows)
         return {
             "batches": self.n_batches,
             "requests": requests,
@@ -113,6 +126,13 @@ class ServeStats:
                 round(max(r.wait_s for r in self.rows), 6) if self.rows else 0.0
             ),
             "seconds": round(sum(r.seconds for r in self.rows), 4),
+            # degraded-mode accounting: how hard the service had to fight
+            # (retries), what it shed (deadline kills), and what it could
+            # not save (per-request errors = the serve quarantine rate)
+            "retries": sum(r.n_retries for r in self.rows),
+            "deadline_kills": self.deadline_kills,
+            "errors": errors,
+            "quarantine_rate": round(errors / requests, 4) if requests else 0.0,
         }
 
     def as_rows(self) -> List[Dict[str, Any]]:

@@ -1,0 +1,152 @@
+"""Bounded retry with deterministic backoff (the robustness layer's
+shared primitive).
+
+One home for the retry POLICY — attempts budget, backoff schedule,
+deterministic jitter, injectable sleep — that the self-healing sweep
+(``parallel/sweep.py``), the emulator's probe evaluator
+(``emulator/build.py``), and the serve stack's exact-fallback isolation
+(``serve/service.py``) all share, so their failure semantics cannot
+drift apart.  The emulator and serve paths run the literal
+:func:`call_with_retry` loop; the sweep's heal path drives its own
+attempt loop (its bisect control flow interleaves with the attempts)
+but takes every delay from :func:`backoff_delay`, so the schedule is
+still this module's, everywhere:
+
+* **bounded attempts** — a persistent failure always surfaces (to the
+  caller's bisect/quarantine/error path), never an infinite loop;
+* **deterministic jitter** — the backoff schedule is a pure function of
+  ``(seed, label, attempt)`` (SHA-256 derived, no global RNG state), so
+  multi-controller processes running the same retry plan sleep the same
+  schedule and tests can pin exact delays;
+* **injectable sleep** — tier-1 tests pass ``sleep=lambda s: None`` and
+  never block (the same design rule as the serve batcher's injectable
+  clock).
+
+The ``retry_*`` config knobs resolve here (:func:`resolve_retry_policy`,
+the ode_*/quad_* tri-state pattern): ``retry_enabled=None`` means
+"engine decides" — the chunked/serving engines turn healing ON, the
+bit-pinned per-point paths have no chunk loop and are unaffected —
+while an explicit ``False`` restores raise-through for debugging.
+"""
+from __future__ import annotations
+
+import hashlib
+import time
+from typing import Any, Callable, NamedTuple, Optional, Tuple, Type
+
+
+class RetryPolicy(NamedTuple):
+    """How a healing call site retries: attempts, backoff, sleep seam."""
+
+    #: Total attempts (first try included); >= 1.  1 = no retry, the
+    #: failure goes straight to the caller's bisect/quarantine path.
+    max_attempts: int = 3
+    #: Base backoff before the first retry; doubles per retry.
+    backoff_s: float = 0.05
+    #: Backoff ceiling (keeps the doubled schedule bounded).
+    max_backoff_s: float = 2.0
+    #: Seed of the deterministic jitter stream.
+    seed: int = 0
+    #: Injectable sleep — tests pass a no-op and never block.
+    sleep: Callable[[float], None] = time.sleep
+
+
+def deterministic_jitter(seed: int, label: str, attempt: int) -> float:
+    """A reproducible uniform-ish value in [0, 1) from (seed, label, attempt).
+
+    SHA-256 based so it is identical on every process and platform —
+    multi-controller retry schedules must not diverge (``random`` module
+    state or ``time``-seeded jitter would), and tests can pin delays.
+    """
+    digest = hashlib.sha256(f"{seed}:{label}:{attempt}".encode()).digest()
+    return int.from_bytes(digest[:8], "big") / float(2 ** 64)
+
+
+def backoff_delay(policy: RetryPolicy, label: str, attempt: int) -> float:
+    """Delay before retry ``attempt`` (0-based): capped exponential with
+    deterministic half-to-full jitter (0.5–1.0× of the doubled base)."""
+    base = float(policy.backoff_s) * (2.0 ** int(attempt))
+    jitter = 0.5 + 0.5 * deterministic_jitter(policy.seed, label, attempt)
+    return min(base * jitter, float(policy.max_backoff_s))
+
+
+def call_with_retry(
+    fn: Callable[[], Any],
+    policy: RetryPolicy,
+    label: str = "",
+    retryable: "Tuple[Type[BaseException], ...]" = (Exception,),
+    on_retry: Optional[Callable[[int, BaseException], None]] = None,
+) -> Any:
+    """Run ``fn`` under the policy; re-raise the last error when exhausted.
+
+    ``on_retry(attempt, exc)`` fires before each retry's backoff sleep
+    (attempt is 0-based over the retries, not the first try) — the hook
+    call sites use to emit ``chunk_retry``-style events.
+    """
+    attempts = max(int(policy.max_attempts), 1)
+    for attempt in range(attempts):
+        try:
+            return fn()
+        except retryable as exc:  # noqa: PERF203 — the retry loop IS the point
+            if attempt + 1 >= attempts:
+                raise
+            if on_retry is not None:
+                on_retry(attempt, exc)
+            policy.sleep(backoff_delay(policy, label, attempt))
+    raise AssertionError("unreachable")  # pragma: no cover
+
+
+def resolve_retry_policy(
+    base=None,
+    enabled: Optional[bool] = None,
+    engine_default: bool = True,
+    sleep: Optional[Callable[[float], None]] = None,
+    seed: int = 0,
+) -> Optional[RetryPolicy]:
+    """Resolve the tri-state ``retry_enabled`` knob into a policy (or None).
+
+    ``enabled`` overrides the config's ``retry_enabled`` when given
+    (callers pass their StaticChoices value); ``None`` falls to
+    ``engine_default`` — True in the chunked sweep / serve engines.
+    Returns ``None`` when healing is OFF: call sites guard every hook on
+    it, so the disabled path has zero overhead and byte-identical
+    behavior.
+    """
+    attempts, backoff = 3, 0.05
+    if base is not None:
+        if enabled is None:
+            enabled = getattr(base, "retry_enabled", None)
+        attempts = int(getattr(base, "retry_max_attempts", attempts))
+        backoff = float(getattr(base, "retry_backoff_s", backoff))
+    on = engine_default if enabled is None else bool(enabled)
+    if not on:
+        return None
+    return RetryPolicy(
+        max_attempts=max(attempts, 1),
+        backoff_s=backoff,
+        seed=int(seed),
+        sleep=time.sleep if sleep is None else sleep,
+    )
+
+
+def resolve_engine_retry(
+    explicit: Optional[RetryPolicy],
+    base,
+    static=None,
+    engine_default: bool = True,
+) -> Optional[RetryPolicy]:
+    """THE engine-level resolution: explicit policy ▸ static tri-state ▸
+    config tri-state ▸ engine default.
+
+    One home for the precedence chain the sweep engine, the emulator
+    build, and the serve stack all apply — spelled once so a future
+    precedence change cannot silently diverge between engines.
+    """
+    if explicit is not None:
+        return explicit
+    enabled = getattr(static, "retry_enabled", None) if static is not None else None
+    if enabled is None:
+        enabled = getattr(base, "retry_enabled", None)
+    return resolve_retry_policy(
+        base, enabled=enabled, engine_default=engine_default
+    )

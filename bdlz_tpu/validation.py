@@ -543,7 +543,16 @@ def reference_ratios_cached(
     h = hashlib.sha256()
     for f in grid:
         h.update(np.ascontiguousarray(np.asarray(f, dtype=np.float64)).tobytes())
-    h.update(repr((tuple(static), n_y)).encode())
+    # robustness knobs are orchestration-only (cannot change reference
+    # values) and are stripped so their addition/toggling never churns
+    # the cache key
+    from bdlz_tpu.config import ROBUSTNESS_STATIC_FIELDS
+
+    ident = tuple(
+        v for f, v in zip(type(static)._fields, static)
+        if f not in ROBUSTNESS_STATIC_FIELDS
+    )
+    h.update(repr((ident, n_y)).encode())
     h.update(_reference_code_fingerprint().encode())
     path = os.path.join(cache_dir, f"ref_{h.hexdigest()[:24]}.npy")
     n = int(np.asarray(grid.m_chi_GeV).shape[0])

@@ -462,6 +462,11 @@ def main(argv=None) -> None:
                     "panel Gauss-Legendre y-quadrature A/B vs the "
                     "n_y=%d trapezoid)" % n_y,
             "n_points": n_sub,
+            # robustness schema: every sweep metric line carries the
+            # failure counters (nulls where the leg has no healing path)
+            "n_failed": int((~np.isfinite(vals_gl)).sum()),
+            "n_quarantined": None,
+            "n_retries": None,
             "quad_impl": "panel_gl",
             "n_quad_nodes": n_quad_gl,
             "vs_trapezoid": round(per_chip_gl / max(per_chip_tr, 1e-9), 1),
@@ -600,6 +605,9 @@ def main(argv=None) -> None:
                 "unit": "stiff ODE param-points/sec/chip (Gamma_wash grid)",
                 "n_points": n_ode,
                 "n_failed": int((~np.isfinite(out_ode)).sum()),
+                # this leg times raw engine steps (no chunk-healing loop)
+                "n_quarantined": None,
+                "n_retries": None,
                 "seconds": round(esdirk_seconds, 3),
                 # the lockstep A/B: same grid, same tolerances, legacy
                 # engine — vs_lockstep is the repacking+accelerations
@@ -640,6 +648,99 @@ def main(argv=None) -> None:
         esdirk_per_chip = esdirk_metric()
     except Exception as exc:  # noqa: BLE001 — secondary metric is best-effort
         print(f"[bench] esdirk metric unavailable: {exc}", file=sys.stderr)
+
+    # --- secondary metric: chaos (self-healing sweep under faults) ----
+    # Runs the production run_sweep twice on a small grid: clean, then
+    # under a canned deterministic fault plan (transient step error on
+    # chunk 0, one poison point the bisect must isolate, one
+    # NaN-poisoned point).  The line records the healed throughput vs
+    # clean, the quarantine/retry counters, and whether every
+    # unaffected point came back BIT-identical to the clean run — the
+    # robustness trajectory, measured every round like the perf one.
+    def chaos_metric():
+        import dataclasses
+
+        from bdlz_tpu.faults import FaultPlan
+        from bdlz_tpu.parallel.sweep import run_sweep
+        from bdlz_tpu.utils.retry import RetryPolicy
+
+        n_chaos = int(os.environ.get("BDLZ_BENCH_CHAOS_POINTS", 64))
+        side_c = max(2, int(round(n_chaos ** 0.5)))
+        axes_c = {
+            "m_chi_GeV": np.geomspace(0.3, 3.0, side_c),
+            "T_p_GeV": np.geomspace(60.0, 200.0, side_c),
+        }
+        n_c = side_c * side_c
+        chunk_c = max(n_dev, ((side_c + n_dev - 1) // n_dev) * n_dev)
+        poison = n_c // 3
+        nan_pt = (2 * n_c) // 3
+        plan = FaultPlan.from_obj({"faults": [
+            {"site": "step", "kind": "transient", "key": 0, "times": 1},
+            {"site": "step", "kind": "poison", "point": poison},
+            {"site": "step", "kind": "nan", "point": nan_pt},
+        ]})
+        retry = RetryPolicy(max_attempts=2, backoff_s=0.0,
+                            sleep=lambda s: None)
+        static_c = static_for("tabulated")
+        # the clean baseline must be INSULATED from any ambient fault
+        # plan (an exported BDLZ_FAULT_PLAN would otherwise fault both
+        # legs and void the A/B); the chaos leg's explicit plan already
+        # overrides the env
+        base_clean = dataclasses.replace(base, fault_injection=False)
+        t1 = time.time()
+        res_clean = run_sweep(
+            base_clean, axes_c, static_c, mesh=mesh, chunk_size=chunk_c,
+            n_y=n_y,
+        )
+        clean_seconds = time.time() - t1
+        t2 = time.time()
+        res_chaos = run_sweep(
+            base, axes_c, static_c, mesh=mesh, chunk_size=chunk_c, n_y=n_y,
+            fault_plan=plan, retry=retry,
+        )
+        chaos_seconds = time.time() - t2
+        per_chip_chaos = round(n_c / chaos_seconds / n_dev, 2)
+        per_chip_clean = round(n_c / clean_seconds / n_dev, 2)
+        affected = np.asarray(res_chaos.failed_mask)
+        unaffected = ~affected & np.isfinite(res_clean.outputs["DM_over_B"])
+        bitwise = bool(np.array_equal(
+            res_chaos.outputs["DM_over_B"][unaffected],
+            res_clean.outputs["DM_over_B"][unaffected],
+        ))
+        payload = {
+            "metric": "chaos_sweep_points_per_sec_per_chip",
+            "value": per_chip_chaos,
+            "unit": "param-points/sec/chip (run_sweep under a canned "
+                    "fault plan: transient chunk error + poison point + "
+                    "NaN point, retry/bisect/quarantine healing on)",
+            "n_points": n_c,
+            "n_failed": int(res_chaos.n_failed),
+            "n_quarantined": int(res_chaos.n_quarantined),
+            "n_retries": int(res_chaos.n_retries),
+            "clean_points_per_sec_per_chip": per_chip_clean,
+            "vs_clean": round(per_chip_chaos / max(per_chip_clean, 1e-9), 3),
+            "bitwise_equal_unaffected": bitwise,
+            "fault_plan": plan.describe(),
+            "quad_impl": "panel_gl" if static_c.quad_panel_gl else "trap",
+            "n_quad_nodes": (
+                n_quad_gl if static_c.quad_panel_gl else max(n_y, 2000)
+            ),
+            "platform": jax.devices()[0].platform,
+            "tpu_unavailable": tpu_unavailable,
+        }
+        print(json.dumps(payload))
+        return {
+            k: payload[k] for k in (
+                "value", "vs_clean", "n_failed", "n_quarantined",
+                "n_retries", "bitwise_equal_unaffected",
+            )
+        }
+
+    chaos_summary = None
+    try:
+        chaos_summary = chaos_metric()
+    except Exception as exc:  # noqa: BLE001 — secondary metric is best-effort
+        print(f"[bench] chaos metric unavailable: {exc}", file=sys.stderr)
 
     # --- secondary metric: the yield-surface emulator + query service ---
     # Builds a small adaptive emulator (bdlz_tpu/emulator) over the bench
@@ -812,6 +913,9 @@ def main(argv=None) -> None:
                 "unit": "param-points/sec/chip (%s + full pipeline, "
                         "n_y=%d)" % (unit_detail, n_y),
                 "n_points": n_lz,
+                "n_failed": None,
+                "n_quarantined": None,
+                "n_retries": None,
                 "lz_derive_seconds": round(t_derive, 3),
                 "seconds": round(lz_seconds, 3),
                 "rel_err_vs_reference": float(f"{lz_rel:.3e}"),
@@ -873,6 +977,12 @@ def main(argv=None) -> None:
                 "vs_baseline": round(per_chip / 4.3, 1),
                 "n_points": n_total,
                 "n_devices": n_dev,
+                # robustness schema (nulls: the timed loop discards chunk
+                # outputs, and healing only engages via run_sweep — the
+                # chaos line below carries the measured counters)
+                "n_failed": None,
+                "n_quarantined": None,
+                "n_retries": None,
                 "seconds": round(seconds, 3),
                 "rel_err_vs_reference": (
                     None if max_rel is None else float(f"{max_rel:.3e}")
@@ -900,6 +1010,9 @@ def main(argv=None) -> None:
                 "tpu_unavailable": tpu_unavailable,
                 "relay_waited_s": relay_waited,
                 "esdirk_points_per_sec_per_chip": esdirk_per_chip,
+                # the chaos (fault-injected self-healing sweep) summary
+                # (null = leg failed; its secondary line has the detail)
+                "chaos": chaos_summary,
                 # the emulator/serving metric (null = build or measure
                 # failed; the secondary line carries the full detail)
                 "emulator": emulator_summary,

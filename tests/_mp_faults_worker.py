@@ -1,0 +1,100 @@
+"""Worker for the 2-process fault-injection sweep test.
+
+Launched twice by ``tests/test_multihost.py::test_two_process_fault_healing``
+as ``python _mp_faults_worker.py <port> <process_id> <out_dir>``.  Both
+processes run the SAME deterministic fault plan (a transient error on
+chunk 0 plus one poison point) through the mesh-sharded sweep: the
+attempt-outcome agreement (allreduce_min) and the deterministic plan must
+keep the retry/bisect decisions in lockstep — divergence deadlocks, which
+the parent's timeout converts into a failure — and both processes must
+end with the identical quarantine mask and outputs.
+"""
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from _mp_common import force_local_device_count, pin_worker_platform
+
+# must run before the first `import jax` (overrides the parent pytest
+# process's 8-device flag)
+force_local_device_count(2)
+
+
+def main() -> None:
+    port, pid, out_dir = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+
+    import jax
+
+    pin_worker_platform(jax, 2)
+
+    from bdlz_tpu.parallel.multihost import init_multihost
+
+    assert init_multihost(f"localhost:{port}", 2, pid) is True
+    assert jax.process_count() == 2, jax.process_count()
+
+    import numpy as np
+
+    from bdlz_tpu.config import config_from_dict, static_choices_from_config
+    from bdlz_tpu.faults import FaultPlan
+    from bdlz_tpu.parallel import make_mesh, run_sweep
+    from bdlz_tpu.utils.retry import RetryPolicy
+
+    cfg = config_from_dict({
+        "regime": "nonthermal",
+        "P_chi_to_B": 0.14925839040304145,
+        "source_shape_sigma_y": 9.0,
+        "incident_flux_scale": 1.07e-9,
+        "Y_chi_init": 4.90e-10,
+    })
+    static = static_choices_from_config(cfg)
+    axes = {"m_chi_GeV": np.geomspace(0.3, 3.0, 8).tolist()}
+    mesh = make_mesh(shape=(4, 1))  # all 4 global devices on dp
+
+    plan = FaultPlan.from_obj([
+        {"site": "step", "kind": "transient", "key": 0, "times": 1},
+        {"site": "step", "kind": "poison", "point": 5},
+    ])
+    retry = RetryPolicy(max_attempts=2, backoff_s=0.0, sleep=lambda s: None)
+    res = run_sweep(
+        cfg, axes, static, mesh=mesh, chunk_size=4, n_y=2000,
+        out_dir=f"{out_dir}/sweep", fault_plan=plan, retry=retry,
+    )
+    assert res.n_quarantined == 1, res.n_quarantined
+    assert res.n_failed == 1, res.n_failed
+    assert res.n_retries >= 1, res.n_retries
+    expected = np.zeros(8, dtype=bool)
+    expected[5] = True
+    np.testing.assert_array_equal(res.quarantined_mask, expected)
+    np.testing.assert_array_equal(res.failed_mask, expected)
+
+    # resume under the SAME armed plan (chaos directories carry their own
+    # identity; resumed chunks never dispatch, so no fault fires):
+    # counters and masks must round-trip identically on both processes
+    # (chunk files + manifest live on shared tmp storage)
+    plan2 = FaultPlan.from_obj([
+        {"site": "step", "kind": "transient", "key": 0, "times": 1},
+        {"site": "step", "kind": "poison", "point": 5},
+    ])
+    res2 = run_sweep(
+        cfg, axes, static, mesh=mesh, chunk_size=4, n_y=2000,
+        out_dir=f"{out_dir}/sweep", fault_plan=plan2, retry=retry,
+    )
+    assert res2.resumed_chunks == res.chunks, res2.resumed_chunks
+    assert res2.n_quarantined == 1 and res2.n_retries == 0
+    np.testing.assert_array_equal(res2.quarantined_mask, expected)
+    np.testing.assert_array_equal(
+        res.outputs["DM_over_B"], res2.outputs["DM_over_B"]
+    )
+
+    np.savez(
+        f"{out_dir}/faults_p{pid}.npz",
+        DM_over_B=res.outputs["DM_over_B"],
+        quarantined=res.quarantined_mask,
+        failed=res.failed_mask,
+    )
+    print(f"worker {pid} OK")
+
+
+if __name__ == "__main__":
+    main()

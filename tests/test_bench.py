@@ -41,7 +41,7 @@ def test_bench_cpu_smoke():
     # drop any inherited bench knobs so a developer's exported overrides
     # (BDLZ_BENCH_IMPL etc.) cannot change what this test asserts
     env = {k: v for k, v in os.environ.items()
-           if not k.startswith("BDLZ_BENCH_")}
+           if not k.startswith("BDLZ_BENCH_") and k != "BDLZ_FAULT_PLAN"}
     env.update(
         BDLZ_BENCH_PLATFORM="cpu",
         BDLZ_BENCH_POINTS="256",
@@ -58,6 +58,8 @@ def test_bench_cpu_smoke():
         # (sigma_y), but queries/exact-sample sizes stay smoke-sized
         BDLZ_BENCH_EMU_QUERIES="2048",
         BDLZ_BENCH_EMU_EXACT_POINTS="64",
+        # tiny chaos leg: the fault plan + healing machinery still runs
+        BDLZ_BENCH_CHAOS_POINTS="16",
         PYTHONPATH=REPO,
     )
     out = subprocess.run(
@@ -102,7 +104,37 @@ def test_bench_cpu_smoke():
             "lz_sweep_points_per_sec_per_chip",
             "lz_coherent_sweep_points_per_sec_per_chip",
             "emulator_query_points_per_sec",
-            "quad_gl_sweep_points_per_sec_per_chip"} <= names
+            "quad_gl_sweep_points_per_sec_per_chip",
+            "chaos_sweep_points_per_sec_per_chip"} <= names
+    # robustness schema: every sweep metric line carries the failure
+    # counters (nulls where the leg has no healing path), main line
+    # included
+    assert {"n_failed", "n_quarantined", "n_retries"} <= set(d)
+    for s in secondary:
+        if s["metric"] == "emulator_query_points_per_sec":
+            continue  # query metric, not a sweep line
+        assert {"n_failed", "n_quarantined", "n_retries"} <= set(s), s["metric"]
+    # the chaos line: healed sweep under the canned fault plan — the
+    # injected poison point is quarantined, the NaN point masked, the
+    # transient chunk retried, and every unaffected point bit-identical
+    # to the clean run
+    chaos = next(s for s in secondary
+                 if s["metric"] == "chaos_sweep_points_per_sec_per_chip")
+    assert chaos["value"] > 0
+    assert chaos["n_quarantined"] == 1
+    assert chaos["n_failed"] == 2          # poison (quarantined) + NaN point
+    assert chaos["n_retries"] >= 1
+    assert chaos["bitwise_equal_unaffected"] is True
+    assert chaos["clean_points_per_sec_per_chip"] > 0
+    assert {"site", "kind"} <= set(chaos["fault_plan"][0])
+    assert d["chaos"] == {
+        "value": chaos["value"],
+        "vs_clean": chaos["vs_clean"],
+        "n_failed": chaos["n_failed"],
+        "n_quarantined": chaos["n_quarantined"],
+        "n_retries": chaos["n_retries"],
+        "bitwise_equal_unaffected": chaos["bitwise_equal_unaffected"],
+    }
     quad = next(s for s in secondary
                 if s["metric"] == "quad_gl_sweep_points_per_sec_per_chip")
     assert {"value", "vs_trapezoid", "trapezoid_points_per_sec_per_chip",

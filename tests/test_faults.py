@@ -1,0 +1,528 @@
+"""The chaos suite: deterministic fault injection + self-healing sweep.
+
+Tier-1 pins for the robustness layer (ISSUE 5): the FaultPlan/RetryPolicy
+primitives, then a tiny sweep under each fault class — transient step
+error (retried), poison point (bisected and quarantined), NaN poison
+(failure-masked), torn chunk file (resume detects-and-recomputes) — each
+asserting results BIT-identical to a clean run on every unaffected
+point.  All tests are sleep-free: retry policies carry an injected no-op
+sleep, and torn storage is injected post-write, never raced.
+"""
+import json
+
+import numpy as np
+import pytest
+
+from bdlz_tpu.config import (
+    ConfigError,
+    config_from_dict,
+    static_choices_from_config,
+    validate,
+)
+from bdlz_tpu.faults import (
+    FaultError,
+    FaultPlan,
+    FaultPlanError,
+    TransientFaultError,
+)
+from bdlz_tpu.parallel import make_mesh, run_sweep
+from bdlz_tpu.utils.retry import (
+    RetryPolicy,
+    backoff_delay,
+    call_with_retry,
+    deterministic_jitter,
+    resolve_retry_policy,
+)
+
+BENCH_OVER = {
+    "regime": "nonthermal",
+    "P_chi_to_B": 0.14925839040304145,
+    "source_shape_sigma_y": 9.0,
+    "incident_flux_scale": 1.07e-9,
+    "Y_chi_init": 4.90e-10,
+}
+
+
+@pytest.fixture(scope="module")
+def base_cfg():
+    return config_from_dict(dict(BENCH_OVER))
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    import jax
+
+    assert len(jax.devices()) == 8
+    return make_mesh(shape=(4, 2))
+
+
+def _noop_sleep_policy(max_attempts=2, calls=None):
+    """A retry policy whose sleep is recorded, never slept."""
+    sink = calls if calls is not None else []
+    return RetryPolicy(
+        max_attempts=max_attempts, backoff_s=0.01, sleep=sink.append
+    ), sink
+
+
+class TestFaultPlan:
+    def test_parse_and_describe(self):
+        plan = FaultPlan.from_obj({"faults": [
+            {"site": "step", "kind": "transient", "chunk": 0, "times": 2},
+            {"site": "step", "kind": "poison", "point": 5},
+            {"site": "serve_exact", "kind": "raise", "call": 1},
+            {"site": "clock", "kind": "slow", "delay_s": 0.25},
+        ]})
+        assert plan.describe() == [
+            {"site": "step", "kind": "transient", "key": 0, "times": 2},
+            {"site": "step", "kind": "poison", "point": 5},
+            {"site": "serve_exact", "kind": "raise", "key": 1},
+            {"site": "clock", "kind": "slow", "delay_s": 0.25},
+        ]
+        assert plan.delay_s("clock", 0) == 0.25
+        assert plan.delay_s("clock", 7) == 0.25  # key=None matches all
+
+    def test_json_text_and_file(self, tmp_path):
+        payload = {"faults": [{"site": "step", "kind": "raise", "key": 3}]}
+        from_text = FaultPlan.from_json(json.dumps(payload))
+        p = tmp_path / "plan.json"
+        p.write_text(json.dumps(payload))
+        from_file = FaultPlan.from_json(str(p))
+        assert from_text.describe() == from_file.describe()
+
+    def test_malformed_plans_rejected(self):
+        with pytest.raises(FaultPlanError, match="site"):
+            FaultPlan.from_obj([{"site": "bogus", "kind": "raise"}])
+        with pytest.raises(FaultPlanError, match="kind"):
+            FaultPlan.from_obj([{"site": "step", "kind": "explode"}])
+        with pytest.raises(FaultPlanError, match="point"):
+            FaultPlan.from_obj([{"site": "step", "kind": "poison"}])
+        with pytest.raises(FaultPlanError, match="times"):
+            FaultPlan.from_obj([{"site": "step", "kind": "transient"}])
+        with pytest.raises(FaultPlanError, match="unknown fault-spec"):
+            FaultPlan.from_obj([{"site": "step", "kind": "raise", "bog": 1}])
+        with pytest.raises(FaultPlanError, match="not valid JSON"):
+            FaultPlan.from_json("{broken")
+
+    def test_transient_counting_then_recovery(self):
+        plan = FaultPlan.from_obj([
+            {"site": "step", "kind": "transient", "key": 2, "times": 2},
+        ])
+        plan.fire("step", 0)  # other chunk: silent
+        for _ in range(2):
+            with pytest.raises(TransientFaultError):
+                plan.fire("step", 2)
+        plan.fire("step", 2)  # budget spent: recovered
+
+    def test_poison_range_and_nan_points(self):
+        plan = FaultPlan.from_obj([
+            {"site": "step", "kind": "poison", "point": 10},
+            {"site": "step", "kind": "nan", "point": 4},
+        ])
+        plan.check_range("step", 0, 10)   # poison point excluded: silent
+        with pytest.raises(FaultError, match="poison point 10"):
+            plan.check_range("step", 8, 16)
+        assert plan.nan_points("step", 0, 8) == [4]
+        assert plan.nan_points("step", 8, 16) == []
+
+    def test_corrupt_file_truncates_once(self, tmp_path):
+        plan = FaultPlan.from_obj([
+            {"site": "chunk_write", "kind": "torn", "key": 0},
+        ])
+        f = tmp_path / "chunk.npz"
+        f.write_bytes(b"x" * 100)
+        assert plan.corrupt_file("chunk_write", 0, str(f)) is True
+        assert f.stat().st_size == 50
+        # fires once: the re-written file stays healthy
+        f.write_bytes(b"y" * 100)
+        assert plan.corrupt_file("chunk_write", 0, str(f)) is False
+        assert f.stat().st_size == 100
+
+    def test_resolve_default_off_and_env(self, base_cfg, monkeypatch):
+        monkeypatch.delenv("BDLZ_FAULT_PLAN", raising=False)
+        assert FaultPlan.resolve(None, base_cfg) is None
+        monkeypatch.setenv(
+            "BDLZ_FAULT_PLAN",
+            '{"faults": [{"site": "step", "kind": "raise", "key": 0}]}',
+        )
+        plan = FaultPlan.resolve(None, base_cfg)
+        assert plan is not None and len(plan.specs) == 1
+        # explicit False gate wins over the env
+        import dataclasses
+
+        off = dataclasses.replace(base_cfg, fault_injection=False)
+        assert FaultPlan.resolve(None, off) is None
+        # explicit True without any plan is a configuration error
+        monkeypatch.delenv("BDLZ_FAULT_PLAN", raising=False)
+        on = dataclasses.replace(base_cfg, fault_injection=True)
+        with pytest.raises(FaultPlanError, match="no fault plan"):
+            FaultPlan.resolve(None, on)
+
+    def test_robustness_knobs_never_enter_identities(self, base_cfg):
+        """Arming a fault plan or tuning retry knobs is orchestration —
+        it must not stale a single resume manifest, emulator artifact,
+        or refcache entry (config AND static identity sides)."""
+        import dataclasses
+
+        from bdlz_tpu.config import (
+            config_identity_dict,
+            static_choices_from_config,
+        )
+        from bdlz_tpu.emulator.artifact import build_identity
+        from bdlz_tpu.parallel.sweep import grid_hash
+
+        tuned = dataclasses.replace(
+            base_cfg,
+            fault_injection=False,
+            fault_plan='{"faults": []}',
+            retry_enabled=True,
+            retry_max_attempts=9,
+            retry_backoff_s=1.5,
+        )
+        assert config_identity_dict(tuned) == config_identity_dict(base_cfg)
+        axes = {"m_chi_GeV": [0.5, 1.0]}
+        assert (
+            grid_hash(tuned, axes, 2000) == grid_hash(base_cfg, axes, 2000)
+        )
+        assert build_identity(
+            tuned, static_choices_from_config(tuned), 2000, "tabulated"
+        ) == build_identity(
+            base_cfg, static_choices_from_config(base_cfg), 2000, "tabulated"
+        )
+
+    def test_config_knob_validation(self):
+        with pytest.raises(ConfigError, match="retry_max_attempts"):
+            validate(config_from_dict({"retry_max_attempts": 0}))
+        with pytest.raises(ConfigError, match="retry_backoff_s"):
+            validate(config_from_dict({"retry_backoff_s": -1.0}))
+        with pytest.raises(ConfigError, match="fault_injection"):
+            validate(config_from_dict({"fault_injection": "yes"}))
+        with pytest.raises(ConfigError, match="retry_enabled"):
+            validate(config_from_dict({"retry_enabled": 1}))
+
+
+class TestRetryPolicy:
+    def test_deterministic_jitter_reproducible(self):
+        a = deterministic_jitter(0, "chunk3", 1)
+        assert a == deterministic_jitter(0, "chunk3", 1)
+        assert 0.0 <= a < 1.0
+        assert a != deterministic_jitter(0, "chunk3", 2)
+        assert a != deterministic_jitter(1, "chunk3", 1)
+
+    def test_backoff_doubles_and_caps(self):
+        pol = RetryPolicy(max_attempts=5, backoff_s=0.1, max_backoff_s=0.3)
+        d0 = backoff_delay(pol, "x", 0)
+        d5 = backoff_delay(pol, "x", 5)
+        assert 0.05 <= d0 <= 0.1      # 0.1 * [0.5, 1.0) jitter band
+        assert d5 == 0.3              # capped
+        assert backoff_delay(pol, "x", 0) == d0  # deterministic
+
+    def test_call_with_retry_recovers_and_exhausts(self):
+        pol, sleeps = _noop_sleep_policy(max_attempts=3)
+        state = {"n": 0}
+
+        def flaky():
+            state["n"] += 1
+            if state["n"] < 3:
+                raise RuntimeError("boom")
+            return "ok"
+
+        retried = []
+        assert call_with_retry(
+            flaky, pol, label="t",
+            on_retry=lambda a, e: retried.append(a),
+        ) == "ok"
+        assert retried == [0, 1]
+        assert sleeps == [backoff_delay(pol, "t", 0), backoff_delay(pol, "t", 1)]
+
+        def dead():
+            raise RuntimeError("still dead")
+
+        with pytest.raises(RuntimeError, match="still dead"):
+            call_with_retry(dead, pol, label="t2")
+
+    def test_resolution_tristate(self, base_cfg):
+        import dataclasses
+
+        # None -> engine default
+        assert resolve_retry_policy(base_cfg, engine_default=True) is not None
+        assert resolve_retry_policy(base_cfg, engine_default=False) is None
+        # explicit False wins
+        off = dataclasses.replace(base_cfg, retry_enabled=False)
+        assert resolve_retry_policy(off, engine_default=True) is None
+        # knobs flow through
+        tuned = dataclasses.replace(
+            base_cfg, retry_enabled=True, retry_max_attempts=7,
+            retry_backoff_s=0.5,
+        )
+        pol = resolve_retry_policy(tuned)
+        assert pol.max_attempts == 7 and pol.backoff_s == 0.5
+
+
+class TestSweepChaos:
+    """Tiny sweeps under each injected fault class (tier-1, sleep-free)."""
+
+    AXES = {"m_chi_GeV": np.geomspace(0.1, 2.0, 16).tolist()}
+
+    @pytest.fixture(scope="class")
+    def clean(self, base_cfg, mesh8):
+        static = static_choices_from_config(base_cfg)
+        return run_sweep(
+            base_cfg, self.AXES, static, mesh=mesh8, chunk_size=8, n_y=2000,
+        )
+
+    def _chaos(self, base_cfg, mesh8, plan, max_attempts=2, **kw):
+        static = static_choices_from_config(base_cfg)
+        policy, sleeps = _noop_sleep_policy(max_attempts=max_attempts)
+        res = run_sweep(
+            base_cfg, self.AXES, static, mesh=mesh8, chunk_size=8, n_y=2000,
+            fault_plan=FaultPlan.from_obj(plan), retry=policy, **kw,
+        )
+        return res, sleeps
+
+    def test_disabled_faults_bit_identical(self, base_cfg, mesh8, clean):
+        """With no fault plan the healed engine is byte-identical to the
+        pre-robustness engine's output (the acceptance pin)."""
+        static = static_choices_from_config(base_cfg)
+        res = run_sweep(
+            base_cfg, self.AXES, static, mesh=mesh8, chunk_size=8,
+            n_y=2000, fault_plan=None,
+        )
+        np.testing.assert_array_equal(
+            res.outputs["DM_over_B"], clean.outputs["DM_over_B"]
+        )
+        assert res.n_quarantined == 0 and res.n_retries == 0
+        assert not res.quarantined_mask.any()
+
+    def test_transient_step_fault_retried(self, base_cfg, mesh8, clean,
+                                          tmp_path):
+        """A chunk that fails transiently costs retries (with the
+        injected, never-slept backoff), not points — results stay
+        bit-identical to the clean run."""
+        from bdlz_tpu.utils.logging import EventLog
+
+        events_path = tmp_path / "events.jsonl"
+        res, sleeps = self._chaos(
+            base_cfg, mesh8,
+            [{"site": "step", "kind": "transient", "key": 1, "times": 1}],
+            event_log=EventLog(path=str(events_path)),
+        )
+        assert res.n_failed == 0 and res.n_quarantined == 0
+        assert res.n_retries == 1
+        assert len(sleeps) == 1  # injected sleep, recorded not slept
+        np.testing.assert_array_equal(
+            res.outputs["DM_over_B"], clean.outputs["DM_over_B"]
+        )
+        events = [json.loads(ln) for ln in
+                  events_path.read_text().splitlines()]
+        retries = [e for e in events if e["event"] == "chunk_retry"]
+        assert len(retries) == 1 and retries[0]["chunk"] == 1
+        assert not [e for e in events if e["event"] == "chunk_quarantine"]
+
+    def test_poison_point_bisected_to_quarantine(self, base_cfg, mesh8,
+                                                 clean, tmp_path):
+        """A persistently failing point is isolated by bisection: ONLY it
+        is quarantined, every survivor of its chunk is kept bit-identical
+        to the clean run."""
+        from bdlz_tpu.utils.logging import EventLog
+
+        events_path = tmp_path / "events.jsonl"
+        res, _ = self._chaos(
+            base_cfg, mesh8,
+            [{"site": "step", "kind": "poison", "point": 5}],
+            event_log=EventLog(path=str(events_path)),
+        )
+        assert res.n_quarantined == 1 and res.n_failed == 1
+        assert res.n_retries >= 1
+        expected = np.zeros(16, dtype=bool)
+        expected[5] = True
+        np.testing.assert_array_equal(res.quarantined_mask, expected)
+        np.testing.assert_array_equal(res.failed_mask, expected)
+        assert np.isnan(res.outputs["DM_over_B"][5])
+        np.testing.assert_array_equal(
+            res.outputs["DM_over_B"][~expected],
+            clean.outputs["DM_over_B"][~expected],
+        )
+        events = [json.loads(ln) for ln in
+                  events_path.read_text().splitlines()]
+        quarantines = [e for e in events if e["event"] == "chunk_quarantine"]
+        assert len(quarantines) == 1
+        assert (quarantines[0]["lo"], quarantines[0]["hi"]) == (5, 6)
+
+    def test_nan_fault_joins_failure_mask(self, base_cfg, mesh8, clean):
+        """A NaN-poisoned output is an ordinary masked failure (physics
+        path), not a quarantine."""
+        res, _ = self._chaos(
+            base_cfg, mesh8,
+            [{"site": "step", "kind": "nan", "point": 3}],
+        )
+        assert res.n_failed == 1 and res.n_quarantined == 0
+        assert res.failed_mask[3] and not res.quarantined_mask.any()
+        keep = ~res.failed_mask
+        np.testing.assert_array_equal(
+            res.outputs["DM_over_B"][keep],
+            clean.outputs["DM_over_B"][keep],
+        )
+
+    def test_torn_chunk_file_recomputed_on_resume(self, base_cfg, mesh8,
+                                                  clean, tmp_path, capsys):
+        """Torn storage: the chunk .npz is truncated after its (atomic)
+        write; the resume pass must detect the corrupt file, recompute
+        that chunk only, and reproduce the clean results."""
+        out = str(tmp_path / "sweep")
+        res1, _ = self._chaos(
+            base_cfg, mesh8,
+            [{"site": "chunk_write", "kind": "torn", "key": 0}],
+            out_dir=out,
+        )
+        assert res1.n_failed == 0  # the RUN was healthy; storage was not
+        with pytest.raises(Exception):
+            np.load(f"{out}/chunk_00000.npz")["DM_over_B"]
+        # resume under the SAME armed plan (chaos directories have their
+        # own identity — a clean run would recompute from scratch)
+        res2, _ = self._chaos(
+            base_cfg, mesh8,
+            [{"site": "chunk_write", "kind": "torn", "key": 0}],
+            out_dir=out,
+        )
+        assert res2.resumed_chunks == res2.chunks - 1
+        assert "recomputing" in capsys.readouterr().err
+        np.testing.assert_array_equal(
+            res2.outputs["DM_over_B"], clean.outputs["DM_over_B"]
+        )
+
+    def test_resume_after_quarantine_manifest_roundtrip(self, base_cfg,
+                                                        mesh8, tmp_path):
+        """Quarantine is durable: the manifest records it, and a resume
+        under the same plan restores the counters and masks without
+        recomputing (resumed chunks never dispatch, so no fault fires)."""
+        out = str(tmp_path / "sweep")
+        plan = [{"site": "step", "kind": "poison", "point": 5}]
+        res1, _ = self._chaos(base_cfg, mesh8, plan, out_dir=out)
+        assert res1.n_quarantined == 1
+        manifest = json.loads((tmp_path / "sweep" / "manifest.json").read_text())
+        rec = manifest["chunks"]["0"]
+        assert rec["n_quarantined"] == 1 and rec["quarantined"] == [5]
+        assert manifest["chunks"]["1"].get("n_quarantined", 0) == 0
+        res2, _ = self._chaos(base_cfg, mesh8, plan, out_dir=out)
+        assert res2.resumed_chunks == res2.chunks
+        assert res2.n_quarantined == 1 and res2.n_retries == 0
+        np.testing.assert_array_equal(
+            res2.quarantined_mask, res1.quarantined_mask
+        )
+        np.testing.assert_array_equal(
+            res2.outputs["DM_over_B"], res1.outputs["DM_over_B"]
+        )
+
+    def test_clean_run_never_resumes_a_chaos_directory(self, base_cfg,
+                                                       mesh8, tmp_path,
+                                                       clean):
+        """An armed fault plan joins the sweep identity: a clean run in
+        the same directory recomputes from scratch instead of silently
+        adopting injected NaN/quarantined chunks as physics."""
+        out = str(tmp_path / "sweep")
+        res1, _ = self._chaos(
+            base_cfg, mesh8,
+            [{"site": "step", "kind": "nan", "point": 3}],
+            out_dir=out,
+        )
+        assert res1.n_failed == 1
+        static = static_choices_from_config(base_cfg)
+        res2 = run_sweep(
+            base_cfg, self.AXES, static, mesh=mesh8, chunk_size=8,
+            n_y=2000, out_dir=out,
+        )
+        assert res2.resumed_chunks == 0
+        assert res2.n_failed == 0
+        np.testing.assert_array_equal(
+            res2.outputs["DM_over_B"], clean.outputs["DM_over_B"]
+        )
+
+    def test_whole_chunk_persistent_failure_bounded(self, base_cfg, mesh8,
+                                                    clean):
+        """A chunk where EVERY attempt fails (persistent raise keyed to
+        the chunk) wholesale-quarantines under the heal budget — O(log
+        chunk) probes, never O(chunk) full re-executions — and the other
+        chunk survives bit-identical."""
+        res, sleeps = self._chaos(
+            base_cfg, mesh8,
+            [{"site": "step", "kind": "raise", "key": 0}],
+            max_attempts=3,
+        )
+        assert res.n_quarantined == 8          # all of chunk 0
+        assert res.quarantined_mask[:8].all()
+        assert not res.quarantined_mask[8:].any()
+        # budget bound: max_attempts * 4 * (1 + ceil(log2(8))) = 48
+        assert res.n_retries <= 48
+        assert len(sleeps) <= res.n_retries    # sleeps injected, bounded
+        np.testing.assert_array_equal(
+            res.outputs["DM_over_B"][8:], clean.outputs["DM_over_B"][8:]
+        )
+
+    def test_retry_disabled_raises_through(self, base_cfg, mesh8):
+        """retry_enabled=False restores the old crash semantics — the
+        debugging escape hatch, and the pin that healing is really the
+        only thing standing between a fault and the sweep."""
+        import dataclasses
+
+        cfg = dataclasses.replace(base_cfg, retry_enabled=False)
+        static = static_choices_from_config(cfg)
+        with pytest.raises(FaultError):
+            run_sweep(
+                cfg, self.AXES, static, mesh=mesh8, chunk_size=8, n_y=2000,
+                fault_plan=FaultPlan.from_obj(
+                    [{"site": "step", "kind": "raise", "key": 0}]
+                ),
+            )
+
+
+class TestEmulatorBuildChaos:
+    def test_build_tolerates_quarantined_probes(self, base_cfg):
+        """A probe chunk whose exact evaluation stays dead after the
+        retry budget is dropped (never pooled), recorded in the report
+        AND the artifact manifest, and the build still converges."""
+        from bdlz_tpu.emulator import AxisSpec, build_emulator, load_artifact
+
+        plan = FaultPlan.from_obj([
+            # first TWO probe-evaluator calls fail: attempt + its one
+            # retry, so the first probe chunk is quarantined, then the
+            # injected fault recovers for every later round
+            {"site": "probe", "kind": "transient", "times": 2},
+        ])
+        policy, _ = _noop_sleep_policy(max_attempts=2)
+        spec = {
+            "m_chi_GeV": AxisSpec(0.9, 1.1, 3, "log"),
+            "T_p_GeV": AxisSpec(90.0, 110.0, 3, "log"),
+        }
+        artifact, report = build_emulator(
+            base_cfg, spec, rtol=1e-4, n_probe=8, n_holdout=16,
+            max_rounds=4, n_y=400, chunk_size=64, seed=0,
+            fault_plan=plan, retry=policy,
+        )
+        assert report.quarantined_probes == 8  # round 0's whole draw
+        assert artifact.manifest["quarantined_probes"] == 8
+        assert report.converged
+
+    def test_transient_probe_fault_healed_by_retry(self, base_cfg):
+        """One transient failure inside the retry budget costs nothing:
+        no quarantined probes, bit-identical surface to a clean build."""
+        from bdlz_tpu.emulator import AxisSpec, build_emulator
+
+        spec = {
+            "m_chi_GeV": AxisSpec(0.9, 1.1, 3, "log"),
+            "T_p_GeV": AxisSpec(90.0, 110.0, 3, "log"),
+        }
+        kw = dict(rtol=1e-4, n_probe=8, n_holdout=16, max_rounds=4,
+                  n_y=400, chunk_size=64, seed=0)
+        clean_art, _ = build_emulator(base_cfg, spec, **kw)
+        policy, sleeps = _noop_sleep_policy(max_attempts=2)
+        art, report = build_emulator(
+            base_cfg, spec, **kw,
+            fault_plan=FaultPlan.from_obj(
+                [{"site": "probe", "kind": "transient", "times": 1}]
+            ),
+            retry=policy,
+        )
+        assert report.quarantined_probes == 0
+        assert len(sleeps) == 1
+        for f in clean_art.values:
+            np.testing.assert_array_equal(art.values[f], clean_art.values[f])

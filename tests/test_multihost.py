@@ -141,6 +141,76 @@ def test_two_process_sweep(tmp_path):
     np.testing.assert_allclose(r0["DM_over_B"], ref.outputs["DM_over_B"], rtol=1e-12)
 
 
+def test_two_process_fault_healing(tmp_path):
+    """The robustness tentpole, executed for real across 2 processes: a
+    deterministic fault plan (transient chunk error + poison point) runs
+    through the mesh-sharded sweep on both controllers.  The
+    attempt-outcome agreement must keep retry/bisect decisions in
+    lockstep (divergence deadlocks — the parent timeout catches it),
+    both processes must produce the identical quarantine mask, and every
+    unaffected point must bitwise-match a clean single-process run."""
+    port = _free_port()
+    worker = os.path.join(os.path.dirname(__file__), "_mp_faults_worker.py")
+
+    env = dict(os.environ)
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_COORDINATOR_ADDRESS", None)
+    env.pop("JAX_NUM_PROCESSES", None)
+    env.pop("JAX_PROCESS_ID", None)
+    env.pop("BDLZ_FAULT_PLAN", None)  # the plan is the worker's, inline
+
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, str(port), str(pid), str(tmp_path)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
+        for pid in (0, 1)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=540)
+            outs.append((p.returncode, out, err))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for rc, out, err in outs:
+        assert_worker_ok(rc, out, err)
+        assert "OK" in out
+
+    r0 = np.load(tmp_path / "faults_p0.npz")
+    r1 = np.load(tmp_path / "faults_p1.npz")
+    np.testing.assert_array_equal(r0["quarantined"], r1["quarantined"])
+    np.testing.assert_array_equal(r0["failed"], r1["failed"])
+    np.testing.assert_array_equal(r0["DM_over_B"], r1["DM_over_B"])
+    expected = np.zeros(8, dtype=bool)
+    expected[5] = True
+    np.testing.assert_array_equal(r0["quarantined"], expected)
+
+    # unaffected points bitwise-match a clean (no faults) run of the same
+    # grid on this runtime
+    from bdlz_tpu.config import config_from_dict, static_choices_from_config
+    from bdlz_tpu.parallel import run_sweep
+
+    cfg = config_from_dict({
+        "regime": "nonthermal",
+        "P_chi_to_B": 0.14925839040304145,
+        "source_shape_sigma_y": 9.0,
+        "incident_flux_scale": 1.07e-9,
+        "Y_chi_init": 4.90e-10,
+    })
+    static = static_choices_from_config(cfg)
+    axes = {"m_chi_GeV": np.geomspace(0.3, 3.0, 8).tolist()}
+    ref = run_sweep(cfg, axes, static, mesh=make_mesh(), chunk_size=4, n_y=2000)
+    keep = ~expected
+    np.testing.assert_allclose(
+        r0["DM_over_B"][keep], ref.outputs["DM_over_B"][keep], rtol=1e-12
+    )
+    assert np.isnan(r0["DM_over_B"][5])
+
+
 def test_two_process_mcmc(tmp_path):
     """The r4 multihost MCMC wiring, executed for real: 2 processes run a
     checkpointed chain over one global mesh; per-segment chains gather via

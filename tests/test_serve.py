@@ -11,8 +11,14 @@ import numpy as np
 import pytest
 
 from bdlz_tpu.emulator import load_artifact
-from bdlz_tpu.serve import BatchResult, MicroBatcher, YieldService
+from bdlz_tpu.serve import (
+    BatchResult,
+    DeadlineExceeded,
+    MicroBatcher,
+    YieldService,
+)
 from bdlz_tpu.utils.profiling import ServeStats
+from bdlz_tpu.utils.retry import RetryPolicy
 
 
 class FakeClock:
@@ -148,6 +154,130 @@ class TestMicroBatcherPolicy:
             MicroBatcher(lambda t: [], max_batch_size=0)
         with pytest.raises(ValueError, match="max_wait_s"):
             MicroBatcher(lambda t: [], max_wait_s=-1.0)
+        with pytest.raises(ValueError, match="deadline_s"):
+            MicroBatcher(lambda t: [], deadline_s=0.0)
+
+
+class TestDeadlines:
+    """Per-request deadlines on the injectable clock: an expired request
+    is answered with the typed DeadlineExceeded at dispatch instead of
+    aging the batch — and tier-1 never sleeps to prove it."""
+
+    def _batcher(self, deadline_s=0.05, process=None):
+        clock = FakeClock()
+        mb = MicroBatcher(
+            process or (lambda thetas: [float(t[0]) for t in thetas]),
+            max_batch_size=4, max_wait_s=0.010, clock=clock,
+            stats=ServeStats(), deadline_s=deadline_s,
+        )
+        return mb, clock
+
+    def test_expired_requests_killed_fresh_ones_served(self):
+        mb, clock = self._batcher()
+        stale = [mb.submit([1.0]), mb.submit([2.0])]
+        clock.advance(0.06)            # both stale past the deadline
+        fresh = mb.submit([3.0])
+        clock.advance(0.011)           # policy fires on max_wait age
+        assert mb.run_once() == 3      # 2 killed + 1 served
+        for f in stale:
+            with pytest.raises(DeadlineExceeded, match="deadline"):
+                f.result(timeout=0)
+        assert fresh.result(timeout=0) == 3.0
+        s = mb.stats.summary()
+        assert s["deadline_kills"] == 2
+        # the served batch never saw the stale requests' wait
+        assert s["requests"] == 1 and s["batches"] == 1
+
+    def test_deadline_must_exceed_max_wait(self):
+        """deadline_s <= max_wait_s would deterministically shed every
+        sparse request (the wait policy ages lone requests to max_wait_s
+        before dispatch) — rejected at construction."""
+        with pytest.raises(ValueError, match="must exceed max_wait_s"):
+            MicroBatcher(
+                lambda t: [], max_wait_s=0.005, deadline_s=0.002,
+            )
+
+    def test_expired_requests_free_their_dispatch_slots(self):
+        """Expired requests are drained from the queue head BEFORE the
+        batch is sliced, so dead requests never consume dispatch slots
+        that still-live requests behind them need."""
+        mb, clock = self._batcher(deadline_s=0.05)
+        stale = [mb.submit([float(i)]) for i in range(3)]
+        clock.advance(0.06)
+        live = [mb.submit([10.0 + i]) for i in range(4)]  # a full batch
+        assert mb.run_once() == 7      # 3 killed + 4 served in ONE pass
+        for f in stale:
+            with pytest.raises(DeadlineExceeded):
+                f.result(timeout=0)
+        assert [f.result(timeout=0) for f in live] == [10.0, 11.0, 12.0, 13.0]
+        s = mb.stats.summary()
+        assert s["deadline_kills"] == 3
+        assert s["batches"] == 1 and s["requests"] == 4
+
+    def test_fully_expired_dispatch_records_no_batch_row(self):
+        mb, clock = self._batcher()
+        f = mb.submit([1.0])
+        clock.advance(1.0)
+        assert mb.run_once() == 1
+        with pytest.raises(DeadlineExceeded):
+            f.result(timeout=0)
+        s = mb.stats.summary()
+        assert s["deadline_kills"] == 1 and s["batches"] == 0
+
+    def test_injected_slow_clock_triggers_deadline_kills(self):
+        """The "slow collections" fault class: an injected clock delay
+        (site "clock", applied THROUGH the injectable clock, never a
+        real sleep) ages the queue past the deadline at dispatch."""
+        from bdlz_tpu.faults import FaultPlan
+
+        clock = FakeClock()
+        mb = MicroBatcher(
+            lambda thetas: [float(t[0]) for t in thetas],
+            max_batch_size=4, max_wait_s=0.010, clock=clock,
+            stats=ServeStats(), deadline_s=0.05,
+            fault_plan=FaultPlan.from_obj(
+                [{"site": "clock", "kind": "slow", "delay_s": 1.0}]
+            ),
+        )
+        f = mb.submit([1.0])
+        assert mb.run_once() == 1   # injected delay: ready AND expired
+        with pytest.raises(DeadlineExceeded):
+            f.result(timeout=0)
+        assert mb.stats.summary()["deadline_kills"] == 1
+        assert clock.t == 0.0       # the real clock never moved
+
+    def test_within_deadline_served_normally(self):
+        mb, clock = self._batcher(deadline_s=10.0)
+        f = mb.submit([4.0])
+        clock.advance(0.02)
+        assert mb.run_once() == 1
+        assert f.result(timeout=0) == 4.0
+        assert mb.stats.summary()["deadline_kills"] == 0
+
+
+class TestPerRequestErrors:
+    def test_batch_result_errors_isolated_per_future(self):
+        """A BatchResult carrying per-request errors fails ONLY those
+        futures; batchmates deliver, and the stats row records the
+        degraded-mode counters."""
+        boom = RuntimeError("exact fallback dead")
+
+        def process(thetas):
+            errs = [boom if t[0] > 1.5 else None for t in thetas]
+            return BatchResult(
+                values=[float(t[0]) for t in thetas],
+                n_fallback=1, errors=errs, n_retries=1,
+            )
+
+        mb, clock, _ = _echo_batcher(max_batch_size=2, process=process)
+        f_ok, f_bad = mb.submit([1.0]), mb.submit([2.0])
+        assert mb.run_once() == 2
+        assert f_ok.result(timeout=0) == 1.0
+        with pytest.raises(RuntimeError, match="exact fallback dead"):
+            f_bad.result(timeout=0)
+        s = mb.stats.summary()
+        assert s["errors"] == 1 and s["retries"] == 1
+        assert s["quarantine_rate"] == pytest.approx(0.5)
 
 
 class TestYieldService:
@@ -216,6 +346,69 @@ class TestYieldService:
         )
         np.testing.assert_allclose(theta, [1.0, 100.0, 0.3])
 
+    def test_exact_fallback_failure_isolated_per_request(self, tiny_emulator):
+        """A persistently failing exact fallback (site "serve_exact",
+        every call) poisons ONLY the out-of-domain requests; the
+        emulator-path results still deliver through the batcher."""
+        base, out_dir, _, _ = tiny_emulator
+        svc = YieldService(
+            load_artifact(out_dir), base, max_batch_size=4,
+            fault_plan='{"faults": [{"site": "serve_exact", "kind": "raise"}]}',
+            retry=RetryPolicy(max_attempts=2, backoff_s=0.0,
+                              sleep=lambda s: None),
+        )
+        clock = FakeClock()
+        mb = svc.make_batcher(max_wait_s=0.005, clock=clock)
+        f_in = mb.submit([1.0, 100.0, 0.30])
+        f_ood = mb.submit([1.0, 100.0, 0.60])    # out-of-domain
+        f_in2 = mb.submit([0.95, 95.0, 0.28])
+        clock.advance(0.006)
+        assert mb.run_once() == 3
+        assert np.isfinite(f_in.result(timeout=0))
+        assert np.isfinite(f_in2.result(timeout=0))
+        with pytest.raises(RuntimeError, match="injected fault"):
+            f_ood.result(timeout=0)
+        s = svc.stats.summary()
+        assert s["errors"] == 1 and s["retries"] == 1
+        assert s["quarantine_rate"] == pytest.approx(1 / 3, abs=1e-4)
+
+    def test_exact_fallback_transient_retried_once(self, tiny_emulator):
+        """One transient exact failure costs one (injected, never slept)
+        backoff, not the request: the retried call answers with the real
+        exact value."""
+        base, out_dir, _, _ = tiny_emulator
+        sleeps = []
+        svc = YieldService(
+            load_artifact(out_dir), base, max_batch_size=4,
+            fault_plan='{"faults": [{"site": "serve_exact", '
+                       '"kind": "transient", "times": 1}]}',
+            retry=RetryPolicy(max_attempts=2, backoff_s=0.01,
+                              sleep=sleeps.append),
+        )
+        ref = YieldService(load_artifact(out_dir), base, max_batch_size=4)
+        thetas = np.array([[1.0, 100.0, 0.30], [1.0, 100.0, 0.60]])
+        values, n_fallback, errors, n_retries = svc._evaluate_isolated(thetas)
+        assert n_fallback == 1 and n_retries == 1
+        assert errors == [None, None]
+        assert len(sleeps) == 1
+        np.testing.assert_array_equal(values, ref.evaluate(thetas)[0])
+
+    def test_evaluate_keeps_loud_contract(self, tiny_emulator):
+        """Direct evaluate() callers still get the raise (the batcher
+        path is where isolation lives)."""
+        base, out_dir, _, _ = tiny_emulator
+        svc = YieldService(
+            load_artifact(out_dir), base, max_batch_size=4,
+            fault_plan='{"faults": [{"site": "serve_exact", "kind": "raise"}]}',
+            retry=RetryPolicy(max_attempts=2, backoff_s=0.0,
+                              sleep=lambda s: None),
+        )
+        with pytest.raises(RuntimeError, match="injected fault"):
+            svc.evaluate(np.array([[1.0, 100.0, 0.60]]))
+        # in-domain-only batches never touch the fallback: still served
+        vals, n_fallback = svc.evaluate(np.array([[1.0, 100.0, 0.30]]))
+        assert n_fallback == 0 and np.isfinite(vals).all()
+
     def test_stale_physics_rejected_at_construction(self, tiny_emulator):
         import dataclasses
 
@@ -261,3 +454,74 @@ class TestServeCLI:
         assert [r["id"] for r in out_lines] == ["a", "b", "ood"]
         assert all(np.isfinite(r["value"]) for r in out_lines)
         assert all(r["latency_s"] >= 0 for r in out_lines)
+
+    def test_malformed_lines_answered_not_fatal(self, tiny_emulator,
+                                                tmp_path, capsys):
+        """A malformed / axis-missing request line gets a structured
+        per-line error record and the stream keeps draining; exit is 0
+        because at least one line served."""
+        base, out_dir, _, _ = tiny_emulator
+        cfg = tmp_path / "cfg.json"
+        cfg.write_text(json.dumps({
+            "regime": "nonthermal",
+            "P_chi_to_B": 0.14925839040304145,
+            "source_shape_sigma_y": 9.0,
+            "incident_flux_scale": 1.07e-9,
+            "Y_chi_init": 4.90e-10,
+        }))
+        reqs = tmp_path / "reqs.jsonl"
+        reqs.write_text("\n".join([
+            "{not json at all",
+            json.dumps({"id": "missing", "m_chi_GeV": 1.0}),  # axes absent
+            json.dumps({"id": "short", "theta": [1.0]}),      # wrong dim
+            json.dumps({"id": "good", "m_chi_GeV": 1.0, "T_p_GeV": 100.0,
+                        "v_w": 0.30}),
+        ]) + "\n")
+        from bdlz_tpu.serve.serve_cli import main
+
+        rc = main([
+            "--config", str(cfg), "--artifact", out_dir,
+            "--requests", str(reqs), "--max-batch", "8",
+            "--max-wait-ms", "1",
+        ])
+        assert rc == 0
+        out_lines = [
+            json.loads(line)
+            for line in capsys.readouterr().out.strip().splitlines()
+        ]
+        assert len(out_lines) == 4
+        assert out_lines[0]["error"] and out_lines[0]["line"] == 1
+        assert out_lines[0]["id"] is None  # unparseable: no id to echo
+        assert "missing axes" in out_lines[1]["error"]
+        assert out_lines[1]["id"] == "missing"  # client id echoed back
+        assert "coordinates" in out_lines[2]["error"]
+        assert out_lines[3]["id"] == "good"
+        assert np.isfinite(out_lines[3]["value"])
+
+    def test_all_lines_failed_exits_nonzero(self, tiny_emulator, tmp_path,
+                                            capsys):
+        base, out_dir, _, _ = tiny_emulator
+        cfg = tmp_path / "cfg.json"
+        cfg.write_text(json.dumps({
+            "regime": "nonthermal",
+            "P_chi_to_B": 0.14925839040304145,
+            "source_shape_sigma_y": 9.0,
+            "incident_flux_scale": 1.07e-9,
+            "Y_chi_init": 4.90e-10,
+        }))
+        reqs = tmp_path / "reqs.jsonl"
+        reqs.write_text("{broken\n" + json.dumps({"id": "x"}) + "\n")
+        from bdlz_tpu.serve.serve_cli import main
+
+        rc = main([
+            "--config", str(cfg), "--artifact", out_dir,
+            "--requests", str(reqs), "--max-batch", "8",
+            "--max-wait-ms", "1",
+        ])
+        assert rc == 1
+        out_lines = [
+            json.loads(line)
+            for line in capsys.readouterr().out.strip().splitlines()
+        ]
+        assert len(out_lines) == 2
+        assert all("error" in r for r in out_lines)
