@@ -149,6 +149,17 @@ class Config:
     # deterministic jitter; tests inject a no-op sleep).
     retry_max_attempts: int = 3
     retry_backoff_s: float = 0.05
+    # ---- serving-fleet knobs (bdlz_tpu/serve/fleet.py,
+    # docs/serving.md) — host-side orchestration like the retry knobs:
+    # they change WHERE queries run and what overload sheds, never a
+    # served value's bits, so they are excluded from every result
+    # identity (SERVE_CONFIG_FIELDS below). ----
+    # Device query replicas per process: None = one per local device.
+    n_replicas: Optional[int] = None
+    # Admission-control bound on the serve queue: submit beyond this
+    # many waiting requests is rejected with the typed QueueFull.
+    # None = unbounded (the pre-fleet behavior).
+    queue_bound: Optional[int] = None
 
 
 def default_config() -> Dict[str, Any]:
@@ -214,6 +225,13 @@ ROBUSTNESS_CONFIG_FIELDS = (
     "retry_max_attempts", "retry_backoff_s",
 )
 
+#: Serving-fleet knobs with the same exclusion rule: replica count and
+#: admission bounds are deployment shape, not physics — a served value
+#: is bit-identical at any replica count (pinned by the fleet parity
+#: tests), and keying them into identities would stale every artifact
+#: whenever an operator resizes the fleet.
+SERVE_CONFIG_FIELDS = ("n_replicas", "queue_bound")
+
 
 def config_identity_dict(cfg: Config) -> Dict[str, Any]:
     """The config as a resume-identity payload.
@@ -230,7 +248,11 @@ def config_identity_dict(cfg: Config) -> Dict[str, Any]:
     defaults = default_config()
     out: Dict[str, Any] = {k: getattr(cfg, k) for k in REFERENCE_KEYS}
     for k in defaults:
-        if k in REFERENCE_KEYS or k in ROBUSTNESS_CONFIG_FIELDS:
+        if (
+            k in REFERENCE_KEYS
+            or k in ROBUSTNESS_CONFIG_FIELDS
+            or k in SERVE_CONFIG_FIELDS
+        ):
             continue
         if k in RESULT_AFFECTING_EXTENSIONS or getattr(cfg, k) != defaults[k]:
             out[k] = getattr(cfg, k)
@@ -312,6 +334,10 @@ def validate(cfg: Config, backend: Optional[str] = None) -> Config:
             f"fault_plan must be JSON text or a file path, got "
             f"{cfg.fault_plan!r}"
         )
+    if cfg.n_replicas is not None and cfg.n_replicas < 1:
+        raise ConfigError("n_replicas must be >= 1 (or null = all devices)")
+    if cfg.queue_bound is not None and cfg.queue_bound < 1:
+        raise ConfigError("queue_bound must be >= 1 (or null = unbounded)")
     return cfg
 
 

@@ -70,6 +70,22 @@ class EmulatorArtifact(NamedTuple):
             n *= len(nodes)
         return n
 
+    @property
+    def content_hash(self) -> str:
+        """The artifact's content hash — the token the serving fleet
+        stamps on every response and the rollout layer agrees on across
+        hosts.  Loaded artifacts carry it in the manifest (already
+        verified against the bytes at load); a freshly built, not yet
+        saved artifact computes it on demand — either way the value is
+        identical to what :func:`save_artifact` would write."""
+        h = self.manifest.get("hash")
+        if h is not None:
+            return str(h)
+        return artifact_hash(
+            self.axis_names, self.axis_nodes, self.axis_scales,
+            self.values, self.identity,
+        )
+
 
 def build_identity(base, static, n_y: int, impl: str) -> Dict[str, Any]:
     """The physics identity an artifact is valid for.

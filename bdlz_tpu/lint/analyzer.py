@@ -88,6 +88,15 @@ STATIC_PARAM_NAMES = {
     "fault_injection",
     "retry_enabled",
     "retry_policy",
+    # serving-fleet knobs (bdlz_tpu/serve/fleet.py, rollout.py): replica
+    # counts, admission bounds, the routing-policy string, and the
+    # rollout driver object are host-side orchestration, never
+    # tracer-valued — same specific-names-only rule as the robustness
+    # knobs above.
+    "n_replicas",
+    "queue_bound",
+    "routing",
+    "rollout",
     "n_y",
     "nz",
     "n_mu",

@@ -31,7 +31,10 @@ What this module adds on top of raw JAX:
   that depend on process-0-only state (manifest files on non-shared
   storage) stay identical everywhere.  Multi-controller JAX requires every
   process to launch the same computations in the same order; a divergent
-  skip-this-chunk decision would deadlock the run.
+  skip-this-chunk decision would deadlock the run;
+* :func:`broadcast_text` — the fixed-width string variant of the
+  coordinator broadcast, for small control-plane tokens (the serving
+  tier's rollout cutover agrees on the staged artifact hash this way).
 """
 from __future__ import annotations
 
@@ -187,6 +190,30 @@ def is_coordinator() -> bool:
     import jax
 
     return jax.process_index() == 0
+
+
+def broadcast_text(s: str, width: int = 64) -> str:
+    """Replicate a short control string from process 0 to all processes.
+
+    Identity in single-process runs.  The string is carried as a
+    fixed-``width`` zero-padded uint8 array (``broadcast_from_coordinator``
+    requires equal shapes on every caller — variable-length payloads
+    would deadlock), so it fits small control-plane tokens only: the
+    serving tier broadcasts the staged artifact hash during a rollout
+    cutover so every host activates the SAME build (serve/rollout.py).
+    """
+    import numpy as np  # host-side gather/bcast buffers (bdlz-lint R1 audit)
+
+    payload = s.encode("utf-8")
+    if len(payload) > width:
+        raise ValueError(
+            f"control string of {len(payload)} bytes exceeds the "
+            f"{width}-byte broadcast width"
+        )
+    arr = np.zeros(width, dtype=np.uint8)
+    arr[: len(payload)] = np.frombuffer(payload, dtype=np.uint8)
+    out = np.asarray(broadcast_from_coordinator(arr), dtype=np.uint8)
+    return bytes(out.tobytes()).rstrip(b"\x00").decode("utf-8")
 
 
 def broadcast_from_coordinator(arr):

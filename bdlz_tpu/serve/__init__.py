@@ -1,13 +1,32 @@
-"""Microbatching query service over the yield-surface emulator
-(`bdlz_tpu/emulator/`): request queue + dynamic batching
-(max-batch-size / max-wait-latency), per-request out-of-domain fallback
-to the exact pipeline, and per-batch observability rows
-(``utils.profiling.ServeStats``).  Entry point: ``python -m
-bdlz_tpu.serve`` (``serve_cli.py``)."""
+"""Serving layer over the yield-surface emulator (`bdlz_tpu/emulator/`):
+
+* single-process front — request queue + dynamic batching (max-batch /
+  max-wait), per-request out-of-domain fallback to the exact pipeline,
+  per-batch observability rows (``utils.profiling.ServeStats``);
+* sharded fleet (``fleet.py``) — per-device query replicas with
+  round-robin / least-loaded micro-batch routing, bounded-queue
+  admission control and deadline-aware load shedding;
+* zero-downtime artifact rollout (``rollout.py``) — stage artifact N+1
+  beside N, warm its kernels, cut over atomically with multihost
+  agreement; responses always carry the artifact hash that answered.
+
+Entry point: ``python -m bdlz_tpu.serve`` (``serve_cli.py``).  Semantics
+reference: docs/serving.md."""
 from bdlz_tpu.serve.batcher import (  # noqa: F401
     BatchResult,
     DeadlineExceeded,
     MicroBatcher,
+    QueueFull,
     drain_results,
 )
-from bdlz_tpu.serve.service import YieldService  # noqa: F401
+from bdlz_tpu.serve.fleet import (  # noqa: F401
+    FleetResponse,
+    FleetService,
+    ReplicaSet,
+)
+from bdlz_tpu.serve.rollout import ArtifactRollout, RolloutError  # noqa: F401
+from bdlz_tpu.serve.service import (  # noqa: F401
+    ExactFallback,
+    YieldService,
+    resolve_service_static,
+)

@@ -47,6 +47,18 @@ class DeadlineExceeded(RuntimeError):
     """
 
 
+class QueueFull(RuntimeError):
+    """Admission control rejected a request: the queue is at its bound.
+
+    Raised synchronously at :meth:`MicroBatcher.submit` (and the fleet's
+    ``FleetService.submit``) when ``queue_bound`` requests are already
+    waiting — the caller finds out *immediately* that the service is
+    overloaded, instead of parking a future that a deadline will kill
+    seconds later.  Typed so load generators and the CLI can count shed
+    traffic apart from evaluation failures.
+    """
+
+
 class BatchResult(NamedTuple):
     """What a process_batch callback returns: per-request values plus
     how many of them took the out-of-domain exact fallback.
@@ -83,11 +95,20 @@ class MicroBatcher:
         stats: Optional[ServeStats] = None,
         deadline_s: Optional[float] = None,
         fault_plan=None,
+        queue_bound: Optional[int] = None,
     ):
         if max_batch_size < 1:
             raise ValueError("max_batch_size must be >= 1")
         if max_wait_s < 0.0:
             raise ValueError("max_wait_s must be >= 0")
+        if queue_bound is not None and queue_bound < max_batch_size:
+            # a bound below one batch would cap every dispatch below
+            # max_batch_size — occupancy could never reach 1.0 and the
+            # knob would silently act as a smaller max_batch
+            raise ValueError(
+                f"queue_bound ({queue_bound}) must be >= max_batch_size "
+                f"({max_batch_size}) or None (unbounded)"
+            )
         if deadline_s is not None and deadline_s <= 0.0:
             raise ValueError("deadline_s must be > 0 (or None)")
         if deadline_s is not None and deadline_s <= max_wait_s:
@@ -108,6 +129,11 @@ class MicroBatcher:
         #: Measured on the SAME injectable clock as the wait policy, so
         #: tier-1 drives expiry with a fake clock and never sleeps.
         self.deadline_s = None if deadline_s is None else float(deadline_s)
+        #: Admission control: submit raises :class:`QueueFull` once this
+        #: many requests are waiting (None = unbounded, the pre-fleet
+        #: behavior).  Overload then degrades to a measured reject rate
+        #: at the front door instead of unbounded queue latency.
+        self.queue_bound = None if queue_bound is None else int(queue_bound)
         #: Injected "slow collection" faults (bdlz_tpu.faults, site
         #: "clock", keyed by batch index): the delay is applied THROUGH
         #: the clock at dispatch — requests look older, deadlines fire —
@@ -125,11 +151,26 @@ class MicroBatcher:
     # ---- enqueue ----------------------------------------------------
 
     def submit(self, theta) -> Future:
-        """Enqueue one d-dimensional query; resolves to its value."""
+        """Enqueue one d-dimensional query; resolves to its value.
+
+        Raises :class:`QueueFull` (synchronously — the request never
+        enters the queue) when admission control is configured and the
+        queue is at its bound.
+        """
         theta = np.asarray(theta, dtype=np.float64).reshape(-1)
         fut: Future = Future()
         with self._wake:
+            if (
+                self.queue_bound is not None
+                and len(self._queue) >= self.queue_bound
+            ):
+                self.stats.record_admission_rejects(1)
+                raise QueueFull(
+                    f"queue at its admission bound ({self.queue_bound} "
+                    "requests waiting); retry later or raise queue_bound"
+                )
             self._queue.append(_Pending(theta, self._clock(), fut))
+            self.stats.record_accepted(1)
             self._wake.notify()
         return fut
 

@@ -1,0 +1,639 @@
+"""Sharded serving fleet: per-device query replicas + overload control.
+
+The single-process :class:`~bdlz_tpu.serve.batcher.MicroBatcher` front
+serves one artifact through one jitted kernel on the default device —
+fine for one user, a ceiling for the north star's "millions".  This
+module makes the batching/routing layer the product:
+
+* :class:`ReplicaSet` — one emulator artifact replicated onto every
+  local device: the padded query kernel is **pre-compiled per bucket
+  shape on each device at load** (the warm start — no first-request
+  compile spike), and micro-batches are routed round-robin or
+  least-loaded so aggregate QPS scales with device count.  Dispatch is
+  asynchronous (JAX async dispatch): a batch is in flight on replica k
+  while the next one is being routed to replica k+1 — the host never
+  blocks a device on another device's result.
+* :class:`FleetService` — the request-plane front: per-request futures,
+  the MicroBatcher's dispatch policy (full batch OR oldest-age
+  ``max_wait_s``), **admission control** (bounded queue, typed
+  :class:`~bdlz_tpu.serve.batcher.QueueFull` at submit) and
+  **deadline-aware shedding** at dispatch (typed ``DeadlineExceeded``),
+  so overload degrades to a measured shed rate instead of unbounded
+  latency.  Every response is a :class:`FleetResponse` carrying the
+  hash of the artifact that answered it — the rollout layer's
+  never-mix-surfaces guarantee is checkable per request.
+
+Design for testability (same contract as the batcher): every policy
+decision is a pure function of (queue state, now) on an injectable
+clock; device completion is observed with ``is_ready()``/blocking
+gathers, never sleeps — tier-1 drives admission, shedding, and rollout
+cutovers with a fake clock and zero real waiting.  Semantics reference:
+docs/serving.md.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from typing import (
+    Any,
+    Callable,
+    Deque,
+    Dict,
+    List,
+    NamedTuple,
+    Optional,
+    Sequence,
+    Tuple,
+)
+
+import numpy as np  # host-side use only; jitted paths go through the backend.py xp seam (bdlz-lint R1 audit)
+
+from bdlz_tpu.emulator.artifact import EmulatorArtifact
+from bdlz_tpu.emulator.grid import in_domain_one, interp_log_fields
+from bdlz_tpu.serve.batcher import DeadlineExceeded, QueueFull
+from bdlz_tpu.serve.service import (
+    ExactFallback,
+    _pad_rows,
+    resolve_service_static,
+)
+from bdlz_tpu.utils.profiling import ServeStats
+
+ROUTING_POLICIES = ("round_robin", "least_loaded")
+
+
+class FleetResponse(NamedTuple):
+    """One answered request: the value, which artifact computed it, and
+    which device replica ran the batch.  The hash is stamped at DISPATCH
+    time — during a rollout, in-flight batches resolve with the artifact
+    they were actually answered by, never the one that became active
+    afterwards."""
+
+    value: float
+    artifact_hash: str
+    replica: int
+
+
+class _Replica:
+    """One device-local copy of the artifact's fused query kernel.
+
+    The node/value tables are ``device_put`` onto this replica's device
+    at construction, so the jitted closure compiles and executes there;
+    the kernel fuses interpolation and the domain test into ONE dispatch
+    per batch (the single-process service pays two).
+    """
+
+    def __init__(self, artifact: EmulatorArtifact, device, field: str,
+                 index: int):
+        from bdlz_tpu.backend import ensure_x64
+
+        ensure_x64()
+        import jax
+        import jax.numpy as jnp
+
+        if field not in artifact.values:
+            raise KeyError(
+                f"field {field!r} not in artifact "
+                f"(has {sorted(artifact.values)})"
+            )
+        self.device = device
+        self.index = int(index)
+        #: Batches dispatched but not yet gathered (the least-loaded
+        #: router's signal).
+        self.in_flight = 0
+        scales = artifact.axis_scales
+        nodes = tuple(
+            jax.device_put(
+                jnp.asarray(np.asarray(n, dtype=np.float64)), device
+            )
+            for n in artifact.axis_nodes
+        )
+        logv = {
+            field: jax.device_put(
+                jnp.asarray(np.log10(
+                    np.asarray(artifact.values[field], dtype=np.float64)
+                )),
+                device,
+            )
+        }
+
+        def one(theta):
+            log_f = interp_log_fields(theta, nodes, scales, logv, jnp)[field]
+            inside = in_domain_one(theta, nodes, jnp)
+            return 10.0 ** log_f, inside
+
+        self._fn = jax.jit(jax.vmap(one))
+
+    def dispatch(self, padded: np.ndarray):
+        """Launch one padded batch on this replica's device (async);
+        returns ``(values, inside)`` device arrays."""
+        import jax
+
+        return self._fn(jax.device_put(padded, self.device))
+
+
+class _Handle(NamedTuple):
+    """An in-flight micro-batch: device arrays plus routing provenance."""
+
+    replica: _Replica
+    values: Any          # (bucket,) device array
+    inside: Any          # (bucket,) bool device array
+    n: int               # live rows (bucket - n = padding)
+
+    def done(self) -> bool:
+        """True when the device work finished (no blocking).  Falls back
+        to True when the runtime has no readiness probe — the gather
+        then simply blocks, which is always correct."""
+        try:
+            return bool(self.values.is_ready())
+        except AttributeError:  # older jax: no is_ready on arrays
+            return True
+
+    def gather(self) -> Tuple[np.ndarray, np.ndarray]:
+        """Block for and fetch the batch's ``(values, inside)`` host
+        arrays (writable — the fallback patches OOD slots), releasing
+        the replica's in-flight slot — even when the deferred device
+        error surfaces here (a leaked slot would bias least_loaded
+        routing away from this replica forever)."""
+        try:
+            values = np.array(self.values, dtype=np.float64)[: self.n]
+            inside = np.asarray(self.inside)[: self.n]
+        finally:
+            self.replica.in_flight -= 1
+        return values, inside
+
+
+class ReplicaSet:
+    """One artifact's query kernel replicated across local devices.
+
+    ``n_replicas`` defaults to every local device; more replicas than
+    devices wrap round-robin onto them (useful for pipelining depth on
+    big chips).  ``routing`` picks the dispatch target: ``round_robin``
+    (strict rotation — deterministic, ignores load) or ``least_loaded``
+    (fewest in-flight batches, lowest index on ties — the default;
+    deterministic given the dispatch/gather sequence).
+
+    Construction **warms every replica** unless ``warm=False``: the
+    padded kernel is compiled once per device at the bucket shape and
+    the seconds are recorded in ``stats`` (and ``warmup_seconds``), so
+    the first real query never pays the compile.  A rollout stages its
+    next ReplicaSet with ``warm=False`` and warms it explicitly before
+    the cutover is allowed.
+    """
+
+    def __init__(
+        self,
+        artifact: EmulatorArtifact,
+        field: str = "DM_over_B",
+        n_replicas: Optional[int] = None,
+        devices: Optional[Sequence] = None,
+        max_batch_size: int = 256,
+        routing: str = "least_loaded",
+        warm: bool = True,
+        stats: Optional[ServeStats] = None,
+    ):
+        import jax
+
+        if routing not in ROUTING_POLICIES:
+            raise ValueError(
+                f"routing={routing!r} is not one of {ROUTING_POLICIES}"
+            )
+        if max_batch_size < 1:
+            raise ValueError("max_batch_size must be >= 1")
+        devices = (
+            list(devices) if devices is not None else jax.local_devices()
+        )
+        if not devices:
+            raise ValueError("ReplicaSet needs at least one device")
+        n = len(devices) if n_replicas is None else int(n_replicas)
+        if n < 1:
+            raise ValueError("n_replicas must be >= 1 (or None = all devices)")
+        self.artifact = artifact
+        self.artifact_hash = artifact.content_hash
+        self.field = field
+        self.max_batch_size = int(max_batch_size)
+        self.routing = routing
+        self.stats = stats
+        self.replicas: List[_Replica] = [
+            _Replica(artifact, devices[i % len(devices)], field, i)
+            for i in range(n)
+        ]
+        self._rr = 0
+        self.warmed = False
+        self.warmup_seconds = 0.0
+        if warm:
+            self.warm()
+
+    @property
+    def n_replicas(self) -> int:
+        return len(self.replicas)
+
+    @property
+    def n_devices(self) -> int:
+        """Distinct physical devices behind the replicas (the QPS/chip
+        denominator)."""
+        return len({id(r.device) for r in self.replicas})
+
+    def warm(self) -> float:
+        """Compile the padded bucket kernel on every replica's device.
+
+        Idempotent; records the seconds in the shared ``stats`` (the
+        ``warmup_seconds`` field dashboards watch instead of a p99
+        compile spike).
+        """
+        if self.warmed:
+            return 0.0
+        import jax
+
+        t0 = time.monotonic()
+        lower = np.asarray([n[0] for n in self.artifact.axis_nodes])
+        probe = np.tile(lower, (self.max_batch_size, 1))
+        for r in self.replicas:
+            jax.block_until_ready(r.dispatch(probe))
+        self.warmup_seconds = time.monotonic() - t0
+        self.warmed = True
+        if self.stats is not None:
+            self.stats.record_warmup(self.warmup_seconds)
+        return self.warmup_seconds
+
+    # ---- routing ----------------------------------------------------
+
+    def pick(self) -> _Replica:
+        """The replica the NEXT micro-batch routes to (pure in the
+        current in-flight counts / rotation cursor)."""
+        if self.routing == "round_robin":
+            r = self.replicas[self._rr % len(self.replicas)]
+            self._rr += 1
+            return r
+        return min(self.replicas, key=lambda r: (r.in_flight, r.index))
+
+    def dispatch(self, thetas) -> _Handle:
+        """Route one micro-batch (≤ max_batch_size rows, padded to the
+        bucket) to a replica; returns the async handle."""
+        thetas = np.atleast_2d(np.asarray(thetas, dtype=np.float64))
+        b = thetas.shape[0]
+        if b > self.max_batch_size:
+            raise ValueError(
+                f"micro-batch of {b} rows exceeds max_batch_size "
+                f"{self.max_batch_size}; split it upstream"
+            )
+        if thetas.shape[1] != len(self.artifact.axis_names):
+            raise ValueError(
+                f"queries must have {len(self.artifact.axis_names)} "
+                f"coordinates ({', '.join(self.artifact.axis_names)}), "
+                f"got shape {thetas.shape}"
+            )
+        padded = _pad_rows(thetas, self.max_batch_size)
+        replica = self.pick()
+        # count the slot only once the launch succeeded: a synchronous
+        # dispatch failure must not permanently bias least_loaded
+        # routing away from this replica (the matching decrement lives
+        # in _Handle.gather's finally)
+        values, inside = replica.dispatch(padded)
+        replica.in_flight += 1
+        return _Handle(replica=replica, values=values, inside=inside, n=b)
+
+
+class _Pending(NamedTuple):
+    theta: np.ndarray
+    enqueued_at: float
+    future: Future
+
+
+class _InFlight(NamedTuple):
+    batch: "list[_Pending]"
+    thetas: np.ndarray
+    handle: _Handle
+    artifact_hash: str
+    wait_s: float
+    dispatched_at: float
+    batch_index: int
+
+
+class FleetService:
+    """Per-request serving over a :class:`ReplicaSet`, with overload
+    control.
+
+    The request plane mirrors the MicroBatcher (submit → future; the
+    full-batch / oldest-age dispatch policy on an injectable clock) but
+    dispatches are ASYNCHRONOUS: :meth:`run_once` routes a batch to a
+    replica and returns immediately, :meth:`poll` resolves completed
+    batches — so N replicas genuinely overlap.  On top:
+
+    * **admission control** — ``queue_bound`` waiting requests is the
+      limit; submit raises :class:`QueueFull` synchronously beyond it;
+    * **deadline shedding** — requests older than ``deadline_s`` at
+      dispatch are answered with ``DeadlineExceeded`` (age-ordered
+      prefix, before the batch is sliced);
+    * **out-of-domain fallback** — the shared :class:`ExactFallback`
+      (retried once, fault-injectable, isolated per request);
+    * **rollout seam** — :meth:`swap_replica_set` replaces the active
+      replicas atomically under the dispatch lock; in-flight batches
+      keep their old handles and resolve with the OLD artifact's hash
+      (the drain guarantee — no request is dropped or answered by a
+      half-loaded artifact).
+
+    ``n_replicas`` / ``queue_bound`` default from the base config's
+    serve knobs (orchestration-only — excluded from every result
+    identity, see ``config.SERVE_CONFIG_FIELDS``).
+    """
+
+    def __init__(
+        self,
+        artifact: EmulatorArtifact,
+        base,
+        static=None,
+        field: str = "DM_over_B",
+        max_batch_size: int = 256,
+        n_replicas: Optional[int] = None,
+        devices: Optional[Sequence] = None,
+        routing: str = "least_loaded",
+        queue_bound: Optional[int] = None,
+        max_wait_s: float = 0.005,
+        deadline_s: Optional[float] = None,
+        clock: Callable[[], float] = time.monotonic,
+        mesh=None,
+        retry=None,
+        fault_plan=None,
+        stats: Optional[ServeStats] = None,
+        warm: bool = True,
+    ):
+        from bdlz_tpu.emulator.artifact import build_identity
+
+        static, n_y, impl = resolve_service_static(artifact, base, static)
+        if n_replicas is None:
+            n_replicas = getattr(base, "n_replicas", None)
+        if queue_bound is None:
+            queue_bound = getattr(base, "queue_bound", None)
+        if queue_bound is not None and queue_bound < max_batch_size:
+            raise ValueError(
+                f"queue_bound ({queue_bound}) must be >= max_batch_size "
+                f"({max_batch_size}) or None (unbounded)"
+            )
+        if max_wait_s < 0.0:
+            raise ValueError("max_wait_s must be >= 0")
+        if deadline_s is not None and deadline_s <= max_wait_s:
+            raise ValueError(
+                f"deadline_s ({deadline_s}) must exceed max_wait_s "
+                f"({max_wait_s}): the wait policy ages every "
+                "non-full batch to max_wait_s before dispatch"
+            )
+        self.field = field
+        self.max_batch_size = int(max_batch_size)
+        self.queue_bound = None if queue_bound is None else int(queue_bound)
+        self.max_wait_s = float(max_wait_s)
+        self.deadline_s = None if deadline_s is None else float(deadline_s)
+        self._clock = clock
+        self.stats = stats if stats is not None else ServeStats()
+        #: The identity every artifact this service will EVER serve must
+        #: match (physics + engine + quadrature) — the rollout layer's
+        #: skew check.  Content (values/axes → hash) may differ.
+        self.expected_identity = build_identity(base, static, n_y, impl)
+        self._fallback = ExactFallback(
+            base, static, n_y=n_y, impl=impl, mesh=mesh,
+            chunk_size=self.max_batch_size, retry=retry,
+            fault_plan=fault_plan,
+        )
+        self._faults = self._fallback.fault_plan
+        self.replica_set = ReplicaSet(
+            artifact, field=field, n_replicas=n_replicas, devices=devices,
+            max_batch_size=self.max_batch_size, routing=routing,
+            warm=warm, stats=self.stats,
+        )
+        self._queue: Deque[_Pending] = deque()
+        self._inflight: Deque[_InFlight] = deque()
+        self._lock = threading.Lock()
+        self._batch_index = 0
+
+    @property
+    def artifact(self) -> EmulatorArtifact:
+        return self.replica_set.artifact
+
+    @property
+    def artifact_hash(self) -> str:
+        return self.replica_set.artifact_hash
+
+    # ---- rollout seam ----------------------------------------------
+
+    def swap_replica_set(self, replica_set: ReplicaSet) -> ReplicaSet:
+        """Atomically make ``replica_set`` the active surface.
+
+        The caller (``serve.rollout``) owns validation: identity match,
+        warmed kernels, fleet agreement.  Here only the structural
+        contract is enforced — same field and bucket shape, warmed —
+        because a half-loaded artifact must be unreachable by
+        construction.  Returns the previous set; batches already in
+        flight on it resolve normally with ITS hash.
+        """
+        if replica_set.field != self.field:
+            raise ValueError(
+                f"staged replica set serves field "
+                f"{replica_set.field!r}, service serves {self.field!r}"
+            )
+        if replica_set.max_batch_size != self.max_batch_size:
+            raise ValueError(
+                f"staged replica set bucket {replica_set.max_batch_size} "
+                f"!= service bucket {self.max_batch_size}"
+            )
+        if not replica_set.warmed:
+            raise ValueError(
+                "staged replica set is not warmed; warm() it before the "
+                "cutover so no request pays the compile"
+            )
+        with self._lock:
+            old, self.replica_set = self.replica_set, replica_set
+        return old
+
+    # ---- enqueue (admission control) --------------------------------
+
+    def submit(self, theta) -> Future:
+        """Enqueue one d-dimensional query; resolves to a
+        :class:`FleetResponse`.  Raises :class:`QueueFull` synchronously
+        when admission control is at its bound."""
+        theta = np.asarray(theta, dtype=np.float64).reshape(-1)
+        d = len(self.artifact.axis_names)
+        if theta.shape != (d,):
+            raise ValueError(
+                f"queries must have {d} coordinates "
+                f"({', '.join(self.artifact.axis_names)}), got "
+                f"{theta.shape[0]}"
+            )
+        fut: Future = Future()
+        with self._lock:
+            if (
+                self.queue_bound is not None
+                and len(self._queue) >= self.queue_bound
+            ):
+                self.stats.record_admission_rejects(1)
+                raise QueueFull(
+                    f"queue at its admission bound ({self.queue_bound} "
+                    "requests waiting); retry later or raise queue_bound"
+                )
+            self._queue.append(_Pending(theta, self._clock(), fut))
+            self.stats.record_accepted(1)
+        return fut
+
+    # ---- dispatch policy (pure in queue state + now) ----------------
+
+    def ready_at(self, now: Optional[float] = None) -> bool:
+        """Would a dispatch fire at time ``now``?  (No side effects.)"""
+        now = self._clock() if now is None else now
+        with self._lock:
+            return self._ready_locked(now)
+
+    def _ready_locked(self, now: float) -> bool:
+        if not self._queue:
+            return False
+        if len(self._queue) >= self.max_batch_size:
+            return True
+        return (now - self._queue[0].enqueued_at) >= self.max_wait_s
+
+    def pending(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    def in_flight(self) -> int:
+        """Micro-batches dispatched to replicas but not yet resolved."""
+        with self._lock:
+            return len(self._inflight)
+
+    # ---- dispatch (async) -------------------------------------------
+
+    def run_once(self, force: bool = False) -> int:
+        """Shed the expired prefix and LAUNCH one batch if the policy
+        says so — without waiting for the device (the poll side resolves
+        it).  Returns requests consumed (killed + dispatched)."""
+        now = self._clock()
+        if self._faults is not None:
+            now += self._faults.delay_s("clock", self._batch_index)
+        with self._lock:
+            if not self._queue or not (force or self._ready_locked(now)):
+                return 0
+            # Expired requests are an age-ordered PREFIX of the queue:
+            # drain them before slicing the batch, so dead requests never
+            # consume dispatch slots that still-live ones behind them
+            # need (shedding load must not add latency to the survivors).
+            expired = []
+            if self.deadline_s is not None:
+                while self._queue and (
+                    now - self._queue[0].enqueued_at > self.deadline_s
+                ):
+                    expired.append(self._queue.popleft())
+            batch = [
+                self._queue.popleft()
+                for _ in range(min(len(self._queue), self.max_batch_size))
+            ]
+            replica_set = self.replica_set
+        n_expired = len(expired)
+        for p in expired:
+            age = now - p.enqueued_at
+            p.future.set_exception(DeadlineExceeded(
+                f"request aged {age:.6f}s past the "
+                f"{self.deadline_s:.6f}s service deadline before dispatch"
+            ))
+        if n_expired:
+            self.stats.record_deadline_kills(n_expired)
+        if not batch:
+            return n_expired
+        wait_s = max(now - p.enqueued_at for p in batch)
+        thetas = np.stack([p.theta for p in batch])
+        try:
+            handle = replica_set.dispatch(thetas)
+        except Exception as exc:  # noqa: BLE001 — delivered per-request
+            for p in batch:
+                p.future.set_exception(exc)
+            return len(batch) + n_expired
+        with self._lock:
+            self._inflight.append(_InFlight(
+                batch=batch, thetas=thetas, handle=handle,
+                artifact_hash=replica_set.artifact_hash,
+                wait_s=float(wait_s), dispatched_at=self._clock(),
+                batch_index=self._batch_index,
+            ))
+            self._batch_index += 1
+        return len(batch) + n_expired
+
+    # ---- resolve ----------------------------------------------------
+
+    def poll(self, block: bool = False) -> int:
+        """Resolve the OLDEST in-flight batch if it is done (or
+        unconditionally when ``block=True``).  Returns requests
+        resolved.  In-order resolution keeps per-replica FIFO semantics
+        and makes the rollout drain a simple queue walk."""
+        with self._lock:
+            if not self._inflight:
+                return 0
+            if not block and not self._inflight[0].handle.done():
+                return 0
+            item = self._inflight.popleft()
+        values, inside = item.handle.gather()  # blocks if still running
+        b = len(item.batch)
+        n_fallback = int((~inside).sum())
+        errors: "list[Optional[BaseException]]" = [None] * b
+        retries_box = [0]
+        if n_fallback:
+            ood = _pad_rows(item.thetas[~inside], self.max_batch_size)
+            axes = {
+                name: ood[:, k]
+                for k, name in enumerate(self.artifact.axis_names)
+            }
+            try:
+                exact_fields = self._fallback(axes, retries_box)
+                values[~inside] = exact_fields[self.field][:n_fallback]
+            except Exception as exc:  # noqa: BLE001 — isolated per request
+                for i in np.flatnonzero(~inside):
+                    errors[int(i)] = exc
+                    values[int(i)] = np.nan
+        now = self._clock()
+        self.stats.record_batch(
+            batch_index=item.batch_index,
+            size=b,
+            occupancy=b / self.max_batch_size,
+            wait_s=item.wait_s,
+            n_fallback=n_fallback,
+            seconds=float(now - item.dispatched_at),
+            n_retries=retries_box[0],
+            n_error=sum(e is not None for e in errors),
+            artifact_hash=item.artifact_hash,
+            replica=item.handle.replica.index,
+        )
+        for p, v, e in zip(item.batch, values, errors):
+            self.stats.record_latency(now - p.enqueued_at)
+            # per-request error isolation: a poisoned request gets its
+            # exception, its batchmates still get their values
+            if e is not None:
+                p.future.set_exception(e)
+            else:
+                p.future.set_result(FleetResponse(
+                    value=float(v),
+                    artifact_hash=item.artifact_hash,
+                    replica=item.handle.replica.index,
+                ))
+        return b
+
+    def drain(self) -> int:
+        """Dispatch everything queued and resolve every in-flight batch
+        (the shutdown / end-of-stream path — no request is ever
+        dropped).  Keeps up to two batches in flight per replica while
+        draining so the replicas stay overlapped.  Returns requests
+        resolved."""
+        depth = 2 * self.replica_set.n_replicas
+        resolved = 0
+        while True:
+            launched = self.run_once(force=True)
+            while self.in_flight() > depth:
+                resolved += self.poll(block=True)
+            if launched == 0 and self.pending() == 0:
+                break
+        while self.in_flight():
+            resolved += self.poll(block=True)
+        return resolved
+
+    # ---- conveniences ----------------------------------------------
+
+    def theta_from_mapping(self, point: Dict[str, float]) -> np.ndarray:
+        """(d,) query vector from an {axis_name: value} mapping."""
+        from bdlz_tpu.serve.service import theta_from_mapping
+
+        return theta_from_mapping(self.artifact, point)

@@ -1,0 +1,199 @@
+"""Zero-downtime artifact rollout: blue/green over the serving fleet.
+
+A production fleet must be able to adopt a rebuilt emulator artifact
+(finer refinement, a widened box) without dropping a request or ever
+answering from a half-loaded surface.  The protocol is classic
+blue/green, riding the PR-3 artifact identity so every way a rollout
+can go wrong is loud:
+
+1. **stage** — load artifact N+1 beside the active N.  The load itself
+   already rejects schema-version skew, content-hash mismatches, and
+   non-finite tables (:func:`~bdlz_tpu.emulator.artifact.load_artifact`);
+   staging additionally rejects IDENTITY skew — an artifact built for
+   different physics (config knobs, engine, n_y, y-quadrature) than the
+   service's exact fallback can never become active.  A fresh
+   :class:`~bdlz_tpu.serve.fleet.ReplicaSet` is built on the same
+   devices/buckets as the active one.
+2. **warm** — compile the staged kernels on every device (recorded as
+   ``warmup_seconds`` in the shared ``ServeStats``).  The cutover
+   REFUSES an unwarmed stage: no request may pay the compile.
+3. **cutover** — fleet-wide agreement first (multi-host runs only; the
+   single-process path is the identity): the coordinator broadcasts its
+   staged hash and every process compares — any skew (a host staged a
+   different build) raises on the host that sees it; then an
+   ``allreduce_min`` readiness vote confirms every host reached the
+   cutover warmed.  Finally the active replica set is swapped
+   atomically under the service's dispatch lock.  Batches already in
+   flight on N resolve normally and carry N's hash; batches dispatched
+   after the swap carry N+1's — a batch NEVER mixes surfaces, which the
+   rollout tests pin via the per-batch ``artifact_hash`` stats rows.
+
+The old replica set is returned from :meth:`ArtifactRollout.cutover`
+(and kept as ``.previous``) so an operator can roll back by staging it
+again — its kernels are still warm.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np  # host-side use only; jitted paths go through the backend.py xp seam (bdlz-lint R1 audit)
+
+from bdlz_tpu.emulator.artifact import (
+    EmulatorArtifact,
+    check_identity,
+    load_artifact,
+)
+from bdlz_tpu.serve.fleet import FleetService, ReplicaSet
+
+#: Fixed width of the hash-agreement broadcast (content hashes are 16
+#: hex chars; headroom for future widening without a wire break).
+HASH_WIRE_WIDTH = 64
+
+
+class RolloutError(RuntimeError):
+    """A rollout step that must not proceed: nothing staged, staged
+    kernels cold, or hash/identity skew across the fleet.  Typed so
+    operators can tell a refused cutover (the service keeps serving N,
+    nothing was lost) from a serving failure."""
+
+
+class ArtifactRollout:
+    """Blue/green rollout driver for one :class:`FleetService`.
+
+    Holds at most one staged replica set at a time.  All methods are
+    host-side orchestration — the serving hot path never checks rollout
+    state; it only ever sees an atomic replica-set swap.
+    """
+
+    def __init__(self, service: FleetService):
+        self.service = service
+        self._staged: Optional[ReplicaSet] = None
+        #: The replica set retired by the last cutover (rollback seam).
+        self.previous: Optional[ReplicaSet] = None
+
+    # ---- introspection ----------------------------------------------
+
+    @property
+    def active_hash(self) -> str:
+        return self.service.artifact_hash
+
+    @property
+    def staged_hash(self) -> Optional[str]:
+        return None if self._staged is None else self._staged.artifact_hash
+
+    def ready(self) -> bool:
+        """True when a staged, warmed replica set awaits cutover."""
+        return self._staged is not None and self._staged.warmed
+
+    # ---- the protocol ----------------------------------------------
+
+    def stage(self, artifact, warm: bool = True) -> str:
+        """Load/validate artifact N+1 and build its replicas beside N.
+
+        ``artifact`` is an :class:`EmulatorArtifact` or a directory path
+        (loaded with full validation).  Identity skew — physics the
+        service's exact fallback was not built for — raises
+        ``EmulatorArtifactError`` here, loudly, before a single replica
+        exists.  Re-staging replaces any previous stage.  Returns the
+        staged content hash.
+        """
+        if not isinstance(artifact, EmulatorArtifact):
+            artifact = load_artifact(str(artifact))
+        # the PR-3 identity check: N+1 must be valid for the SAME
+        # physics/engine/quadrature the service (and its exact fallback)
+        # was constructed for — content (axes, values, hash) may differ
+        check_identity(artifact, self.service.expected_identity)
+        active = self.service.replica_set
+        staged = ReplicaSet(
+            artifact,
+            field=active.field,
+            n_replicas=active.n_replicas,
+            devices=[r.device for r in active.replicas],
+            max_batch_size=active.max_batch_size,
+            routing=active.routing,
+            warm=False,
+            stats=self.service.stats,
+        )
+        if warm:
+            staged.warm()
+        self._staged = staged
+        return staged.artifact_hash
+
+    def warm(self) -> float:
+        """Warm the staged kernels (idempotent); seconds spent."""
+        if self._staged is None:
+            raise RolloutError("nothing staged; call stage() first")
+        return self._staged.warm()
+
+    def abort(self) -> None:
+        """Drop the staged replica set (its device tables are freed with
+        it); the active artifact keeps serving untouched."""
+        self._staged = None
+
+    def cutover(self) -> Tuple[str, str]:
+        """Atomically make the staged artifact the active surface.
+
+        Refuses (typed :class:`RolloutError`, service untouched) when
+        nothing is staged, the stage is cold, or the fleet disagrees on
+        WHICH build is being activated.  Returns ``(old_hash,
+        new_hash)``.
+        """
+        staged = self._staged
+        if staged is None:
+            raise RolloutError("nothing staged; call stage() first")
+        _agree_cutover(staged.artifact_hash, staged.warmed)
+        old = self.service.swap_replica_set(staged)
+        self._staged = None
+        self.previous = old
+        return old.artifact_hash, staged.artifact_hash
+
+
+def _agree_cutover(staged_hash: str, warmed: bool) -> None:
+    """Fleet-wide agreement that every process activates the SAME build,
+    warmed.
+
+    Single-process runs: both collectives are the identity — zero cost,
+    zero behavior change.  Multi-process runs (the multihost serving
+    tier): the coordinator's staged hash is broadcast and compared on
+    every process, and a single ``allreduce_min`` vote carries each
+    process's local verdict (hash matches AND stage warmed).  EVERY
+    process joins BOTH collectives before any of them raises — a
+    process that raised between the collectives would leave its peers
+    blocked inside the next one forever (multi-controller JAX requires
+    all processes to join every collective; see parallel/multihost.py).
+    A failed vote then raises on every process together, each naming
+    its own local cause.  Multi-host callers must still sequence
+    stage()/cutover() uniformly across processes, like every other
+    collective decision in this codebase.
+    """
+    from bdlz_tpu.parallel.multihost import allreduce_min, broadcast_text
+
+    agreed = broadcast_text(staged_hash, width=HASH_WIRE_WIDTH)
+    hash_ok = agreed == staged_hash
+    ready = allreduce_min(
+        np.asarray([1 if (hash_ok and warmed) else 0], dtype=np.int64)
+    )
+    if int(np.asarray(ready).min()) == 1:
+        return
+    if not warmed:
+        raise RolloutError(
+            "staged replicas are cold; warm() them before cutover so "
+            "no request pays the compile"
+        )
+    if not hash_ok:
+        raise RolloutError(
+            f"rollout hash skew: this process staged {staged_hash!r} but "
+            f"the coordinator is activating {agreed!r} — every host must "
+            "stage the same artifact build before cutover"
+        )
+    raise RolloutError(
+        "rollout refused: another process reported hash skew or a cold "
+        "stage"
+    )
+
+
+__all__ = [
+    "ArtifactRollout",
+    "RolloutError",
+    "HASH_WIRE_WIDTH",
+]

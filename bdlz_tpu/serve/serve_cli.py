@@ -2,7 +2,16 @@
 
     python -m bdlz_tpu.serve --config cfg.json --artifact emu_dir/ \
         [--requests queries.jsonl | --bench N] [--max-batch 256] \
-        [--max-wait-ms 5] [--field DM_over_B] [--events events.jsonl]
+        [--max-wait-ms 5] [--field DM_over_B] [--events events.jsonl] \
+        [--replicas N] [--queue-bound Q] [--routing least_loaded]
+
+``--replicas`` switches to the sharded fleet front (serve/fleet.py):
+N per-device query replicas (0 = one per local device) with
+least-loaded or round-robin micro-batch routing, optional bounded-queue
+admission control (``--queue-bound``; rejected requests get structured
+``QueueFull`` error records), and responses that carry the
+``artifact_hash`` that answered them (the rollout provenance,
+docs/serving.md).
 
 Requests are JSON lines, one query each, either an object mapping the
 artifact's axis names to values (``{"m_chi_GeV": 0.95, "T_p_GeV":
@@ -54,6 +63,21 @@ def main(argv: Optional[list] = None) -> int:
                     help="per-request deadline: a request older than this "
                          "at dispatch is answered with DeadlineExceeded "
                          "instead of aging its batch (default: none)")
+    ap.add_argument("--replicas", type=int, default=None, metavar="N",
+                    help="serve through the sharded fleet "
+                         "(serve/fleet.py): N per-device query replicas "
+                         "with least-loaded micro-batch routing; 0 = one "
+                         "replica per local device (default: the "
+                         "single-kernel MicroBatcher front)")
+    ap.add_argument("--queue-bound", type=int, default=None,
+                    help="admission-control bound: submits beyond this "
+                         "many waiting requests are rejected with a "
+                         "structured QueueFull error record (default: "
+                         "unbounded)")
+    ap.add_argument("--routing", default="least_loaded",
+                    choices=("least_loaded", "round_robin"),
+                    help="fleet micro-batch routing policy "
+                         "(--replicas only)")
     ap.add_argument("--events", default=None,
                     help="JSON-lines event log path (default stderr)")
     args = ap.parse_args(argv)
@@ -70,9 +94,26 @@ def main(argv: Optional[list] = None) -> int:
     event_log = EventLog(path=args.events) if args.events else EventLog()
     base = validate(load_config(args.config))
     artifact = load_artifact(args.artifact)
-    service = YieldService(
-        artifact, base, field=args.field, max_batch_size=args.max_batch
-    )
+    fleet = None
+    if args.replicas is not None:
+        from bdlz_tpu.serve.fleet import FleetService
+
+        fleet = FleetService(
+            artifact, base, field=args.field,
+            max_batch_size=args.max_batch,
+            n_replicas=args.replicas if args.replicas > 0 else None,
+            queue_bound=args.queue_bound,
+            routing=args.routing,
+            max_wait_s=args.max_wait_ms / 1e3,
+            deadline_s=(
+                None if args.deadline_ms is None else args.deadline_ms / 1e3
+            ),
+        )
+        service = None
+    else:
+        service = YieldService(
+            artifact, base, field=args.field, max_batch_size=args.max_batch
+        )
     event_log.emit(
         "serve_start",
         artifact=args.artifact,
@@ -81,9 +122,21 @@ def main(argv: Optional[list] = None) -> int:
         max_rel_err=artifact.manifest.get("max_rel_err"),
         max_batch=args.max_batch,
         max_wait_ms=args.max_wait_ms,
+        **(
+            {}
+            if fleet is None
+            else {
+                "replicas": fleet.replica_set.n_replicas,
+                "routing": fleet.replica_set.routing,
+                "queue_bound": fleet.queue_bound,
+                "artifact_hash": fleet.artifact_hash,
+            }
+        ),
     )
 
     if args.bench is not None:
+        if fleet is not None:
+            return _bench_fleet(fleet, int(args.bench), event_log)
         return _bench(service, int(args.bench), args, event_log)
 
     if args.requests is None:
@@ -112,11 +165,12 @@ def main(argv: Optional[list] = None) -> int:
                 )
                 continue
             rid = obj.get("id", ln) if isinstance(obj, dict) else ln
+            front = fleet if fleet is not None else service
             try:
                 theta = (
                     np.asarray(obj["theta"], dtype=np.float64)
                     if "theta" in obj
-                    else service.theta_from_mapping(
+                    else front.theta_from_mapping(
                         {k: v for k, v in obj.items() if k != "id"}
                     )
                 )
@@ -137,8 +191,14 @@ def main(argv: Optional[list] = None) -> int:
         if fh is not sys.stdin:
             fh.close()
 
-    # warm both jitted paths so the first request's latency_s measures
-    # serving, not the XLA compile
+    if fleet is not None:
+        n_ok = _serve_requests_fleet(fleet, requests)
+        event_log.emit("serve_done", **fleet.stats.summary())
+        return 1 if (n_lines and n_ok == 0) else 0
+
+    # warm the exact-fallback path too (the query/domain kernels are
+    # already warmed at construction) so the first request's latency_s
+    # measures serving, not the XLA compile
     service.evaluate(np.array([[nodes[0] for nodes in artifact.axis_nodes]]))
     batcher = service.make_batcher(
         max_wait_s=args.max_wait_ms / 1e3,
@@ -173,6 +233,109 @@ def main(argv: Optional[list] = None) -> int:
         batcher.stop()
     event_log.emit("serve_done", **service.stats.summary())
     return 1 if (n_lines and n_ok == 0) else 0
+
+
+def _serve_requests_fleet(fleet, requests) -> int:
+    """Drain parsed requests through the fleet front.
+
+    Admission rejections (QueueFull) become structured per-request error
+    records like any other per-request failure — and because the fleet
+    queue is pumped between submits, a bounded queue sheds only when the
+    offered rate genuinely exceeds what the replicas drain.  Responses
+    carry the hash of the artifact that answered (the rollout
+    provenance).  Returns the number of requests answered with a value.
+    """
+    from bdlz_tpu.serve.batcher import QueueFull
+
+    n_ok = 0
+    submitted = []  # (rid, future | None, error | None)
+    resolved_at = {}  # submitted index -> resolve-time latency
+
+    def _stamp(index, t0):
+        # latency must be stamped when the FUTURE resolves (inside
+        # poll/drain), not when the record is printed after the whole
+        # stream drained — otherwise the first request would appear to
+        # take as long as serving the entire file
+        def cb(_fut):
+            resolved_at[index] = time.monotonic() - t0
+
+        return cb
+
+    for rid, theta in requests:
+        t0 = time.monotonic()
+        try:
+            fut = fleet.submit(theta)
+            fut.add_done_callback(_stamp(len(submitted), t0))
+            submitted.append((rid, fut, None))
+        except QueueFull as exc:
+            submitted.append((rid, None, exc))
+        fleet.run_once()
+        fleet.poll(block=False)
+    fleet.drain()
+    for index, (rid, fut, err) in enumerate(submitted):
+        if err is not None:
+            print(json.dumps({
+                "id": rid,
+                "error": f"{type(err).__name__}: {err}",
+                "latency_s": 0.0,
+            }))
+            continue
+        latency = round(resolved_at.get(index, 0.0), 6)
+        try:
+            resp = fut.result(timeout=0)
+        except Exception as exc:  # noqa: BLE001 — report per request
+            print(json.dumps({
+                "id": rid,
+                "error": f"{type(exc).__name__}: {exc}",
+                "latency_s": latency,
+            }))
+            continue
+        n_ok += 1
+        print(json.dumps({
+            "id": rid,
+            "value": float(resp.value),
+            "artifact_hash": resp.artifact_hash,
+            "replica": resp.replica,
+            "latency_s": latency,
+        }))
+    return n_ok
+
+
+def _bench_fleet(fleet, n: int, event_log) -> int:
+    """--bench through the fleet: random in-domain traffic, closed-loop
+    pumped so the replicas stay overlapped."""
+    rng = np.random.default_rng(0)
+    art = fleet.artifact
+    lo = np.array([nodes[0] for nodes in art.axis_nodes])
+    hi = np.array([nodes[-1] for nodes in art.axis_nodes])
+    thetas = rng.uniform(lo, hi, size=(n, len(lo)))
+    t0 = time.monotonic()
+    futures = []
+    for t in thetas:
+        futures.append(fleet.submit(t))  # unbounded unless --queue-bound
+        fleet.run_once()
+        fleet.poll(block=False)
+    fleet.drain()
+    values = [f.result(timeout=0).value for f in futures]
+    seconds = time.monotonic() - t0
+    summary = fleet.stats.summary()
+    print(json.dumps({
+        "metric": "serve_bench_queries_per_sec",
+        "value": round(n / max(seconds, 1e-9), 1),
+        "n_queries": n,
+        # "seconds" would be shadowed by the summary's eval-time key
+        "wall_seconds": round(seconds, 4),
+        "finite": int(np.isfinite(np.asarray(values)).sum()),
+        "n_replicas": fleet.replica_set.n_replicas,
+        "routing": fleet.replica_set.routing,
+        "artifact_hash": fleet.artifact_hash,
+        **summary,
+    }))
+    event_log.emit(
+        "serve_bench_done", n_queries=n,
+        wall_seconds=round(seconds, 4), **summary,
+    )
+    return 0
 
 
 def _bench(service, n: int, args, event_log) -> int:

@@ -41,86 +41,95 @@ def _pad_rows(arr: np.ndarray, n: int) -> np.ndarray:
     )
 
 
-class YieldService:
-    """Batched (Ω_DM/Ω_b)-style yield queries against one artifact.
+def theta_from_mapping(
+    artifact: EmulatorArtifact, point: Dict[str, float]
+) -> np.ndarray:
+    """(d,) query vector from an {axis_name: value} mapping — the one
+    request-parsing rule both serving fronts (YieldService and the
+    fleet) delegate to."""
+    missing = [n for n in artifact.axis_names if n not in point]
+    if missing:
+        raise ValueError(f"query is missing axes {missing}")
+    unknown = sorted(set(point) - set(artifact.axis_names))
+    if unknown:
+        raise ValueError(
+            f"query has unknown axes {unknown}; this artifact takes "
+            f"{list(artifact.axis_names)}"
+        )
+    return np.asarray(
+        [float(point[n]) for n in artifact.axis_names]
+    )
 
-    ``base``/``static`` must be the physics the artifact was built for —
-    checked at construction via the artifact identity (axis fields
-    exempt: their per-query values override the base), so a service can
-    never silently pair a stale surface with its exact fallback.  The
-    fallback runs at the ARTIFACT's recorded n_y/engine: both paths
-    answer from the same surface definition.
+
+def resolve_service_static(artifact: EmulatorArtifact, base, static=None):
+    """``(static, n_y, impl)`` a service must run with for ``artifact``.
+
+    The single home of the serve-layer identity rules (YieldService and
+    the fleet must agree bit-for-bit): resolve the caller's static from
+    the base config when absent, ADOPT the artifact's recorded
+    y-quadrature scheme when the caller's tri-state leaves it ``None``
+    (an explicit scheme is checked strictly), then reject any remaining
+    identity mismatch loudly via :func:`check_identity` — a service can
+    never silently pair a stale surface with its exact fallback.
+    """
+    from bdlz_tpu.config import static_choices_from_config
+
+    if static is None:
+        static = static_choices_from_config(base)
+    n_y = int(artifact.identity.get("n_y", 0))
+    impl = str(artifact.identity.get("impl", "tabulated"))
+    q_art = artifact.identity.get("quad_panel_gl")
+    if static.quad_panel_gl is None and q_art is not None:
+        static = static._replace(quad_panel_gl=bool(q_art))
+    check_identity(artifact, build_identity(base, static, n_y, impl))
+    return static, n_y, impl
+
+
+class ExactFallback:
+    """The exact-pipeline fallback behind its robustness seams.
+
+    Shared by :class:`YieldService` and the fleet
+    (:mod:`bdlz_tpu.serve.fleet`): one retried, fault-injectable wrapper
+    around ``emulator.build.make_exact_evaluator`` so the two serving
+    fronts cannot drift in how they answer out-of-domain traffic.
+    Retried ONCE with deterministic backoff when a retry policy is
+    resolved (a transient XLA/dispatch failure should cost one backoff,
+    not the request — a bounded slice of the policy's budget, through
+    the SHARED ``call_with_retry`` primitive); injected ``serve_exact``
+    faults fire keyed by the fallback call counter.  A persistent
+    failure re-raises to the caller, which decides whether to isolate it
+    per-request or propagate.
     """
 
     def __init__(
-        self,
-        artifact: EmulatorArtifact,
-        base,
-        static=None,
-        field: str = "DM_over_B",
-        max_batch_size: int = 256,
-        mesh=None,
-        retry=None,
-        fault_plan=None,
+        self, base, static, *, n_y: int, impl: str, mesh=None,
+        chunk_size: int, retry=None, fault_plan=None,
     ):
-        from bdlz_tpu.config import static_choices_from_config
         from bdlz_tpu.faults import FaultPlan
         from bdlz_tpu.utils.retry import resolve_engine_retry
 
-        if static is None:
-            static = static_choices_from_config(base)
-        # Robustness seams (docs/robustness.md): the exact fallback is
-        # retried once with deterministic backoff and its failures are
-        # isolated to the requests that needed it (process_batch);
-        # injected faults (site "serve_exact") exercise both.  Default:
-        # healing on, injection off, zero overhead.
         self._retry = resolve_engine_retry(retry, base, static)
         self._faults = FaultPlan.resolve(fault_plan, base)
-        self._exact_calls = 0
-        n_y = int(artifact.identity.get("n_y", 0))
-        impl = str(artifact.identity.get("impl", "tabulated"))
-        # the exact fallback must answer from the artifact's recorded
-        # quadrature scheme too: a None (tri-state) caller ADOPTS it; an
-        # explicit caller is checked strictly by check_identity below
-        q_art = artifact.identity.get("quad_panel_gl")
-        if static.quad_panel_gl is None and q_art is not None:
-            static = static._replace(quad_panel_gl=bool(q_art))
-        check_identity(artifact, build_identity(base, static, n_y, impl))
-        self.artifact = artifact
-        self.field = field
-        self.max_batch_size = int(max_batch_size)
-        self._query = make_query_fn(artifact, field=field)
-        self._in_domain = make_domain_fn(artifact)
         self._exact = make_exact_evaluator(
             base, static, n_y=n_y, impl=impl, mesh=mesh,
-            chunk_size=self.max_batch_size,
+            chunk_size=chunk_size,
         )
-        self.stats = ServeStats()
+        self._calls = 0
 
-    # ---- evaluation -------------------------------------------------
+    @property
+    def fault_plan(self):
+        return self._faults
 
-    def _exact_guarded(self, axes, retries_box) -> Dict[str, np.ndarray]:
-        """The exact fallback behind its robustness seams.
-
-        Retried ONCE with deterministic backoff when a retry policy is
-        resolved (a transient XLA/dispatch failure should cost one
-        backoff, not the request — a bounded slice of the policy's
-        budget, through the SHARED ``call_with_retry`` primitive so the
-        serve path cannot drift from the sweep's retry semantics);
-        injected ``serve_exact`` faults fire here, keyed by the
-        fallback call counter.  ``retries_box[0]`` counts the retries
-        paid — success or not, the degraded-mode accounting sees them.
-        A persistent failure re-raises to the caller, which decides
-        whether to isolate it per-request (:meth:`process_batch`) or
-        propagate (:meth:`evaluate`).
-        """
+    def __call__(self, axes, retries_box) -> Dict[str, np.ndarray]:
+        """Evaluate ``axes`` exactly; ``retries_box[0]`` counts retries
+        paid — success or not, the degraded-mode accounting sees them."""
         from bdlz_tpu.utils.retry import call_with_retry
 
         # the fault key is the LOGICAL fallback call — retries share it,
         # so a keyed "raise" spec is truly persistent (only the
         # "transient" kind's times budget distinguishes attempts)
-        call_idx = self._exact_calls
-        self._exact_calls += 1
+        call_idx = self._calls
+        self._calls += 1
 
         def attempt():
             if self._faults is not None:
@@ -144,6 +153,76 @@ class YieldService:
             label=f"serve_exact{call_idx}",
             on_retry=count_retry,
         )
+
+
+class YieldService:
+    """Batched (Ω_DM/Ω_b)-style yield queries against one artifact.
+
+    ``base``/``static`` must be the physics the artifact was built for —
+    checked at construction via the artifact identity (axis fields
+    exempt: their per-query values override the base), so a service can
+    never silently pair a stale surface with its exact fallback.  The
+    fallback runs at the ARTIFACT's recorded n_y/engine: both paths
+    answer from the same surface definition.
+    """
+
+    def __init__(
+        self,
+        artifact: EmulatorArtifact,
+        base,
+        static=None,
+        field: str = "DM_over_B",
+        max_batch_size: int = 256,
+        mesh=None,
+        retry=None,
+        fault_plan=None,
+        warm: bool = True,
+    ):
+        # identity resolution + the retried/fault-injectable exact path
+        # are shared with the fleet (resolve_service_static /
+        # ExactFallback) so the two serving fronts cannot drift.
+        static, n_y, impl = resolve_service_static(artifact, base, static)
+        self.artifact = artifact
+        self.field = field
+        self.max_batch_size = int(max_batch_size)
+        self._query = make_query_fn(artifact, field=field)
+        self._in_domain = make_domain_fn(artifact)
+        self._exact_guarded = ExactFallback(
+            base, static, n_y=n_y, impl=impl, mesh=mesh,
+            chunk_size=self.max_batch_size, retry=retry,
+            fault_plan=fault_plan,
+        )
+        self._faults = self._exact_guarded.fault_plan
+        self.stats = ServeStats()
+        if warm:
+            self.warm_start()
+
+    # ---- evaluation -------------------------------------------------
+
+    def warm_start(self) -> float:
+        """Pre-compile the padded query + domain kernels (NOT the exact
+        fallback — its compile is paid only by out-of-domain traffic).
+
+        Without this the first request of a deployment carries the XLA
+        compile (hundreds of ms) in its latency; with it the spike moves
+        to construction and is recorded as ``warmup_seconds`` in
+        :class:`~bdlz_tpu.utils.profiling.ServeStats` where dashboards
+        can see it.  Returns the seconds spent.
+        """
+        import time
+
+        t0 = time.monotonic()
+        lower = np.asarray(
+            [nodes[0] for nodes in self.artifact.axis_nodes]
+        )
+        probe = np.tile(lower, (self.max_batch_size, 1))
+        import jax
+
+        jax.block_until_ready(self._query(probe))
+        jax.block_until_ready(self._in_domain(probe))
+        seconds = time.monotonic() - t0
+        self.stats.record_warmup(seconds)
+        return seconds
 
     def _evaluate_isolated(self, thetas):
         """(values, n_fallback, errors, n_retries) with per-request
@@ -235,15 +314,4 @@ class YieldService:
 
     def theta_from_mapping(self, point: Dict[str, float]) -> np.ndarray:
         """(d,) query vector from an {axis_name: value} mapping."""
-        missing = [n for n in self.artifact.axis_names if n not in point]
-        if missing:
-            raise ValueError(f"query is missing axes {missing}")
-        unknown = sorted(set(point) - set(self.artifact.axis_names))
-        if unknown:
-            raise ValueError(
-                f"query has unknown axes {unknown}; this artifact takes "
-                f"{list(self.artifact.axis_names)}"
-            )
-        return np.asarray(
-            [float(point[n]) for n in self.artifact.axis_names]
-        )
+        return theta_from_mapping(self.artifact, point)

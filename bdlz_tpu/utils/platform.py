@@ -18,9 +18,26 @@ import sys
 #: The axon relay's compile endpoint (host, port).
 RELAY_ADDR = ("127.0.0.1", 8083)
 
+#: Process-wide relay verdict memo: ``None`` until a probe (or a
+#: completed bounded wait) resolves it, then the bool every later
+#: caller reuses.  One process pays the relay wait at most ONCE —
+#: BENCH_r05 stamped ``relay_waited_s: 600.0`` and then later legs'
+#: backend touches re-probed (and on a flapping relay re-waited) for
+#: the same dead endpoint.  A live verdict is also cached: the relay
+#: serving this process's backend is not going to un-register mid-run,
+#: and a 2 s TCP probe per CLI layer adds up.
+_RELAY_VERDICT: "bool | None" = None
 
-def axon_relay_alive(timeout: float = 2.0) -> bool:
-    """True if the TPU relay's compile endpoint accepts connections."""
+
+def reset_relay_cache() -> None:
+    """Forget the cached relay verdict (tests; long-lived supervisors
+    that want to re-admit a recovered relay)."""
+    global _RELAY_VERDICT
+    _RELAY_VERDICT = None
+
+
+def _probe_relay(timeout: float) -> bool:
+    """One uncached TCP probe of the relay's compile endpoint."""
     s = socket.socket()
     s.settimeout(timeout)
     try:
@@ -30,6 +47,18 @@ def axon_relay_alive(timeout: float = 2.0) -> bool:
         return False
     finally:
         s.close()
+
+
+def axon_relay_alive(timeout: float = 2.0) -> bool:
+    """True if the TPU relay's compile endpoint accepts connections.
+
+    The verdict is cached per process after the first resolution (see
+    ``_RELAY_VERDICT``); ``reset_relay_cache()`` forgets it.
+    """
+    global _RELAY_VERDICT
+    if _RELAY_VERDICT is None:
+        _RELAY_VERDICT = _probe_relay(timeout)
+    return _RELAY_VERDICT
 
 
 def axon_registered() -> bool:
@@ -72,13 +101,25 @@ def wait_for_relay(max_wait_s: float = 0.0, poll_s: float = 10.0) -> bool:
     The relay is an environment state that can recover (observed: it has
     come back after dying); benches that *want* the TPU number can spend a
     bounded wait on it instead of silently downgrading the metric.
+
+    The wait is paid AT MOST ONCE per process: its outcome lands in the
+    shared verdict cache, so a second ``wait_for_relay`` (or any
+    ``axon_relay_alive`` / ``ensure_live_backend`` probe on a later
+    bench leg) returns the cached verdict immediately — a round with a
+    dead relay pays its ``relay_waited_s`` exactly once, not once per
+    metric leg.
     """
     import time
 
+    global _RELAY_VERDICT
+    if _RELAY_VERDICT is not None:
+        return _RELAY_VERDICT
     deadline = time.time() + max_wait_s
     while True:
-        if axon_relay_alive():
+        if _probe_relay(2.0):
+            _RELAY_VERDICT = True
             return True
         if time.time() >= deadline:
+            _RELAY_VERDICT = False
             return False
         time.sleep(min(poll_s, max(0.1, deadline - time.time())))

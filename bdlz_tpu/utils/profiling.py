@@ -81,6 +81,12 @@ class ServeBatch:
     # the retry budget (the serve analog of sweep quarantine)
     n_retries: int = 0
     n_error: int = 0
+    # fleet provenance (docs/serving.md): which artifact answered the
+    # batch and which device replica ran it.  Every request in one batch
+    # shares one artifact by construction — the rollout tests pin that a
+    # cutover never mixes surfaces within a dispatch.
+    artifact_hash: "str | None" = None
+    replica: "int | None" = None
 
 
 @dataclass
@@ -90,13 +96,32 @@ class ServeStats:
     bench JSON / event logs).  ``occupancy`` is the quantity dynamic
     batching exists to maximize; ``fallback_rate`` is the fraction of
     traffic the emulator could not absorb — a rising rate means the
-    artifact's box no longer covers the query distribution."""
+    artifact's box no longer covers the query distribution.
+
+    Every rate/percentile field of :meth:`summary` is ``None`` — never
+    NaN, never a fabricated 0.0 — when its window is empty (zero batches
+    dispatched, every request shed): a dashboard must be able to tell
+    "nothing measured" from "measured zero", and the summary must stay
+    ``json.dumps(..., allow_nan=False)``-safe under total overload.
+    """
 
     rows: List[ServeBatch] = field(default_factory=list)
     #: Requests answered with ``DeadlineExceeded`` at dispatch instead of
     #: aging their batch (counted here, not per row — a fully-expired
     #: dispatch records no batch row at all).
     deadline_kills: int = 0
+    #: Requests rejected at submit by admission control (bounded queue,
+    #: ``serve.QueueFull``) — they never entered the queue at all.
+    admission_rejects: int = 0
+    #: Requests the queue accepted (admission's complement: offered
+    #: traffic = accepted + admission_rejects).
+    accepted: int = 0
+    #: Per-request submit→resolve latencies on the service's clock (the
+    #: fleet records one entry per answered request; percentile source).
+    latencies_s: List[float] = field(default_factory=list)
+    #: Seconds spent pre-compiling query kernels (artifact load + rollout
+    #: warm-up) — the compile spike the warm start keeps out of p99.
+    warmup_seconds: float = 0.0
 
     def record_batch(self, **kw: Any) -> None:
         self.rows.append(ServeBatch(**kw))
@@ -104,26 +129,52 @@ class ServeStats:
     def record_deadline_kills(self, n: int) -> None:
         self.deadline_kills += int(n)
 
+    def record_admission_rejects(self, n: int = 1) -> None:
+        self.admission_rejects += int(n)
+
+    def record_accepted(self, n: int = 1) -> None:
+        self.accepted += int(n)
+
+    def record_latency(self, seconds: float) -> None:
+        self.latencies_s.append(float(seconds))
+
+    def record_warmup(self, seconds: float) -> None:
+        self.warmup_seconds += float(seconds)
+
     @property
     def n_batches(self) -> int:
         return len(self.rows)
+
+    def _percentile(self, q: float) -> "float | None":
+        if not self.latencies_s:
+            return None
+        import numpy as np  # host-side stats (bdlz-lint R1 audit)
+
+        return round(float(np.percentile(np.asarray(self.latencies_s), q)), 6)
 
     def summary(self) -> Dict[str, Any]:
         requests = sum(r.size for r in self.rows)
         fallbacks = sum(r.n_fallback for r in self.rows)
         errors = sum(r.n_error for r in self.rows)
+        shed = self.deadline_kills + self.admission_rejects
+        offered = self.accepted + self.admission_rejects
         return {
             "batches": self.n_batches,
             "requests": requests,
             "fallbacks": fallbacks,
-            "fallback_rate": round(fallbacks / requests, 4) if requests else 0.0,
-            "mean_batch": round(requests / self.n_batches, 2) if self.rows else 0.0,
+            "fallback_rate": (
+                round(fallbacks / requests, 4) if requests else None
+            ),
+            "mean_batch": (
+                round(requests / self.n_batches, 2) if self.rows else None
+            ),
             "mean_occupancy": (
                 round(sum(r.occupancy for r in self.rows) / self.n_batches, 4)
-                if self.rows else 0.0
+                if self.rows else None
             ),
             "max_wait_s": (
-                round(max(r.wait_s for r in self.rows), 6) if self.rows else 0.0
+                round(max(r.wait_s for r in self.rows), 6)
+                if self.rows else None
             ),
             "seconds": round(sum(r.seconds for r in self.rows), 4),
             # degraded-mode accounting: how hard the service had to fight
@@ -132,7 +183,18 @@ class ServeStats:
             "retries": sum(r.n_retries for r in self.rows),
             "deadline_kills": self.deadline_kills,
             "errors": errors,
-            "quarantine_rate": round(errors / requests, 4) if requests else 0.0,
+            "quarantine_rate": (
+                round(errors / requests, 4) if requests else None
+            ),
+            # fleet-plane accounting (docs/serving.md): offered traffic
+            # vs what overload control turned away, and the latency
+            # percentiles of what was answered
+            "accepted": self.accepted,
+            "admission_rejects": self.admission_rejects,
+            "shed_rate": round(shed / offered, 4) if offered else None,
+            "p50_latency_s": self._percentile(50.0),
+            "p99_latency_s": self._percentile(99.0),
+            "warmup_seconds": round(self.warmup_seconds, 4),
         }
 
     def as_rows(self) -> List[Dict[str, Any]]:

@@ -47,9 +47,16 @@ the two LZ-sweep secondary metrics — per-point P derived from a bounce
 profile through the two-channel LZ kernel, once analytically and once
 through the coherent transfer-matrix P(v_w) table; default: the full
 grid on TPU, 4096 on CPU fallback), BDLZ_BENCH_LZ_TABLE_N (coherent
-P-table nodes; default 16384 on TPU, 2048 on CPU fallback).  Every
-secondary leg runs on EVERY platform (flagged tpu_unavailable on the
-fallback path) so a relay-dead round still records full engine coverage.
+P-table nodes; default 16384 on TPU, 2048 on CPU fallback),
+BDLZ_BENCH_SERVE_QUERIES / BDLZ_BENCH_SERVE_BATCH /
+BDLZ_BENCH_SERVE_REPLICAS / BDLZ_BENCH_SERVE_LAT_QUERIES (the
+serve_bench leg: request-stream size, micro-batch bucket, fleet size,
+and the closed-loop latency sample — the leg replays the round's
+emulator artifact through the per-device replica fleet and reports
+QPS/chip, replica scaling, p50/p99 latency, and the deterministic shed
+rate of a canned overload trace).  Every secondary leg runs on EVERY
+platform (flagged tpu_unavailable on the fallback path) so a
+relay-dead round still records full engine coverage.
 """
 from __future__ import annotations
 
@@ -849,18 +856,190 @@ def main(argv=None) -> None:
             "tpu_unavailable": tpu_unavailable,
         }
         print(json.dumps(payload))
-        return {
+        summary = {
             k: payload[k] for k in (
                 "build_seconds", "refinement_rounds", "max_rel_err",
                 "converged", "vs_exact",
             )
         } | {"query_points_per_sec": payload["value"]}
+        # the artifact rides along for the serve_bench leg (one build
+        # per round; the fleet must serve the surface this round built)
+        return summary, artifact
 
     emulator_summary = None
+    emu_artifact = None
     try:
-        emulator_summary = emulator_metric()
+        emulator_summary, emu_artifact = emulator_metric()
     except Exception as exc:  # noqa: BLE001 — secondary metric is best-effort
         print(f"[bench] emulator metric unavailable: {exc}", file=sys.stderr)
+
+    # --- secondary metric: the sharded serving fleet (serve_bench) ----
+    # The serving counterpart of sweep_points_per_sec_per_chip
+    # (docs/serving.md): replicate the round's emulator artifact onto
+    # every local device (bdlz_tpu/serve/fleet.py), stream the same
+    # request stream through 1 replica and N replicas (bit-identity
+    # checked), pump a closed-loop request plane for latency
+    # percentiles, and run a canned fake-clock overload trace against
+    # the bounded queue + deadline shedding so the shed rate is a
+    # DETERMINISTIC function of the trace, not of host timing.
+    def serve_bench_metric(artifact):
+        from collections import deque
+
+        from bdlz_tpu.serve.batcher import QueueFull
+        from bdlz_tpu.serve.fleet import FleetService, ReplicaSet
+
+        n_q = int(os.environ.get("BDLZ_BENCH_SERVE_QUERIES",
+                                 16384 if on_cpu else 262144))
+        srv_batch = int(os.environ.get("BDLZ_BENCH_SERVE_BATCH", 4096))
+        srv_batch = max(1, min(srv_batch, n_q))
+        n_rep = int(os.environ.get("BDLZ_BENCH_SERVE_REPLICAS",
+                                   min(4, n_dev)))
+        rng = np.random.default_rng(11)
+        lo = np.array([nodes[0] for nodes in artifact.axis_nodes])
+        hi = np.array([nodes[-1] for nodes in artifact.axis_nodes])
+        thetas = rng.uniform(lo, hi, size=(n_q, len(lo)))
+
+        def throughput(n_replicas):
+            # raw micro-batch routing (the aggregate-QPS product): keep
+            # two batches in flight per replica so devices overlap
+            rs = ReplicaSet(
+                artifact, n_replicas=n_replicas,
+                max_batch_size=srv_batch, routing="least_loaded",
+            )
+            vals = np.empty(n_q)
+            handles = deque()
+            t0 = time.time()
+            for lo_i in range(0, n_q, srv_batch):
+                hi_i = min(lo_i + srv_batch, n_q)
+                handles.append(
+                    (lo_i, hi_i, rs.dispatch(thetas[lo_i:hi_i]))
+                )
+                if len(handles) > 2 * n_replicas:
+                    a, b, h = handles.popleft()
+                    vals[a:b] = h.gather()[0]
+            while handles:
+                a, b, h = handles.popleft()
+                vals[a:b] = h.gather()[0]
+            seconds = time.time() - t0
+            return vals, n_q / max(seconds, 1e-9), rs
+
+        vals1, qps1, _ = throughput(1)
+        vals_n, qps_n, rs_n = throughput(n_rep)
+        # the acceptance contract: same stream, BIT-identical responses
+        # at any replica count (same kernel, same table bytes, per
+        # device) — scaling must never buy a different answer
+        bit_identical = bool(np.array_equal(vals1, vals_n))
+        replica_scaling = qps_n / max(qps1, 1e-9)
+        qps_per_chip = qps_n / rs_n.n_devices
+
+        # request-plane latency percentiles: closed-loop pump through
+        # the per-request future front (real clock — these are the p50/
+        # p99 a caller would see)
+        n_lat = int(os.environ.get("BDLZ_BENCH_SERVE_LAT_QUERIES",
+                                   min(4096, n_q)))
+        lat_batch = min(256, srv_batch)
+        svc = FleetService(
+            artifact, base, max_batch_size=lat_batch, n_replicas=n_rep,
+            max_wait_s=5e-4,
+        )
+        futs = []
+        for i in range(n_lat):
+            futs.append(svc.submit(thetas[i % n_q]))
+            svc.run_once()
+            svc.poll(block=False)
+        svc.drain()
+        for f in futs:
+            f.result(timeout=0)  # surface any per-request failure loudly
+        lat_summary = svc.stats.summary()
+
+        # canned overload trace (fake clock): 8 bursts, each offering a
+        # full queue bound; one dispatch drains lat_batch per burst, so
+        # admission must reject the excess and the deadline must kill
+        # the aged tail — the shed rate is a pure function of the trace
+        class _Tick:
+            t = 0.0
+
+            def __call__(self):
+                return self.t
+
+        tick = _Tick()
+        q_bound = 2 * lat_batch
+        ov = FleetService(
+            artifact, base, max_batch_size=lat_batch, n_replicas=n_rep,
+            queue_bound=q_bound, max_wait_s=1e-3, deadline_s=0.05,
+            clock=tick,
+        )
+        offered = 0
+        ov_futs = []
+        for _burst in range(8):
+            for _k in range(q_bound):
+                offered += 1
+                try:
+                    ov_futs.append(ov.submit(thetas[offered % n_q]))
+                except QueueFull:
+                    pass
+            ov.run_once()
+            ov.poll(block=False)
+            tick.t += 0.02
+        ov.drain()
+        ov_summary = ov.stats.summary()
+
+        try:
+            host_cores = len(os.sched_getaffinity(0))
+        except AttributeError:  # non-linux fallback
+            host_cores = os.cpu_count()
+
+        payload = {
+            "metric": "serve_bench_queries_per_sec_per_chip",
+            "value": round(qps_per_chip, 1),
+            "unit": "emulator serve QPS/chip (per-device replica fleet, "
+                    "least-loaded micro-batch routing, batch %d)"
+                    % srv_batch,
+            "n_queries": n_q,
+            "n_replicas": n_rep,
+            "n_replica_devices": rs_n.n_devices,
+            # replica scaling is bounded by physical parallelism: on a
+            # CPU fallback host the replicas share host_cores, so ~1.0
+            # there is expected — the chip-count scaling claim is a
+            # hardware number, flagged like every other leg
+            "host_cores": host_cores,
+            "qps": round(qps_n, 1),
+            "single_replica_qps": round(qps1, 1),
+            "replica_scaling": round(replica_scaling, 2),
+            "bit_identical_across_replicas": bit_identical,
+            "warmup_seconds": round(rs_n.warmup_seconds, 4),
+            "routing": "least_loaded",
+            "artifact_hash": artifact.content_hash,
+            "latency_queries": n_lat,
+            "p50_latency_s": lat_summary["p50_latency_s"],
+            "p99_latency_s": lat_summary["p99_latency_s"],
+            "mean_occupancy": lat_summary["mean_occupancy"],
+            "shed_rate": ov_summary["shed_rate"],
+            "admission_rejects": ov_summary["admission_rejects"],
+            "deadline_kills": ov_summary["deadline_kills"],
+            "overload_offered": offered,
+            "platform": jax.devices()[0].platform,
+            "tpu_unavailable": tpu_unavailable,
+        }
+        print(json.dumps(payload))
+        return {
+            k: payload[k] for k in (
+                "value", "qps", "replica_scaling", "p50_latency_s",
+                "p99_latency_s", "shed_rate",
+                "bit_identical_across_replicas",
+            )
+        }
+
+    serve_summary = None
+    if emu_artifact is not None:
+        try:
+            serve_summary = serve_bench_metric(emu_artifact)
+        except Exception as exc:  # noqa: BLE001 — secondary metric is best-effort
+            print(f"[bench] serve_bench metric unavailable: {exc}",
+                  file=sys.stderr)
+    else:
+        print("[bench] serve_bench skipped: no emulator artifact this "
+              "round", file=sys.stderr)
 
     # --- secondary metrics: the LZ sweeps (BASELINE.json's metric name) --
     # Per-point P derived from a bounce profile through the two-channel
@@ -1016,6 +1195,9 @@ def main(argv=None) -> None:
                 # the emulator/serving metric (null = build or measure
                 # failed; the secondary line carries the full detail)
                 "emulator": emulator_summary,
+                # the sharded-fleet serving metric (null = leg failed or
+                # no artifact; its secondary line has the full detail)
+                "serve": serve_summary,
                 "lz_sweep_points_per_sec_per_chip": lz_per_chip,
                 "lz_coherent_sweep_points_per_sec_per_chip": (
                     lz_coherent_per_chip

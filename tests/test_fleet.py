@@ -1,0 +1,450 @@
+"""Sharded-fleet tests (bdlz_tpu/serve/fleet.py + rollout.py).
+
+Same testability contract as the batcher suite: every policy decision
+(admission, deadline shedding, dispatch readiness, rollout cutover) is
+driven with a FAKE CLOCK and explicit run_once/poll calls — zero sleeps,
+zero background threads.  Device work is real (the conftest 8-virtual-
+device CPU mesh), but only its RESULTS are asserted (bit-parity,
+hashes), never its timing.
+
+Most tests ride a synthetic artifact (valid identity, fabricated
+positive table) instead of the session emulator build: the fleet layer
+only interpolates — the correct-physics pins live in test_serve /
+test_emulator — and the fabricated table makes N vs N+1 rollout
+artifacts cheap to construct.
+"""
+import dataclasses
+import json
+
+import numpy as np
+import pytest
+
+from bdlz_tpu.config import config_from_dict, static_choices_from_config
+from bdlz_tpu.emulator.artifact import (
+    EmulatorArtifact,
+    EmulatorArtifactError,
+    build_identity,
+)
+from bdlz_tpu.serve import (
+    ArtifactRollout,
+    DeadlineExceeded,
+    FleetService,
+    QueueFull,
+    ReplicaSet,
+    RolloutError,
+)
+from bdlz_tpu.utils.profiling import ServeStats
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+BASE = config_from_dict({
+    "regime": "nonthermal",
+    "P_chi_to_B": 0.14925839040304145,
+    "source_shape_sigma_y": 9.0,
+    "incident_flux_scale": 1.07e-9,
+    "Y_chi_init": 4.90e-10,
+})
+STATIC = static_choices_from_config(BASE)._replace(quad_panel_gl=False)
+AXES = ("m_chi_GeV", "T_p_GeV", "v_w")
+NODES = (
+    np.linspace(0.9, 1.1, 4),
+    np.geomspace(90.0, 110.0, 5),
+    np.linspace(0.25, 0.35, 3),
+)
+LO = np.array([n[0] for n in NODES])
+HI = np.array([n[-1] for n in NODES])
+
+
+def _make_artifact(scale=1.0, base=BASE):
+    """A valid-identity artifact with a fabricated positive table.
+
+    ``scale`` multiplies the values — the N+1 rollout artifact: same
+    identity (same physics), different content hash.
+    """
+    rng = np.random.default_rng(42)
+    vals = np.exp(rng.normal(size=(4, 5, 3))) * scale
+    return EmulatorArtifact(
+        axis_names=AXES,
+        axis_nodes=NODES,
+        axis_scales=("log", "log", "lin"),
+        values={"DM_over_B": vals},
+        identity=build_identity(base, STATIC, 400, "tabulated"),
+        manifest={},
+    )
+
+
+def _thetas(n, seed=0):
+    return np.random.default_rng(seed).uniform(LO, HI, size=(n, 3))
+
+
+def _fleet(artifact=None, clock=None, **kw):
+    kw.setdefault("max_batch_size", 4)
+    kw.setdefault("max_wait_s", 0.010)
+    kw.setdefault("n_replicas", 4)
+    return FleetService(
+        artifact if artifact is not None else _make_artifact(),
+        BASE, static=STATIC, clock=clock or FakeClock(), **kw,
+    )
+
+
+class TestReplicaSet:
+    def test_multi_vs_single_replica_bit_parity(self):
+        """The acceptance contract: the SAME request stream through 1
+        replica and 4 replicas returns BIT-identical values — scaling
+        must never buy a different answer."""
+        art = _make_artifact()
+        rs1 = ReplicaSet(art, n_replicas=1, max_batch_size=16)
+        rs4 = ReplicaSet(art, n_replicas=4, max_batch_size=16)
+        assert rs4.n_devices == 4  # conftest pins an 8-device CPU mesh
+        thetas = _thetas(128)
+
+        def stream(rs):
+            handles = [rs.dispatch(thetas[i:i + 16])
+                       for i in range(0, 128, 16)]
+            return np.concatenate([h.gather()[0] for h in handles])
+
+        v1, v4 = stream(rs1), stream(rs4)
+        assert np.array_equal(v1, v4)  # bitwise, not allclose
+        assert np.isfinite(v1).all()
+
+    def test_warm_start_precompiles_and_records_seconds(self):
+        """Satellite pin: kernels compile at LOAD (per device, at the
+        bucket shape), the seconds land in ServeStats, and warming is
+        idempotent."""
+        stats = ServeStats()
+        rs = ReplicaSet(_make_artifact(), n_replicas=2, max_batch_size=8,
+                        stats=stats)
+        assert rs.warmed
+        assert rs.warmup_seconds > 0.0
+        assert stats.summary()["warmup_seconds"] == pytest.approx(
+            rs.warmup_seconds, abs=1e-3
+        )
+        assert rs.warm() == 0.0  # idempotent: no second compile pass
+
+    def test_round_robin_rotation_and_least_loaded_pick(self):
+        art = _make_artifact()
+        rr = ReplicaSet(art, n_replicas=3, max_batch_size=4,
+                        routing="round_robin")
+        picked = [rr.dispatch(_thetas(4)).replica.index for _ in range(6)]
+        assert picked == [0, 1, 2, 0, 1, 2]
+
+        ll = ReplicaSet(art, n_replicas=3, max_batch_size=4,
+                        routing="least_loaded")
+        h0 = ll.dispatch(_thetas(4))
+        h1 = ll.dispatch(_thetas(4))
+        # two in flight on 0 and 1 → next goes to the idle replica 2
+        assert (h0.replica.index, h1.replica.index) == (0, 1)
+        assert ll.pick().index == 2
+        # gathering replica 0 frees its slot → ties break to lowest index
+        h0.gather()
+        assert ll.pick().index == 0
+
+    def test_validation(self):
+        art = _make_artifact()
+        with pytest.raises(ValueError, match="routing"):
+            ReplicaSet(art, routing="random")
+        with pytest.raises(ValueError, match="n_replicas"):
+            ReplicaSet(art, n_replicas=0)
+        with pytest.raises(KeyError, match="field"):
+            ReplicaSet(art, field="bogus")
+        rs = ReplicaSet(art, n_replicas=1, max_batch_size=4)
+        with pytest.raises(ValueError, match="exceeds max_batch_size"):
+            rs.dispatch(_thetas(5))
+        with pytest.raises(ValueError, match="coordinates"):
+            rs.dispatch(np.zeros((2, 2)))
+
+
+class TestAdmissionAndShedding:
+    def test_sustained_load_admission_deterministic(self):
+        """The satellite's sustained-load pin: beyond queue_bound every
+        submit rejects with the typed QueueFull, the shed rate is a pure
+        function of the trace, and the accepted requests all serve."""
+        clock = FakeClock()
+        svc = _fleet(clock=clock, queue_bound=8)
+        futs, rejects = [], 0
+        for i in range(20):  # burst with no dispatch between: 8 fit
+            try:
+                futs.append(svc.submit(_thetas(20)[i]))
+            except QueueFull:
+                rejects += 1
+        assert len(futs) == 8 and rejects == 12
+        svc.drain()
+        s = svc.stats.summary()
+        assert s["accepted"] == 8
+        assert s["admission_rejects"] == 12
+        assert s["shed_rate"] == pytest.approx(12 / 20)
+        assert all(np.isfinite(f.result(timeout=0).value) for f in futs)
+
+    def test_deadline_shed_prefix_then_serve(self):
+        clock = FakeClock()
+        svc = _fleet(clock=clock, deadline_s=0.05)
+        stale = [svc.submit(t) for t in _thetas(3)]
+        clock.advance(0.06)
+        live = [svc.submit(t) for t in _thetas(4, seed=1)]  # a full batch
+        assert svc.run_once() == 7  # 3 killed + 4 dispatched in ONE pass
+        for f in stale:
+            with pytest.raises(DeadlineExceeded):
+                f.result(timeout=0)
+        assert svc.poll(block=True) == 4
+        assert all(np.isfinite(f.result(timeout=0).value) for f in live)
+        s = svc.stats.summary()
+        assert s["deadline_kills"] == 3
+        assert s["batches"] == 1 and s["requests"] == 4
+        # shed accounting: 3 of 7 offered were shed
+        assert s["shed_rate"] == pytest.approx(3 / 7, abs=1e-4)
+
+    def test_policy_pure_in_queue_and_now(self):
+        clock = FakeClock()
+        svc = _fleet(clock=clock)
+        svc.submit(_thetas(1)[0])
+        assert not svc.ready_at()          # under max_wait, under batch
+        assert svc.ready_at(now=0.011)     # pure: no side effects
+        assert not svc.ready_at(now=0.009)
+        assert svc.run_once() == 0         # real now still says wait
+        clock.advance(0.011)
+        assert svc.run_once() == 1
+
+    def test_latencies_recorded_on_injected_clock(self):
+        clock = FakeClock()
+        svc = _fleet(clock=clock)
+        svc.submit(_thetas(1)[0])
+        clock.advance(0.02)
+        svc.run_once()
+        clock.advance(0.005)
+        svc.poll(block=True)
+        s = svc.stats.summary()
+        assert s["p50_latency_s"] == pytest.approx(0.025)
+        assert s["p99_latency_s"] == pytest.approx(0.025)
+
+    def test_knob_validation(self):
+        with pytest.raises(ValueError, match="queue_bound"):
+            _fleet(queue_bound=2, max_batch_size=4)
+        with pytest.raises(ValueError, match="deadline_s"):
+            _fleet(deadline_s=0.001, max_wait_s=0.01)
+        with pytest.raises(ValueError, match="coordinates"):
+            _fleet().submit([1.0])
+
+    def test_config_knobs_resolve_and_stay_out_of_identity(self):
+        from bdlz_tpu.config import config_identity_dict
+
+        base2 = dataclasses.replace(BASE, n_replicas=2, queue_bound=8)
+        svc = FleetService(
+            _make_artifact(base=base2), base2, static=STATIC,
+            max_batch_size=4, clock=FakeClock(),
+        )
+        assert svc.replica_set.n_replicas == 2
+        assert svc.queue_bound == 8
+        # deployment shape must not stale artifacts/manifests
+        assert config_identity_dict(base2) == config_identity_dict(BASE)
+
+    def test_exact_fallback_isolated_per_request(self, tiny_emulator):
+        """The fleet answers out-of-domain rows through the SAME
+        retried exact fallback as YieldService, isolated per request."""
+        from bdlz_tpu.emulator import load_artifact
+        from bdlz_tpu.serve import YieldService
+
+        base, out_dir, _, _ = tiny_emulator
+        art = load_artifact(out_dir)
+        clock = FakeClock()
+        svc = FleetService(art, base, max_batch_size=4, n_replicas=2,
+                           clock=clock, max_wait_s=0.005)
+        ref = YieldService(art, base, max_batch_size=4, warm=False)
+        thetas = np.array([
+            [1.0, 100.0, 0.30],   # inside
+            [1.0, 100.0, 0.60],   # v_w outside the tiny box
+            [0.95, 95.0, 0.28],   # inside
+        ])
+        futs = [svc.submit(t) for t in thetas]
+        clock.advance(0.006)
+        assert svc.run_once() == 3
+        assert svc.poll(block=True) == 3
+        got = np.array([f.result(timeout=0).value for f in futs])
+        want, n_fallback = ref.evaluate(thetas)
+        assert n_fallback == 1
+        np.testing.assert_array_equal(got, want)
+        assert svc.stats.summary()["fallbacks"] == 1
+
+
+class TestRollout:
+    def test_rollout_under_load_zero_drops_no_mixed_batches(self):
+        """The zero-downtime pin: under continuous fake-clock load, the
+        N→N+1 cutover drops nothing, every response carries a valid
+        artifact hash (N or N+1, never mixed within a batch), and the
+        per-batch hash sequence is a clean N…N / N+1…N+1 transition."""
+        art_n = _make_artifact()
+        art_n1 = _make_artifact(scale=1.5)
+        clock = FakeClock()
+        svc = _fleet(artifact=art_n, clock=clock)
+        ro = ArtifactRollout(svc)
+        h_n, h_n1 = art_n.content_hash, art_n1.content_hash
+        assert h_n != h_n1
+
+        thetas = _thetas(64, seed=3)
+        futs = []
+        for round_i in range(16):           # 16 full batches of 4
+            for k in range(4):
+                futs.append(svc.submit(thetas[(4 * round_i + k) % 64]))
+            svc.run_once()
+            svc.poll(block=False)           # load keeps flowing
+            if round_i == 7:                # mid-stream rollout
+                assert ro.stage(art_n1) == h_n1
+                old, new = ro.cutover()
+                assert (old, new) == (h_n, h_n1)
+        svc.drain()
+
+        # zero drops: every submitted request resolves with a value
+        responses = [f.result(timeout=0) for f in futs]
+        assert len(responses) == 64
+        hashes = [r.artifact_hash for r in responses]
+        assert set(hashes) == {h_n, h_n1}
+        # never mixed within a batch, and the per-batch sequence is a
+        # single monotone N→N+1 transition
+        rows = svc.stats.as_rows()
+        row_hashes = [r["artifact_hash"] for r in rows]
+        assert all(h in (h_n, h_n1) for h in row_hashes)
+        flip = row_hashes.index(h_n1)
+        assert all(h == h_n for h in row_hashes[:flip])
+        assert all(h == h_n1 for h in row_hashes[flip:])
+        # the answers actually moved to the new surface (1.5x table)
+        by_hash = {}
+        for r, f in zip(responses, futs):
+            by_hash.setdefault(r.artifact_hash, []).append(r.value)
+        assert np.isfinite(by_hash[h_n]).all()
+        assert np.isfinite(by_hash[h_n1]).all()
+
+    def test_in_flight_batches_resolve_with_old_artifact(self):
+        """The drain guarantee: a batch dispatched against N before the
+        cutover resolves with N's hash and N's values even though N+1 is
+        active by the time it is gathered."""
+        art_n, art_n1 = _make_artifact(), _make_artifact(scale=2.0)
+        clock = FakeClock()
+        svc = _fleet(artifact=art_n, clock=clock)
+        ro = ArtifactRollout(svc)
+        theta = _thetas(4, seed=5)
+        pre = [svc.submit(t) for t in theta]
+        svc.run_once()                      # in flight on N
+        ro.stage(art_n1)
+        ro.cutover()
+        post = [svc.submit(t) for t in theta]
+        svc.run_once()
+        svc.drain()
+        pre_r = [f.result(timeout=0) for f in pre]
+        post_r = [f.result(timeout=0) for f in post]
+        assert {r.artifact_hash for r in pre_r} == {art_n.content_hash}
+        assert {r.artifact_hash for r in post_r} == {art_n1.content_hash}
+        for a, b in zip(pre_r, post_r):     # same theta, 2x table
+            assert b.value == pytest.approx(2.0 * a.value, rel=1e-12)
+
+    def test_identity_skew_rejected_at_stage(self):
+        """An artifact built for DIFFERENT physics can never be staged:
+        the PR-3 identity check fires before any replica exists."""
+        svc = _fleet()
+        ro = ArtifactRollout(svc)
+        base_bad = dataclasses.replace(BASE, incident_flux_scale=2e-9)
+        art_bad = _make_artifact()._replace(
+            identity=build_identity(base_bad, STATIC, 400, "tabulated")
+        )
+        with pytest.raises(EmulatorArtifactError, match="identity mismatch"):
+            ro.stage(art_bad)
+        assert ro.staged_hash is None       # nothing half-staged
+
+    def test_cutover_refuses_cold_or_empty_stage(self):
+        svc = _fleet()
+        ro = ArtifactRollout(svc)
+        with pytest.raises(RolloutError, match="nothing staged"):
+            ro.cutover()
+        ro.stage(_make_artifact(scale=1.1), warm=False)
+        with pytest.raises(RolloutError, match="cold"):
+            ro.cutover()
+        ro.warm()
+        old, new = ro.cutover()
+        assert new == _make_artifact(scale=1.1).content_hash
+        assert ro.previous is not None      # rollback seam
+        # the drained stage is gone: a second cutover has nothing
+        with pytest.raises(RolloutError, match="nothing staged"):
+            ro.cutover()
+
+    def test_abort_leaves_active_untouched(self):
+        svc = _fleet()
+        ro = ArtifactRollout(svc)
+        h0 = svc.artifact_hash
+        ro.stage(_make_artifact(scale=3.0))
+        ro.abort()
+        assert ro.staged_hash is None
+        assert svc.artifact_hash == h0
+
+    def test_broadcast_text_roundtrip(self):
+        """The rollout's hash-agreement wire helper (single-process =
+        identity; width overflow is loud, not truncated)."""
+        from bdlz_tpu.parallel.multihost import broadcast_text
+
+        assert broadcast_text("0123abcd9999ffff", width=64) == (
+            "0123abcd9999ffff"
+        )
+        with pytest.raises(ValueError, match="exceeds"):
+            broadcast_text("x" * 65, width=64)
+
+
+class TestServeStatsAudit:
+    """Satellite pin: every rate/percentile field is None — never NaN,
+    never a fabricated 0.0 — on an empty window, and the summary stays
+    strict-JSON-safe under total overload."""
+
+    EMPTY_NULL_FIELDS = (
+        "fallback_rate", "mean_batch", "mean_occupancy", "max_wait_s",
+        "quarantine_rate", "shed_rate", "p50_latency_s", "p99_latency_s",
+    )
+
+    def test_empty_window_rates_are_null(self):
+        s = ServeStats().summary()
+        for key in self.EMPTY_NULL_FIELDS:
+            assert s[key] is None, key
+        json.dumps(s, allow_nan=False)  # strict JSON, no NaN/inf
+
+    def test_all_requests_shed_window(self):
+        """Zero batches dispatched, everything shed: the rates that have
+        a denominator report it, the rest stay null."""
+        st = ServeStats()
+        st.record_accepted(3)
+        st.record_deadline_kills(3)
+        st.record_admission_rejects(2)
+        s = st.summary()
+        assert s["batches"] == 0 and s["requests"] == 0
+        assert s["shed_rate"] == pytest.approx(1.0)  # (3+2)/(3+2)
+        for key in ("fallback_rate", "mean_batch", "mean_occupancy",
+                    "max_wait_s", "quarantine_rate", "p50_latency_s",
+                    "p99_latency_s"):
+            assert s[key] is None, key
+        json.dumps(s, allow_nan=False)
+
+    def test_batcher_queue_bound(self):
+        """MicroBatcher admission control: the single-kernel front gets
+        the same typed rejection as the fleet."""
+        from bdlz_tpu.serve import MicroBatcher
+
+        clock = FakeClock()
+        mb = MicroBatcher(
+            lambda thetas: [float(t[0]) for t in thetas],
+            max_batch_size=2, max_wait_s=0.01, clock=clock,
+            queue_bound=2,
+        )
+        f1, f2 = mb.submit([1.0]), mb.submit([2.0])
+        with pytest.raises(QueueFull, match="admission bound"):
+            mb.submit([3.0])
+        assert mb.run_once() == 2
+        assert f1.result(timeout=0) == 1.0 and f2.result(timeout=0) == 2.0
+        s = mb.stats.summary()
+        assert s["accepted"] == 2 and s["admission_rejects"] == 1
+        assert s["shed_rate"] == pytest.approx(1 / 3, abs=1e-4)
+        with pytest.raises(ValueError, match="queue_bound"):
+            MicroBatcher(lambda t: [], max_batch_size=4, queue_bound=2)

@@ -419,6 +419,23 @@ class TestYieldService:
         with pytest.raises(EmulatorArtifactError, match="identity mismatch"):
             YieldService(load_artifact(out_dir), base2)
 
+    def test_warm_start_records_seconds(self, tiny_emulator):
+        """Satellite pin: construction pre-compiles the padded query +
+        domain kernels and records the seconds in ServeStats (the
+        first-query compile spike moves out of p99); warm=False keeps
+        the old lazy behavior for compile-cost-sensitive callers."""
+        base, out_dir, _, _ = tiny_emulator
+        svc = YieldService(load_artifact(out_dir), base, max_batch_size=8)
+        assert svc.stats.summary()["warmup_seconds"] > 0.0
+        cold = YieldService(load_artifact(out_dir), base, max_batch_size=8,
+                            warm=False)
+        assert cold.stats.summary()["warmup_seconds"] == 0.0
+        # warmed and cold services answer identically
+        thetas = np.array([[1.0, 100.0, 0.30], [0.95, 95.0, 0.28]])
+        np.testing.assert_array_equal(
+            svc.evaluate(thetas)[0], cold.evaluate(thetas)[0]
+        )
+
 
 class TestServeCLI:
     def test_requests_file_round_trip(self, tiny_emulator, tmp_path, capsys):
@@ -497,6 +514,47 @@ class TestServeCLI:
         assert "coordinates" in out_lines[2]["error"]
         assert out_lines[3]["id"] == "good"
         assert np.isfinite(out_lines[3]["value"])
+
+    def test_fleet_requests_round_trip(self, tiny_emulator, tmp_path,
+                                       capsys):
+        """--replicas routes through the fleet front: same answers as
+        the single-kernel path, plus the artifact-hash provenance on
+        every response line."""
+        base, out_dir, _, _ = tiny_emulator
+        cfg = tmp_path / "cfg.json"
+        cfg.write_text(json.dumps({
+            "regime": "nonthermal",
+            "P_chi_to_B": 0.14925839040304145,
+            "source_shape_sigma_y": 9.0,
+            "incident_flux_scale": 1.07e-9,
+            "Y_chi_init": 4.90e-10,
+        }))
+        reqs = tmp_path / "reqs.jsonl"
+        reqs.write_text("\n".join([
+            json.dumps({"id": "a", "m_chi_GeV": 1.0, "T_p_GeV": 100.0,
+                        "v_w": 0.30}),
+            json.dumps({"id": "b", "theta": [0.95, 95.0, 0.33]}),
+            json.dumps({"id": "ood", "m_chi_GeV": 1.0, "T_p_GeV": 100.0,
+                        "v_w": 0.60}),
+        ]) + "\n")
+        from bdlz_tpu.emulator.artifact import load_artifact as _load
+        from bdlz_tpu.serve.serve_cli import main
+
+        rc = main([
+            "--config", str(cfg), "--artifact", out_dir,
+            "--requests", str(reqs), "--max-batch", "8",
+            "--max-wait-ms", "1", "--replicas", "2",
+        ])
+        assert rc == 0
+        out_lines = [
+            json.loads(line)
+            for line in capsys.readouterr().out.strip().splitlines()
+        ]
+        assert [r["id"] for r in out_lines] == ["a", "b", "ood"]
+        assert all(np.isfinite(r["value"]) for r in out_lines)
+        want_hash = _load(out_dir).content_hash
+        assert all(r["artifact_hash"] == want_hash for r in out_lines)
+        assert all(r["latency_s"] >= 0 for r in out_lines)
 
     def test_all_lines_failed_exits_nonzero(self, tiny_emulator, tmp_path,
                                             capsys):
