@@ -160,6 +160,18 @@ class Config:
     # many waiting requests is rejected with the typed QueueFull.
     # None = unbounded (the pre-fleet behavior).
     queue_bound: Optional[int] = None
+    # ---- provenance / result-cache knobs (bdlz_tpu/provenance/,
+    # docs/provenance.md) — orchestration like the serve knobs: caching
+    # changes WHERE a result comes from, never its bits (the sweep_cache
+    # bench line pins bitwise equality), so both are excluded from every
+    # identity (CACHE_CONFIG_FIELDS below). ----
+    # Tri-state gate for the content-addressed result cache: None = on
+    # iff a root is configured (cache_root or BDLZ_CACHE_ROOT), False =
+    # force off, True = on (default root under ~/.cache when unset).
+    cache_enabled: Optional[bool] = None
+    # Store root for cached sweep chunks / published artifacts; None
+    # defers to the BDLZ_CACHE_ROOT env var.
+    cache_root: Optional[str] = None
 
 
 def default_config() -> Dict[str, Any]:
@@ -232,6 +244,13 @@ ROBUSTNESS_CONFIG_FIELDS = (
 #: whenever an operator resizes the fleet.
 SERVE_CONFIG_FIELDS = ("n_replicas", "queue_bound")
 
+#: Provenance-cache knobs with the same exclusion rule: a cache hit
+#: returns the bytes a cold run would compute (the sweep_cache bench
+#: line pins bitwise equality), so where results are cached can never
+#: join what identifies them — keying these in would also stale every
+#: artifact the moment an operator pointed the cache at a new disk.
+CACHE_CONFIG_FIELDS = ("cache_enabled", "cache_root")
+
 
 def config_identity_dict(cfg: Config) -> Dict[str, Any]:
     """The config as a resume-identity payload.
@@ -252,6 +271,7 @@ def config_identity_dict(cfg: Config) -> Dict[str, Any]:
             k in REFERENCE_KEYS
             or k in ROBUSTNESS_CONFIG_FIELDS
             or k in SERVE_CONFIG_FIELDS
+            or k in CACHE_CONFIG_FIELDS
         ):
             continue
         if k in RESULT_AFFECTING_EXTENSIONS or getattr(cfg, k) != defaults[k]:
@@ -321,7 +341,8 @@ def validate(cfg: Config, backend: Optional[str] = None) -> Config:
     if not (cfg.ode_rtol > 0.0 and cfg.ode_atol > 0.0):
         raise ConfigError("ode_rtol and ode_atol must be positive")
     for k in ("ode_auto_h0", "ode_pi_controller", "ode_tabulated_av",
-              "quad_panel_gl", "fault_injection", "retry_enabled"):
+              "quad_panel_gl", "fault_injection", "retry_enabled",
+              "cache_enabled"):
         v = getattr(cfg, k)
         if v is not None and not isinstance(v, bool):
             raise ConfigError(f"{k} must be true, false, or null, got {v!r}")
@@ -338,6 +359,11 @@ def validate(cfg: Config, backend: Optional[str] = None) -> Config:
         raise ConfigError("n_replicas must be >= 1 (or null = all devices)")
     if cfg.queue_bound is not None and cfg.queue_bound < 1:
         raise ConfigError("queue_bound must be >= 1 (or null = unbounded)")
+    if cfg.cache_root is not None and not isinstance(cfg.cache_root, str):
+        raise ConfigError(
+            f"cache_root must be a directory path or null, got "
+            f"{cfg.cache_root!r}"
+        )
     return cfg
 
 

@@ -23,7 +23,6 @@ failure mode this layer must never have.
 """
 from __future__ import annotations
 
-import hashlib
 import json
 import os
 from typing import Any, Dict, List, Mapping, NamedTuple, Sequence, Tuple
@@ -139,25 +138,19 @@ def artifact_hash(
     The axis SCALES are part of the identity: they select each axis's
     interpolation coordinate, so the same table queried under a
     different scale list returns different numbers.
+
+    Construction lives in the shared provenance layer
+    (:func:`bdlz_tpu.provenance.emulator_artifact_identity`); the digest
+    is byte-compatible with the pre-provenance implementation, so every
+    existing artifact on disk keeps loading (pinned in
+    ``tests/test_provenance.py``).
     """
-    h = hashlib.sha256()
-    payload = {
-        "schema_version": SCHEMA_VERSION,
-        "axes": {
-            str(n): [float(v) for v in np.asarray(nodes)]
-            for n, nodes in zip(axis_names, axis_nodes)
-        },
-        "scales": [str(s) for s in axis_scales],
-        "identity": dict(identity),
-        "fields": sorted(values),
-    }
-    h.update(json.dumps(payload, sort_keys=True).encode())
-    for name in sorted(values):
-        h.update(name.encode())
-        h.update(np.ascontiguousarray(
-            np.asarray(values[name], dtype=np.float64)
-        ).tobytes())
-    return h.hexdigest()[:16]
+    from bdlz_tpu.provenance import emulator_artifact_identity
+
+    return emulator_artifact_identity(
+        axis_names, axis_nodes, axis_scales, values, identity,
+        SCHEMA_VERSION,
+    ).digest(16)
 
 
 def _validate_table(artifact: EmulatorArtifact, where: str) -> None:

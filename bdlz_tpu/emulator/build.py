@@ -125,7 +125,7 @@ def _draw_probes(
 def _exact_fields(
     base, axes: Mapping[str, np.ndarray], static, *, product: bool,
     mesh, chunk_size: int, n_y: int, impl: str,
-    fault_plan=None, retry=None,
+    fault_plan=None, retry=None, cache=None,
 ) -> Tuple[Dict[str, np.ndarray], int]:
     """Exact pipeline over a product grid via the production sweep engine.
 
@@ -144,7 +144,7 @@ def _exact_fields(
     res = run_sweep(
         base, dict(axes), static, mesh=mesh, chunk_size=chunk_size,
         n_y=n_y, out_dir=None, keep_outputs=True, impl=impl,
-        fault_plan=fault_plan, retry=retry,
+        fault_plan=fault_plan, retry=retry, cache=cache,
     )
     n_pts = res.n_points
     if res.n_failed:
@@ -164,7 +164,7 @@ def _exact_fields(
 
 def make_exact_evaluator(
     base, static, *, n_y: int, impl: str, mesh=None, chunk_size: int = 2048,
-    retry=None, fault_plan=None, quarantine_sink=None,
+    retry=None, fault_plan=None, quarantine_sink=None, cache=None,
 ):
     """Zipped exact-pipeline evaluator through the production engine.
 
@@ -185,29 +185,72 @@ def make_exact_evaluator(
     ``quarantine_sink`` after every ``evaluate`` call — instead of
     killing the caller.  ``fault_plan`` fires injected ``probe`` faults
     keyed by the evaluator's chunk-call counter.
+
+    ``cache`` (a :class:`~bdlz_tpu.provenance.Store`) consults the SAME
+    content-addressed chunk entries ``run_sweep`` writes
+    (``parallel.sweep.chunk_cache_key`` — keys carry no axes or chunk
+    position, only resolved identity + slice bytes), so a warm emulator
+    rebuild's probe/held-out evaluations hit the chunks the cold build
+    paid; the engine (device tables + jit) is built lazily, only when a
+    chunk actually misses.  Cached probe-fault quarantine round-trips
+    through entries like the sweep's; entries are only WRITTEN for
+    clean chunks unless a fault plan is armed (armed plans join the
+    key, so chaos probes can never pollute clean runs).
     """
     import jax
     import jax.numpy as jnp
 
     from bdlz_tpu.models.yields_pipeline import YieldsResult
     from bdlz_tpu.ops.kjma_table import make_f_table
-    from bdlz_tpu.parallel.sweep import _pad_chunk, build_grid, make_sweep_step
+    from bdlz_tpu.parallel.sweep import (
+        _pad_chunk,
+        build_grid,
+        chunk_cache_key,
+        chunk_entry_arrays,
+        chunk_entry_ok,
+        engine_identity_extra,
+        make_sweep_step,
+    )
     from bdlz_tpu.physics.percolation import make_kjma_grid
     from bdlz_tpu.utils.retry import call_with_retry
 
     interpret = impl == "pallas" and jax.devices()[0].platform == "cpu"
-    step = make_sweep_step(
-        static, mesh=mesh, n_y=n_y, impl=impl, interpret=interpret
-    )
-    if impl == "tabulated":
-        aux = make_f_table(float(base.I_p), jnp)
-    elif impl == "pallas":
-        from bdlz_tpu.ops.kjma_pallas import build_shifted_table
+    fields = YieldsResult._fields
 
-        table = make_f_table(float(base.I_p), jnp)
-        aux = (table, build_shifted_table(table))
-    else:
-        aux = make_kjma_grid(jnp)
+    # lazy engine: a fully cache-hit evaluate() pays no table build and
+    # no compile — most of the warm-rebuild win for probe rounds
+    _engine: Dict[str, Any] = {}
+
+    def _ensure_engine():
+        if "step" in _engine:
+            return _engine["step"], _engine["aux"]
+        _engine["step"] = make_sweep_step(
+            static, mesh=mesh, n_y=n_y, impl=impl, interpret=interpret
+        )
+        if impl == "tabulated":
+            _engine["aux"] = make_f_table(float(base.I_p), jnp)
+        elif impl == "pallas":
+            from bdlz_tpu.ops.kjma_pallas import build_shifted_table
+
+            table = make_f_table(float(base.I_p), jnp)
+            _engine["aux"] = (table, build_shifted_table(table))
+        else:
+            _engine["aux"] = make_kjma_grid(jnp)
+        return _engine["step"], _engine["aux"]
+
+    def _chunk_extra(pp, lo, hi):
+        esdirk_knobs = None
+        if impl == "esdirk":
+            # mirrors the engine's own per-chunk resolution (knobs=None)
+            from bdlz_tpu.solvers.batching import resolve_engine_knobs
+
+            esdirk_knobs = resolve_engine_knobs(
+                static, np.asarray(pp.I_p)[lo:hi]
+            )
+        return engine_identity_extra(
+            static, impl, esdirk_knobs=esdirk_knobs, faults=fault_plan,
+            interpret=interpret,
+        )
 
     calls = [0]  # the probe-fault key: one count per chunk dispatch
 
@@ -215,9 +258,7 @@ def make_exact_evaluator(
         pp = build_grid(base, dict(axes), product=False)
         n = int(np.asarray(pp.m_chi_GeV).shape[0])
         chunk = min(int(chunk_size), n) if chunk_size else n
-        out: Dict[str, List[np.ndarray]] = {
-            f: [] for f in YieldsResult._fields
-        }
+        out: Dict[str, List[np.ndarray]] = {f: [] for f in fields}
         qmask = np.zeros(n, dtype=bool)
         for lo in range(0, n, chunk):
             hi = min(lo + chunk, n)
@@ -226,15 +267,40 @@ def make_exact_evaluator(
             call_idx = calls[0]
             calls[0] += 1
 
-            def one_chunk(lo=lo, hi=hi, call_idx=call_idx):
+            key = None
+            if cache is not None:
+                key = chunk_cache_key(
+                    base, static, pp, lo, hi, n_y=n_y, impl=impl,
+                    extra=_chunk_extra(pp, lo, hi),
+                    fault_ctx=(
+                        ("probe", call_idx, lo, hi)
+                        if fault_plan is not None else None
+                    ),
+                )
+                ent = cache.get_npz(f"sweep_chunk/{key}.npz")
+                if chunk_entry_ok(ent, hi - lo):
+                    for f in fields:
+                        out[f].append(ent[f])
+                    qm = ent.get("quarantined")
+                    if qm is not None:
+                        qmask[lo:hi] = np.asarray(qm, dtype=bool)
+                    continue
+
+            attempts = [0]  # counts one_chunk calls → retries = calls - 1
+
+            def one_chunk(lo=lo, hi=hi, call_idx=call_idx,
+                          attempts=attempts):
+                attempts[0] += 1
                 if fault_plan is not None:
                     fault_plan.fire("probe", call_idx)
+                step, aux = _ensure_engine()
                 res = step(_pad_chunk(pp, lo, hi, chunk), aux)
                 return {
                     f: np.asarray(getattr(res, f))[: hi - lo]
-                    for f in YieldsResult._fields
+                    for f in fields
                 }
 
+            quarantined_here = False
             try:
                 host = (
                     call_with_retry(one_chunk, retry, label=f"probe{lo}")
@@ -243,12 +309,24 @@ def make_exact_evaluator(
             except Exception:  # noqa: BLE001 — quarantined when allowed
                 if quarantine_sink is None:
                     raise
-                host = {
-                    f: np.full(hi - lo, np.nan)
-                    for f in YieldsResult._fields
-                }
+                host = {f: np.full(hi - lo, np.nan) for f in fields}
                 qmask[lo:hi] = True
-            for f in YieldsResult._fields:
+                quarantined_here = True
+            if cache is not None and (
+                not quarantined_here or fault_plan is not None
+            ):
+                cache.put_npz(
+                    f"sweep_chunk/{key}.npz",
+                    chunk_entry_arrays(
+                        host,
+                        n_retries=max(attempts[0] - 1, 0),
+                        qmask=(
+                            np.ones(hi - lo, dtype=bool)
+                            if quarantined_here else None
+                        ),
+                    ),
+                )
+            for f in fields:
                 out[f].append(host[f])
         if quarantine_sink is not None:
             quarantine_sink(qmask)
@@ -399,6 +477,7 @@ def build_emulator(
     require_converged: bool = False,
     fault_plan=None,
     retry=None,
+    cache=None,
 ) -> Tuple[EmulatorArtifact, BuildReport]:
     """Build (and optionally save) an error-controlled yield-surface emulator.
 
@@ -413,6 +492,15 @@ def build_emulator(
     that the refinement never saw.  With ``require_converged=True`` a
     budget-exhausted build raises instead of saving a surface that
     missed its tolerance.
+
+    ``cache`` (store / root path / None — resolved like ``run_sweep``'s)
+    routes every exact evaluation the build pays — the initial tensor
+    grid, refinement hyperplanes, probe rounds, the held-out set —
+    through the content-addressed sweep chunk cache
+    (docs/provenance.md): a warm rebuild of the same box skips straight
+    to gather with a bit-identical surface (the ``sweep_cache`` bench
+    line measures exactly this), and an overlapping rebuild reuses
+    whatever hyperplane slices an earlier build already paid for.
     """
     from bdlz_tpu.config import static_choices_from_config, validate
     from bdlz_tpu.parallel.sweep import AXIS_MAP
@@ -457,6 +545,12 @@ def build_emulator(
 
     faults = FaultPlan.resolve(fault_plan, base)
     retry_policy = resolve_engine_retry(retry, base, static)
+    # One store for every exact evaluation of the build (grid sweeps
+    # inherit it through run_sweep; probes through the evaluator), so
+    # hyperplane and probe chunks land in — and hit — the same entries.
+    from bdlz_tpu.provenance import resolve_store
+
+    store = resolve_store(cache, base, label="emulator")
 
     # Resolve the quadrature tri-state ONCE, over the initial tensor
     # grid, and pass the explicit bool to EVERY internal sweep (the
@@ -487,7 +581,7 @@ def build_emulator(
     flat, n_exact = _exact_fields(
         base, {k: a for k, a in zip(axis_names, nodes)}, static,
         product=True, mesh=mesh, chunk_size=chunk_size, n_y=n_y, impl=impl,
-        fault_plan=faults, retry=retry_policy,
+        fault_plan=faults, retry=retry_policy, cache=store,
     )
     values = {f: np.asarray(flat[f]).reshape(grid_shape()) for f in FIELDS}
     _check_positive(values)
@@ -500,7 +594,7 @@ def build_emulator(
         base, static, n_y=n_y, impl=impl, mesh=mesh,
         chunk_size=min(int(chunk_size), int(n_probe)),
         retry=retry_policy, fault_plan=faults,
-        quarantine_sink=qsink.append,
+        quarantine_sink=qsink.append, cache=store,
     )
     n_quarantined_probes = 0
 
@@ -656,7 +750,7 @@ def build_emulator(
             flat, n_new = _exact_fields(
                 base, axes_eval, static, product=True, mesh=mesh,
                 chunk_size=chunk_size, n_y=n_y, impl=impl,
-                fault_plan=faults, retry=retry_policy,
+                fault_plan=faults, retry=retry_policy, cache=store,
             )
             n_exact += n_new
             slab_shape = tuple(

@@ -97,6 +97,13 @@ STATIC_PARAM_NAMES = {
     "queue_bound",
     "routing",
     "rollout",
+    # provenance-cache knobs (bdlz_tpu/provenance/, docs/provenance.md):
+    # the cache gate and store root are host-side orchestration — a
+    # cached result's bits are identical to a computed one's, and
+    # neither value ever reaches a tracer.  Same specific-names-only
+    # rule as above.
+    "cache_enabled",
+    "cache_root",
     "n_y",
     "nz",
     "n_mu",

@@ -266,10 +266,24 @@ def main(argv=None) -> None:
         from bdlz_tpu.config import config_identity_dict
         from bdlz_tpu.sampling.checkpoint import run_ensemble_checkpointed
 
+        # The RESOLVED static joins the run identity (provenance layer):
+        # the likelihood's per-point fast path resolves every tri-state
+        # engine knob to its bit-pinned default (quad_panel_gl None ->
+        # trapezoid, ode_* None -> off), so the resolution is recorded
+        # explicitly — a future default flip (e.g. panel-GL adopted on
+        # this path) then invalidates resume instead of silently
+        # splicing a trapezoid-era chain (the PR-4 drift this fixes).
+        static_resolved = static._replace(
+            quad_panel_gl=bool(static.quad_panel_gl),
+            ode_auto_h0=bool(static.ode_auto_h0),
+            ode_pi_controller=bool(static.ode_pi_controller),
+            ode_tabulated_av=bool(static.ode_tabulated_av),
+        )
         run = run_ensemble_checkpointed(
             args.seed + 1, logp, init, n_steps=args.steps,
             out_dir=args.checkpoint_dir,
             checkpoint_every=args.checkpoint_every, mesh=mesh,
+            static=static_resolved,
             # fingerprint of the posterior: the physics config (extension
             # keys only when non-default, so new framework fields don't
             # invalidate old chains) + the sampled-parameter spec + the

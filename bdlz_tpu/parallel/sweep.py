@@ -19,7 +19,6 @@ masked and reported per chunk, never aborting the sweep (SURVEY §5
 """
 from __future__ import annotations
 
-import hashlib
 import json
 import sys
 import time
@@ -110,20 +109,189 @@ def grid_hash(
     The config enters through ``config_identity_dict`` — extension keys
     only when non-default — so ADDING a framework extension field does
     not invalidate every pre-existing sweep directory.
-    """
-    from bdlz_tpu.config import config_identity_dict
 
-    payload = {
-        "base": config_identity_dict(base),
-        "axes": {k: list(map(float, v)) for k, v in axes.items()},
-        "n_y": n_y,
-        "impl": impl,
+    Construction lives in the shared provenance layer
+    (:func:`bdlz_tpu.provenance.sweep_identity`); the digest is
+    byte-compatible with the pre-provenance implementation, so existing
+    sweep directories keep their manifests (pinned in
+    ``tests/test_provenance.py``).
+    """
+    from bdlz_tpu.provenance import sweep_identity
+
+    return sweep_identity(base, axes, n_y, impl, extra=extra).digest(16)
+
+
+def engine_identity_extra(
+    static: StaticChoices,
+    impl: str,
+    *,
+    esdirk_knobs: "dict | None" = None,
+    faults=None,
+    fuse_exp: bool = False,
+    pallas_reduce: "bool | None" = None,
+    interpret: "bool | None" = None,
+) -> Dict[str, Any]:
+    """Resolved result-affecting engine knobs as identity ``extra`` blocks.
+
+    ONE home for what the config hash alone cannot pin (the tri-state
+    knobs resolve per-engine), shared by the sweep manifest hash and the
+    chunk-cache keys so the two can never drift:
+
+    * ``quad`` — the resolved panel-GL scheme (panel/node counts);
+      omit-at-default (trapezoid) so pre-existing directories keep
+      their hashes;
+    * ``esdirk`` — the repacked engine's resolved knob dict (auto-h0/PI
+      change results at ~1e-7, the tabulated A/V RHS at ~1e-11);
+    * ``pallas`` — kernel-level knobs that change results at the ~1e-7
+      level (summation tier, fused exp; COL_BLOCK / bf16x3 layout
+      omit-at-default; ``interpret`` only when the caller runs the
+      interpreter — manifest hashes never pass it, keeping them
+      byte-stable);
+    * ``fault_plan`` — an ARMED plan joins every identity
+      (omit-at-default): nan/poison injection changes output bits, so
+      chaos results must never collide with clean ones.
+    """
+    extra: Dict[str, Any] = {}
+    if impl == "tabulated" and static.quad_panel_gl:
+        from bdlz_tpu.solvers.panels import (
+            N_PANELS_DEFAULT,
+            NODES_PER_PANEL_DEFAULT,
+        )
+
+        extra["quad"] = {
+            "panel_gl": True,
+            "n_panels": N_PANELS_DEFAULT,
+            "n_nodes": NODES_PER_PANEL_DEFAULT,
+        }
+    if impl == "esdirk":
+        extra["esdirk"] = {"strategy": "repack", **(esdirk_knobs or {})}
+    if impl == "pallas":
+        from bdlz_tpu.ops.kjma_pallas import (
+            COL_BLOCK,
+            COL_BLOCK_DEFAULT,
+            REDUCE_DEFAULT,
+            TABLE_SPLIT3,
+        )
+
+        extra["pallas"] = {
+            "fuse_exp": bool(fuse_exp),
+            "reduce": bool(
+                REDUCE_DEFAULT if pallas_reduce is None else pallas_reduce
+            ),
+            **(
+                {"col_block": COL_BLOCK}
+                if COL_BLOCK != COL_BLOCK_DEFAULT
+                else {}
+            ),
+            **({"table_split3": True} if TABLE_SPLIT3 else {}),
+            **({"interpret": True} if interpret else {}),
+        }
+    if faults is not None:
+        extra["fault_plan"] = faults.describe()
+    return extra
+
+
+def chunk_cache_key(
+    base: Config,
+    static: StaticChoices,
+    pp: PointParams,
+    lo: int,
+    hi: int,
+    *,
+    n_y: int,
+    impl: str,
+    table_nodes: int = 16384,
+    extra: "Mapping[str, Any] | None" = None,
+    fault_ctx: "tuple | None" = None,
+    platform: "str | None" = None,
+) -> str:
+    """Content key of one sweep chunk result (docs/provenance.md).
+
+    The yield surface is a pure function of the resolved configuration
+    and the per-point parameter values, so the key is (engine core,
+    point-slice bytes) — NOT the sweep's axes or chunk index: an
+    emulator rebuild whose hyperplanes repeat a slice some earlier run
+    paid for hits the same entry.  The engine core carries everything
+    results depend on that the slice bytes cannot: the config/static
+    identity, n_y, the engine, the F-table resolution, the resolved
+    engine ``extra`` blocks (:func:`engine_identity_extra`), and the
+    PLATFORM (XLA-CPU and TPU bits differ; cross-platform reuse would
+    void the bitwise contract).  Batch composition is deliberately
+    excluded: every engine is per-point (padding lanes are sliced off,
+    and the repacked stiff engine's bit-parity with the lockstep one is
+    pinned), which the sweep_cache bench line re-verifies bitwise every
+    round.
+
+    ``fault_ctx`` (``(site, chunk_or_call_index, lo, hi)``) must be
+    passed — on top of the plan already in ``extra`` — whenever a fault
+    plan is ARMED: injected faults are keyed by site + chunk/call index
+    + absolute point index, so the same slice at a different injection
+    position (or through a different fault site — run_sweep's ``step``
+    vs the probe evaluator's ``probe``) is a different (injected)
+    result.  Clean keys never carry the window, so a clean run can
+    never collide with a chaos entry and vice versa.
+    """
+    import jax
+
+    from bdlz_tpu.provenance import (
+        config_payload,
+        static_payload,
+        sweep_chunk_identity,
+    )
+
+    core: Dict[str, Any] = {
+        "schema": 1,
+        "base": config_payload(base),
+        "static": static_payload(static, normalize_quad=True),
+        "n_y": int(n_y),
+        "impl": str(impl),
+        "table_nodes": int(table_nodes),
+        "platform": platform or jax.devices()[0].platform,
     }
     if extra:
-        # only present when used — an unconditional key (even None) would
-        # change every existing sweep's hash and invalidate old manifests
-        payload["extra"] = dict(extra)
-    return hashlib.sha256(json.dumps(payload, sort_keys=True).encode()).hexdigest()[:16]
+        core["extra"] = dict(extra)
+    if fault_ctx is not None:
+        core["fault_window"] = [
+            v if isinstance(v, str) else int(v) for v in fault_ctx
+        ]
+    arrays = [np.asarray(f)[lo:hi] for f in pp]
+    return sweep_chunk_identity(core, arrays).digest(32)
+
+
+def chunk_entry_ok(ent, n_valid: int) -> bool:
+    """Validate one store entry's shape contract — every YieldsResult
+    field plus the failure mask at the slice length.  Shared by the two
+    entry consumers (``run_sweep``'s hit plan and the emulator's exact
+    evaluator) so what counts as a loadable entry cannot drift."""
+    from bdlz_tpu.models.yields_pipeline import YieldsResult
+
+    if ent is None or ent.get("failed") is None:
+        return False
+    return all(
+        ent.get(f) is not None and ent[f].shape == (n_valid,)
+        for f in YieldsResult._fields
+    )
+
+
+def chunk_entry_arrays(
+    host: Mapping[str, np.ndarray],
+    *,
+    n_retries: int = 0,
+    qmask: "np.ndarray | None" = None,
+) -> Dict[str, np.ndarray]:
+    """Build one store entry's array dict from a chunk's host results —
+    the single writer-side twin of :func:`chunk_entry_ok` (fields +
+    ``failed`` + the retry counter, quarantine mask only when any)."""
+    from bdlz_tpu.models.yields_pipeline import YieldsResult
+
+    arrays: Dict[str, np.ndarray] = {
+        f: host[f] for f in YieldsResult._fields
+    }
+    arrays["failed"] = ~np.isfinite(host["DM_over_B"])
+    arrays["n_retries"] = np.int64(n_retries)
+    if qmask is not None and qmask.any():
+        arrays["quarantined"] = qmask
+    return arrays
 
 
 def make_sweep_step(
@@ -555,6 +723,12 @@ class SweepResult:
     n_quarantined: int = 0
     #: Chunk re-dispatches the healing path paid (retries + bisect probes).
     n_retries: int = 0
+    #: Chunk-cache counters (docs/provenance.md): chunks served straight
+    #: from the content-addressed store / chunks that had to compute.
+    #: None when the run had no store configured (``cache_enabled`` /
+    #: ``cache_root`` / BDLZ_CACHE_ROOT all unset).
+    cache_hits: Optional[int] = None
+    cache_misses: Optional[int] = None
     outputs: Optional[Dict[str, np.ndarray]] = field(default=None, repr=False)
     #: Per-point failure mask (True = non-finite output, masked out), full
     #: grid order — not just the count, so callers can locate *which*
@@ -598,6 +772,7 @@ def run_sweep(
     overlap_chunks: bool = True,
     fault_plan=None,
     retry=None,
+    cache=None,
 ) -> SweepResult:
     """Run a full sweep: grid build → per-chunk jitted sharded evaluation →
     (optional) chunk files + manifest with resume.
@@ -648,6 +823,24 @@ def run_sweep(
     (:mod:`bdlz_tpu.faults`) to exercise all of this; disabled (the
     default) every hook is skipped and behavior is byte-identical to
     the unhealed engine.
+
+    **Chunk cache** (docs/provenance.md): with a resolved store
+    (``cache`` arg ▸ ``Config.cache_root``/``cache_enabled`` ▸
+    ``BDLZ_CACHE_ROOT``; default OFF), every chunk result is keyed by
+    its content (:func:`chunk_cache_key` — resolved engine identity +
+    point-slice bytes) in a content-addressed store, consulted before
+    dispatch: a warm re-run of an identical sweep, an emulator rebuild
+    repeating hyperplanes, or a fleet member resuming on another host
+    skips straight to gather with BIT-identical outputs.  Quarantine
+    masks and per-chunk retry counters round-trip through entries, so
+    self-healing bookkeeping survives a cache hit; real-world (plan-
+    less) quarantined chunks are never cached — only an armed,
+    identity-joined fault plan may replay injected NaNs.  The hit plan
+    is coordinator-decided and broadcast like the resume plan
+    (directory resume wins over the cache for a chunk that has both);
+    multi-process runs need the store root on shared storage, exactly
+    like chunk-file resume.  A fully warm run skips engine
+    construction (device tables + jit) entirely.
     """
     import jax
     import jax.numpy as jnp
@@ -784,110 +977,95 @@ def run_sweep(
 
     chunk_size = int(np.asarray(_bcast(np.array([chunk_size])))[0])
     pallas_reduce: "bool | None" = None  # resolved tier (None = kernel default)
-    if impl in ("direct", "esdirk", "esdirk_lockstep"):
-        aux = make_kjma_grid(jnp)
-    else:
-        if table_np is not None:
-            # reuse the audit's host-built table (same bytes, shipped)
-            from bdlz_tpu.ops.kjma_table import table_to_namespace
+    if impl == "pallas":
+        # COL_BLOCK and the bf16x3 table layout are import-time
+        # per-process knobs (BDLZ_PALLAS_COL_BLOCK /
+        # BDLZ_PALLAS_TABLE_SPLIT3) that key the kernel's numerics
+        # and (when non-default) the grid hash — a per-host env
+        # divergence must fail the whole fleet, not splice
+        # mixed-kernel chunks.  One elementwise allreduce_min over
+        # [v, -v] pairs yields [min, -max] per knob; min != max
+        # raises identically on every host.
+        from bdlz_tpu.ops.kjma_pallas import COL_BLOCK as _CB
+        from bdlz_tpu.ops.kjma_pallas import TABLE_SPLIT3 as _S3
+        from bdlz_tpu.parallel.multihost import allreduce_min as _armin
 
-            table = table_to_namespace(table_np, jnp)
-        else:
-            table = make_f_table(float(base.I_p), jnp, n=table_nodes)
-        if impl == "pallas":
-            from bdlz_tpu.ops.kjma_pallas import build_shifted_table
-
-            # COL_BLOCK and the bf16x3 table layout are import-time
-            # per-process knobs (BDLZ_PALLAS_COL_BLOCK /
-            # BDLZ_PALLAS_TABLE_SPLIT3) that key the kernel's numerics
-            # and (when non-default) the grid hash — a per-host env
-            # divergence must fail the whole fleet, not splice
-            # mixed-kernel chunks.  One elementwise allreduce_min over
-            # [v, -v] pairs yields [min, -max] per knob; min != max
-            # raises identically on every host.
-            from bdlz_tpu.ops.kjma_pallas import COL_BLOCK as _CB
-            from bdlz_tpu.ops.kjma_pallas import TABLE_SPLIT3 as _S3
-            from bdlz_tpu.parallel.multihost import allreduce_min as _armin
-
-            _knobs = np.asarray(_armin(np.array(
-                [_CB, -_CB, int(_S3), -int(_S3)], dtype=np.int64
-            )))
-            for _name, _lo, _hi, _local in (
-                ("BDLZ_PALLAS_COL_BLOCK", _knobs[0], -_knobs[1], _CB),
-                ("BDLZ_PALLAS_TABLE_SPLIT3", _knobs[2], -_knobs[3],
-                 int(_S3)),
-            ):
-                if int(_lo) != int(_hi):
-                    raise RuntimeError(
-                        f"{_name} differs across hosts (min {int(_lo)}, "
-                        f"max {int(_hi)}; this host {_local}); set one "
-                        "value fleet-wide"
-                    )
-            _tier_code = _TIER_CODE[None]  # non-hardware: kernel default
-            _tier_msg = "no hardware preflight (cpu/interpret)"
-            if not interpret and jax.devices()[0].platform != "cpu":
-                # Hardware preflight at the sweep's OWN shapes (lowering
-                # failures are shape-dependent — the r2 RecursionError
-                # needed n_y=8000's column count to fire), through the
-                # shared tier resolver so the sweep degrades reduce ->
-                # streaming exactly like the bench.
-                tier, _tier_msg = resolve_pallas_tier(
-                    static.chi_stats, n_y, fuse_exp=fuse_exp,
-                    table_nodes=table_nodes,
-                )
-                print(f"[sweep] pallas preflight {_tier_msg}", file=sys.stderr)
-                _tier_code = (
-                    _TIER_FAILED if tier is None else _TIER_CODE[tier]
-                )
-            # The preflight outcome is per-process, but the tier keys both
-            # the compiled step and the grid hash — hosts landing on
-            # different tiers would corrupt the shared manifest/chunk
-            # directory.  A coordinator-wins broadcast could force a tier
-            # some host's own preflight just proved fails there, so agree
-            # on the MIN (most conservative) tier across hosts; a host
-            # whose preflight failed entirely (-2) fails the whole fleet
-            # together instead of deadlocking a later collective.
-            _local_code = _tier_code
-            _tier_code = _agree_tier_code(_tier_code)
-            if _tier_code == _TIER_FAILED:
+        _knobs = np.asarray(_armin(np.array(
+            [_CB, -_CB, int(_S3), -int(_S3)], dtype=np.int64
+        )))
+        for _name, _lo, _hi, _local in (
+            ("BDLZ_PALLAS_COL_BLOCK", _knobs[0], -_knobs[1], _CB),
+            ("BDLZ_PALLAS_TABLE_SPLIT3", _knobs[2], -_knobs[3],
+             int(_S3)),
+        ):
+            if int(_lo) != int(_hi):
                 raise RuntimeError(
-                    "no pallas kernel tier preflights clean on every host "
-                    f"(this host: {_tier_msg}); rerun with "
-                    "impl='tabulated' or fix the kernel"
+                    f"{_name} differs across hosts (min {int(_lo)}, "
+                    f"max {int(_hi)}; this host {_local}); set one "
+                    "value fleet-wide"
                 )
-            pallas_reduce = _TIER_FROM_CODE[_tier_code]
-            _agreed_ok, _agreed_msg = 1, "validated by local resolution"
-            if _local_code > _tier_code:
-                # Another host downgraded the fleet to a tier this host's
-                # resolver short-circuited past without preflighting —
-                # validate it here so a mid-sweep Mosaic failure cannot
-                # be the first time this host compiles the agreed kernel.
-                _agreed, _agreed_msg = resolve_pallas_tier(
-                    static.chi_stats, n_y, fuse_exp=fuse_exp,
-                    table_nodes=table_nodes, reduce=pallas_reduce,
-                )
-                _agreed_ok = 0 if _agreed is None else 1
-            # Second agreement round so a re-preflight failure raises on
-            # EVERY host instead of one host raising while the rest hang
-            # in the first chunk collective.
-            _agreed_ok = int(np.asarray(_armin(np.array([_agreed_ok])))[0])
-            if _agreed_ok == 0:
-                raise RuntimeError(
-                    f"fleet-agreed pallas tier reduce={pallas_reduce} "
-                    f"fails preflight on some host (this host: "
-                    f"{_agreed_msg}); rerun with impl='tabulated' or fix "
-                    "the kernel"
-                )
-            if _local_code != _tier_code:
-                print(
-                    f"[sweep] pallas fleet tier: reduce={pallas_reduce} "
-                    f"(local preflight resolved "
-                    f"{_TIER_FROM_CODE[_local_code]})",
-                    file=sys.stderr,
-                )
-            aux = (table, build_shifted_table(table))
-        else:
-            aux = table
+        _tier_code = _TIER_CODE[None]  # non-hardware: kernel default
+        _tier_msg = "no hardware preflight (cpu/interpret)"
+        if not interpret and jax.devices()[0].platform != "cpu":
+            # Hardware preflight at the sweep's OWN shapes (lowering
+            # failures are shape-dependent — the r2 RecursionError
+            # needed n_y=8000's column count to fire), through the
+            # shared tier resolver so the sweep degrades reduce ->
+            # streaming exactly like the bench.
+            tier, _tier_msg = resolve_pallas_tier(
+                static.chi_stats, n_y, fuse_exp=fuse_exp,
+                table_nodes=table_nodes,
+            )
+            print(f"[sweep] pallas preflight {_tier_msg}", file=sys.stderr)
+            _tier_code = (
+                _TIER_FAILED if tier is None else _TIER_CODE[tier]
+            )
+        # The preflight outcome is per-process, but the tier keys both
+        # the compiled step and the grid hash — hosts landing on
+        # different tiers would corrupt the shared manifest/chunk
+        # directory.  A coordinator-wins broadcast could force a tier
+        # some host's own preflight just proved fails there, so agree
+        # on the MIN (most conservative) tier across hosts; a host
+        # whose preflight failed entirely (-2) fails the whole fleet
+        # together instead of deadlocking a later collective.
+        _local_code = _tier_code
+        _tier_code = _agree_tier_code(_tier_code)
+        if _tier_code == _TIER_FAILED:
+            raise RuntimeError(
+                "no pallas kernel tier preflights clean on every host "
+                f"(this host: {_tier_msg}); rerun with "
+                "impl='tabulated' or fix the kernel"
+            )
+        pallas_reduce = _TIER_FROM_CODE[_tier_code]
+        _agreed_ok, _agreed_msg = 1, "validated by local resolution"
+        if _local_code > _tier_code:
+            # Another host downgraded the fleet to a tier this host's
+            # resolver short-circuited past without preflighting —
+            # validate it here so a mid-sweep Mosaic failure cannot
+            # be the first time this host compiles the agreed kernel.
+            _agreed, _agreed_msg = resolve_pallas_tier(
+                static.chi_stats, n_y, fuse_exp=fuse_exp,
+                table_nodes=table_nodes, reduce=pallas_reduce,
+            )
+            _agreed_ok = 0 if _agreed is None else 1
+        # Second agreement round so a re-preflight failure raises on
+        # EVERY host instead of one host raising while the rest hang
+        # in the first chunk collective.
+        _agreed_ok = int(np.asarray(_armin(np.array([_agreed_ok])))[0])
+        if _agreed_ok == 0:
+            raise RuntimeError(
+                f"fleet-agreed pallas tier reduce={pallas_reduce} "
+                f"fails preflight on some host (this host: "
+                f"{_agreed_msg}); rerun with impl='tabulated' or fix "
+                "the kernel"
+            )
+        if _local_code != _tier_code:
+            print(
+                f"[sweep] pallas fleet tier: reduce={pallas_reduce} "
+                f"(local preflight resolved "
+                f"{_TIER_FROM_CODE[_local_code]})",
+                file=sys.stderr,
+            )
     esdirk_knobs = None
     if impl == "esdirk":
         # Resolve the repacked engine's tri-state knobs ONCE over the
@@ -902,12 +1080,45 @@ def run_sweep(
     # the event log (one "esdirk_rounds" event per chunk) — the repacking
     # exists to retire lanes early, and that claim needs numbers attached.
     _esdirk_stats_holder: list = []
-    step = make_sweep_step(
-        static, mesh=mesh, n_y=n_y, use_table=use_table, impl=impl,
-        interpret=interpret, fuse_exp=fuse_exp, reduce=pallas_reduce,
-        esdirk_stats_sink=_esdirk_stats_holder.append,
-        esdirk_knobs=esdirk_knobs,
-    )
+
+    # Engine construction is LAZY (docs/provenance.md): the device
+    # tables and the jitted step are built on the first chunk that
+    # actually COMPUTES — a fully resumed or fully cache-hit warm run
+    # never pays table shipping or compilation, which is most of the
+    # sweep_cache warm-rebuild win on small grids.  Identity-affecting
+    # resolution (pallas tier, esdirk knobs, quadrature) already
+    # happened above, so laziness changes no hash and, being plan-
+    # driven, every multi-controller process builds (or skips) the
+    # engine at the same loop points.
+    _engine: Dict[str, Any] = {}
+
+    def _ensure_engine():
+        if "step" in _engine:
+            return _engine["step"], _engine["aux"]
+        if impl in ("direct", "esdirk", "esdirk_lockstep"):
+            aux = make_kjma_grid(jnp)
+        else:
+            if table_np is not None:
+                # reuse the audit's host-built table (same bytes, shipped)
+                from bdlz_tpu.ops.kjma_table import table_to_namespace
+
+                table = table_to_namespace(table_np, jnp)
+            else:
+                table = make_f_table(float(base.I_p), jnp, n=table_nodes)
+            if impl == "pallas":
+                from bdlz_tpu.ops.kjma_pallas import build_shifted_table
+
+                aux = (table, build_shifted_table(table))
+            else:
+                aux = table
+        _engine["aux"] = aux
+        _engine["step"] = make_sweep_step(
+            static, mesh=mesh, n_y=n_y, use_table=use_table, impl=impl,
+            interpret=interpret, fuse_exp=fuse_exp, reduce=pallas_reduce,
+            esdirk_stats_sink=_esdirk_stats_holder.append,
+            esdirk_knobs=esdirk_knobs,
+        )
+        return _engine["step"], _engine["aux"]
 
     from bdlz_tpu.parallel.multihost import (
         broadcast_from_coordinator,
@@ -920,77 +1131,23 @@ def run_sweep(
 
     manifest_path = None
     manifest: Dict[str, Any] = {}
-    if impl == "pallas":
-        # Kernel-level knobs that change pallas results at the ~1e-7
-        # level join the identity (same reasoning as ode_method/rtol/atol
-        # for the stiff engine): a resumed directory must not splice
-        # chunks from different summation/exp algorithms.  "reduce"
-        # records the tier this sweep actually runs with — the resolved
-        # preflight tier on hardware, the kernel default otherwise.
-        from bdlz_tpu.ops.kjma_pallas import (
-            COL_BLOCK,
-            COL_BLOCK_DEFAULT,
-            REDUCE_DEFAULT,
-            TABLE_SPLIT3,
-        )
-
-        hash_extra = dict(hash_extra or {})
-        hash_extra["pallas"] = {
-            "fuse_exp": bool(fuse_exp),
-            "reduce": bool(
-                REDUCE_DEFAULT if pallas_reduce is None else pallas_reduce
-            ),
-            # omit-at-default so pre-r4 directories stay resumable; a
-            # non-default block changes Kahan accumulation order (~1e-13)
-            **(
-                {"col_block": COL_BLOCK}
-                if COL_BLOCK != COL_BLOCK_DEFAULT
-                else {}
-            ),
-            # the bf16x3 table layout changes results at ~1e-12 — a
-            # resumed directory must not splice the two layouts
-            **({"table_split3": True} if TABLE_SPLIT3 else {}),
-        }
-    if impl == "esdirk":
-        # The repacked engine's RESOLVED knobs join the identity (the
-        # config's tri-state Nones resolve per-engine, so the config hash
-        # alone cannot pin them): auto-h0/PI change results at ~1e-7,
-        # the tabulated A/V RHS at ~1e-11 — a resumed directory must not
-        # splice chunks across knob settings.  ``esdirk_knobs`` is the
-        # sweep-level resolution the step above actually runs with.
-        # Pre-existing impl="esdirk" directories (computed by the old
-        # lockstep strategy) get a different hash and recompute, which
-        # is exactly right — the new default engine is a different
-        # numerical engine.
-        hash_extra = dict(hash_extra or {})
-        hash_extra["esdirk"] = {"strategy": "repack", **esdirk_knobs}
-    if faults is not None:
-        # An ARMED fault plan joins the identity: nan/poison injection
-        # changes the bits a chaos run writes into its chunk files, so a
-        # chaos directory must never be silently resumed by a clean run
-        # (or vice versa).  Omit-at-default — no plan, no key — so every
-        # clean sweep's hash is byte-identical to pre-robustness; the
-        # retry_* knobs stay excluded (orchestration cannot change
-        # output bits).
-        hash_extra = dict(hash_extra or {})
-        hash_extra["fault_plan"] = faults.describe()
-    if quad_on:
-        # The RESOLVED quadrature joins the identity (same reasoning as
-        # the esdirk knobs): panel-GL and trapezoid chunks agree only to
-        # ~1e-11 on audited grids — a resumed directory must never
-        # splice the two schemes.  Omit-at-default (trapezoid) so every
-        # pre-existing sweep directory keeps its hash.
-        from bdlz_tpu.solvers.panels import (
-            N_PANELS_DEFAULT,
-            NODES_PER_PANEL_DEFAULT,
-        )
-
-        hash_extra = dict(hash_extra or {})
-        hash_extra["quad"] = {
-            "panel_gl": True,
-            "n_panels": N_PANELS_DEFAULT,
-            "n_nodes": NODES_PER_PANEL_DEFAULT,
-        }
+    # The RESOLVED engine knobs join the identity through the shared
+    # provenance helper (the config hash alone cannot pin tri-states
+    # that resolve per-engine): the pallas kernel tier/layout, the
+    # repacked esdirk knob dict, the resolved panel-GL scheme, and an
+    # ARMED fault plan — all omit-at-default so every pre-existing
+    # sweep directory keeps its hash, and a resumed directory can never
+    # splice chunks computed under different numerics (or splice chaos
+    # output into a clean run).  Pre-existing impl="esdirk" directories
+    # (old lockstep strategy) hash differently and recompute — the new
+    # default engine is a different numerical engine, so that is
+    # exactly right.
+    extra_engine = engine_identity_extra(
+        static, impl, esdirk_knobs=esdirk_knobs, faults=faults,
+        fuse_exp=fuse_exp, pallas_reduce=pallas_reduce,
+    )
+    if extra_engine:
+        hash_extra = {**(hash_extra or {}), **extra_engine}
     h = grid_hash(base, axes, n_y, impl, extra=hash_extra)
     if out_dir is not None:
         import os
@@ -1067,6 +1224,69 @@ def run_sweep(
     plan = broadcast_from_coordinator(plan)
 
     fields = YieldsResult._fields
+
+    # ---- content-addressed chunk cache (docs/provenance.md) ----------
+    # The hit plan mirrors the resume plan exactly: coordinator-decided,
+    # broadcast, directory-resume wins where both apply.  Keys are pure
+    # functions of (resolved identity, slice bytes), so every process
+    # computes identical keys without a collective; only the coordinator
+    # probes the store (other processes read the shared root when they
+    # need the bytes, like chunk-file resume).  The broadcast runs even
+    # with no store configured — a per-host env divergence must surface
+    # as a loud shared-root error below, never as a collective deadlock.
+    from bdlz_tpu.provenance import resolve_store
+
+    store = resolve_store(cache, base, label="sweep")
+    chunk_keys: "list | None" = None
+    cache_data: Dict[int, Dict[str, np.ndarray]] = {}
+    # [hit, prior_n_retries] — failure/quarantine counts are recomputed
+    # from the entry bits on the hit path, so only these two flow
+    # through the plan collective
+    cplan = np.zeros((n_chunks, 2), dtype=np.int64)
+
+    def _entry_name(ci: int) -> str:
+        return f"sweep_chunk/{chunk_keys[ci]}.npz"
+
+    if store is not None:
+        armed = faults is not None
+        chunk_extra = {
+            k: v for k, v in (hash_extra or {}).items()
+            if k in ("quad", "esdirk", "pallas", "fault_plan")
+        }
+        if impl == "pallas" and interpret:
+            # the interpreter's bits are not the hardware kernel's; the
+            # manifest hash never carried this knob (resume directories
+            # are per-run anyway) but a content-addressed entry crosses
+            # runs, so the chunk key must
+            chunk_extra["pallas"] = {
+                **chunk_extra.get("pallas", {}), "interpret": True,
+            }
+        chunk_keys = [
+            chunk_cache_key(
+                base, static, pp_all,
+                ci * chunk_size, min((ci + 1) * chunk_size, n_total),
+                n_y=n_y, impl=impl, table_nodes=table_nodes,
+                extra=chunk_extra,
+                fault_ctx=(
+                    ("step", ci, ci * chunk_size,
+                     min((ci + 1) * chunk_size, n_total))
+                    if armed else None
+                ),
+            )
+            for ci in range(n_chunks)
+        ]
+        if coordinator:
+            for ci in range(n_chunks):
+                if plan[ci, 0]:
+                    continue  # resumed from the sweep directory wins
+                n_valid_ci = min((ci + 1) * chunk_size, n_total) - ci * chunk_size
+                ent = store.get_npz(_entry_name(ci))
+                if not chunk_entry_ok(ent, n_valid_ci):
+                    continue
+                cache_data[ci] = ent
+                cplan[ci] = (1, int(ent.get("n_retries", 0)))
+    cplan = broadcast_from_coordinator(cplan)
+
     collected = {f: [] for f in fields} if keep_outputs else None
     masks: Optional[list] = []
     qmasks: Optional[list] = []
@@ -1153,7 +1373,8 @@ def run_sweep(
                 from bdlz_tpu.parallel.multihost import shard_global_chunk
 
                 ppc = shard_global_chunk(ppc, batch_sharding(mesh))
-            res = step(ppc, aux)
+            step_fn, aux = _ensure_engine()
+            res = step_fn(ppc, aux)
             full = gather_to_host({f: getattr(res, f) for f in fields})
             host = {f: full[f][: hi_r - lo_r] for f in fields}
         except Exception as exc:  # noqa: BLE001 — healing path decides
@@ -1181,12 +1402,16 @@ def run_sweep(
         attempts = max(int(retry_policy.max_attempts), 1)
         return attempts * 4 * (1 + max(int(n) - 1, 1).bit_length())
 
-    def _heal_range(ci, lo_r, hi_r, first_err, budget):
+    def _heal_range(ci, lo_r, hi_r, first_err, budget, paid):
         """Bounded retry with deterministic backoff; persistent failure
         bisects (surviving halves kept) down to the irreducible points,
         which are quarantined into the failure mask.  ``budget`` is a
         1-element list of remaining attempts shared across the chunk's
-        whole heal tree; exhaustion quarantines the range wholesale."""
+        whole heal tree; exhaustion quarantines the range wholesale.
+        ``paid`` is the CHUNK's own retry counter (a 1-element list on
+        its loop entry): the cache stores it per entry, and attributing
+        through the global counter instead would let an overlapped
+        neighbor's collect-time healing leak into this chunk's delta."""
         nonlocal n_retries
         err = first_err
         attempts = max(int(retry_policy.max_attempts), 1)
@@ -1202,6 +1427,7 @@ def run_sweep(
                 backoff_delay(retry_policy, f"chunk{ci}:{lo_r}", attempt - 1)
             )
             n_retries += 1
+            paid[0] += 1
             budget[0] -= 1
             ok, host, err2 = _attempt_range(ci, lo_r, hi_r)
             if ok:
@@ -1219,6 +1445,7 @@ def run_sweep(
                 parts.append(_quarantine_range(ci, a, b, err))
                 continue
             n_retries += 1
+            paid[0] += 1
             budget[0] -= 1
             ok, host, err_h = _attempt_range(ci, a, b)
             if ok:
@@ -1227,14 +1454,14 @@ def run_sweep(
                     np.zeros(b - a, dtype=bool),
                 ))
             else:
-                parts.append(_heal_range(ci, a, b, err_h, budget))
+                parts.append(_heal_range(ci, a, b, err_h, budget, paid))
         return (
             {f: np.concatenate([p[0][f] for p in parts]) for f in fields},
             np.concatenate([p[1] for p in parts]),
         )
 
     def _collect() -> None:
-        nonlocal inflight, n_failed, n_quarantined
+        nonlocal inflight, n_failed, n_quarantined, n_retries
         if inflight is None:
             return
         entry, inflight = inflight, None
@@ -1260,12 +1487,22 @@ def run_sweep(
                 host, entry["qmask"] = _heal_range(
                     entry["ci"], entry["lo"], entry["hi"], collect_err,
                     [_heal_budget(entry["hi"] - entry["lo"])],
+                    entry.setdefault("retries_paid", [0]),
                 )
-        host = _apply_nan_faults(host, entry["lo"], entry["hi"])
+        if not entry.get("cached"):
+            # cached entries carry post-injection bits already; NaN
+            # faults re-applied would be idempotent, but the skip keeps
+            # the hook count (and therefore the plan's fire budget)
+            # identical to the run that wrote the entry
+            host = _apply_nan_faults(host, entry["lo"], entry["hi"])
         q = entry.get("qmask")
         if q is None:
             q = np.zeros(entry["n_valid"], dtype=bool)
         n_quarantined += int(q.sum())
+        # round-trip the healing bookkeeping through cache entries: a
+        # warm hit restores the retries the cold run paid, so counters
+        # (like the masks) are bit-for-bit whatever the cold run reported
+        n_retries += int(entry.get("retries_cached", 0))
         bad = ~np.isfinite(host["DM_over_B"])
         n_failed += int(bad.sum())
         if event_log is not None:
@@ -1273,6 +1510,7 @@ def run_sweep(
                 "chunk_done", chunk=entry["ci"], n_valid=entry["n_valid"],
                 n_failed=int(bad.sum()), n_quarantined=int(q.sum()),
                 seconds=round(time.time() - entry["t0"], 4),
+                **({"cached": True} if entry.get("cached") else {}),
             )
             while _esdirk_stats_holder:
                 cs = _esdirk_stats_holder.pop(0)
@@ -1314,6 +1552,21 @@ def run_sweep(
                 # torn-storage injection AFTER the atomic write: the
                 # resume path must detect the truncated zip and recompute
                 faults.corrupt_file("chunk_write", entry["ci"], entry["file"])
+        if store is not None and coordinator and not entry.get("cached"):
+            # populate the chunk cache from the freshly computed result.
+            # Quarantined chunks are stored ONLY under an armed fault
+            # plan (deterministic injection, part of the key): a real-
+            # world infrastructure quarantine must recompute on the next
+            # run, never replay its NaNs out of the cache.
+            if not q.any() or faults is not None:
+                store.put_npz(
+                    _entry_name(entry["ci"]),
+                    chunk_entry_arrays(
+                        host,
+                        n_retries=entry.get("retries_paid", [0])[0],
+                        qmask=q,
+                    ),
+                )
         if keep_outputs:
             for f in fields:
                 collected[f].append(host[f])
@@ -1371,6 +1624,41 @@ def run_sweep(
                     qmasks = None
             continue
 
+        if cplan[ci, 0]:
+            # cache hit (docs/provenance.md): the chunk another run —
+            # possibly another host — already paid.  Routed through the
+            # normal _collect() bookkeeping (chunk file + manifest are
+            # REBUILT from the cached bytes when out_dir is set, so the
+            # sweep directory stays resumable), with quarantine mask and
+            # retry counters restored from the entry.
+            _collect()  # keep collected/masks appends in chunk order
+            ent = cache_data.get(ci)
+            if ent is None:
+                # non-coordinator process: the plan was broadcast, so
+                # the bytes must come from the shared store root
+                ent = store.get_npz(_entry_name(ci)) if store is not None else None
+                if not chunk_entry_ok(ent, n_valid):
+                    raise RuntimeError(
+                        f"chunk {ci} was cache-planned by the coordinator "
+                        f"but its entry is unreadable on this process; "
+                        "multi-process cached sweeps require a shared "
+                        "cache root (like chunk-file resume)"
+                    )
+            qm = ent.get("quarantined")
+            inflight = {
+                "ci": ci, "n_valid": n_valid, "t0": time.time(),
+                "file": chunk_file, "lo": lo, "hi": hi,
+                "host": {f: ent[f] for f in fields},
+                "qmask": (
+                    np.asarray(qm, dtype=bool) if qm is not None
+                    else np.zeros(n_valid, dtype=bool)
+                ),
+                "cached": True,
+                "retries_cached": int(cplan[ci, 1]),
+            }
+            _collect()
+            continue
+
         t_chunk = time.time()
         entry = {
             "ci": ci, "n_valid": n_valid, "t0": t_chunk,
@@ -1390,7 +1678,8 @@ def run_sweep(
                 # host contributes only its local shard of the global chunk
                 pp_chunk = shard_global_chunk(pp_chunk, batch_sharding(mesh))
             with profiler_trace(trace_dir):
-                entry["res"] = step(pp_chunk, aux)
+                step_fn, aux = _ensure_engine()
+                entry["res"] = step_fn(pp_chunk, aux)
                 if not overlap:
                     # serial mode (profiling / esdirk): the device gather
                     # happens inside the trace window — exactly the
@@ -1416,6 +1705,7 @@ def run_sweep(
             entry.pop("res", None)
             entry["host"], entry["qmask"] = _heal_range(
                 ci, lo, hi, dispatch_err, [_heal_budget(hi - lo)],
+                entry.setdefault("retries_paid", [0]),
             )
         if overlap and dispatch_err is None:
             _collect()        # block on chunk k-1 while chunk k computes
@@ -1436,6 +1726,10 @@ def run_sweep(
         n_quad = quad_nodes if quad_on else max(int(n_y), 2000)
     else:  # stiff engines: no y-quadrature
         quad_impl, n_quad = None, None
+    cache_hits = cache_misses = None
+    if store is not None:
+        cache_hits = int(cplan[:, 0].sum())
+        cache_misses = int(((plan[:, 0] == 0) & (cplan[:, 0] == 0)).sum())
     return SweepResult(
         n_points=n_total,
         n_failed=n_failed,
@@ -1448,6 +1742,8 @@ def run_sweep(
         n_quad_nodes=n_quad,
         n_quarantined=n_quarantined,
         n_retries=n_retries,
+        cache_hits=cache_hits,
+        cache_misses=cache_misses,
         outputs=outputs,
         failed_mask=failed_mask,
         quarantined_mask=quarantined_mask,

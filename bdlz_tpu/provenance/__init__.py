@@ -1,0 +1,58 @@
+"""Unified provenance plane: typed identities + content-addressed store.
+
+See docs/provenance.md for the identity-rules table (what joins which
+hash, the exclusion sets, armed-fault semantics) and the store layout.
+"""
+from bdlz_tpu.provenance.identity import (
+    SCHEMA_VERSION,
+    Identity,
+    array_part,
+    bench_leg_identity,
+    code_fingerprint,
+    config_payload,
+    emulator_artifact_identity,
+    mcmc_segment_identity,
+    package_source_fingerprint,
+    refcache_identity,
+    reference_code_fingerprint,
+    static_payload,
+    sweep_chunk_identity,
+    sweep_identity,
+)
+from bdlz_tpu.provenance.registry import (
+    ARTIFACT_KIND,
+    fetch_artifact,
+    publish_artifact,
+)
+from bdlz_tpu.provenance.store import (
+    Store,
+    StoreStats,
+    StoreUntrustedError,
+    default_store_root,
+    resolve_store,
+)
+
+__all__ = [
+    "SCHEMA_VERSION",
+    "Identity",
+    "array_part",
+    "bench_leg_identity",
+    "code_fingerprint",
+    "config_payload",
+    "emulator_artifact_identity",
+    "mcmc_segment_identity",
+    "package_source_fingerprint",
+    "refcache_identity",
+    "reference_code_fingerprint",
+    "static_payload",
+    "sweep_chunk_identity",
+    "sweep_identity",
+    "ARTIFACT_KIND",
+    "fetch_artifact",
+    "publish_artifact",
+    "Store",
+    "StoreStats",
+    "StoreUntrustedError",
+    "default_store_root",
+    "resolve_store",
+]

@@ -1,0 +1,349 @@
+"""Typed content identities — THE hash layer (docs/provenance.md).
+
+Before this package the repo had four hand-rolled content-identity
+systems with subtly different rules:
+
+* the sweep manifest hash (``parallel/sweep.py:grid_hash``) — config
+  through ``config_identity_dict`` (omit-at-default), axes, n_y, engine,
+  conditional ``extra``;
+* the emulator artifact hash (``emulator/artifact.py:artifact_hash``) —
+  a JSON header plus the raw value bytes, field-sorted;
+* the validation refcache key (``validation.reference_ratios_cached``) —
+  population bytes, the robustness-stripped static tuple, n_y, and a
+  fingerprint of the reference path's source;
+* the MCMC segment hash (``sampling/checkpoint.py:_run_hash``) —
+  walkers/seed/steps/identity, which IGNORED the resolved StaticChoices
+  (the PR-7 drift fix: a quadrature-scheme flip could silently resume a
+  trapezoid-era chain).
+
+They now all construct an :class:`Identity` here and digest through one
+primitive.  The legacy digests are BYTE-COMPATIBLE where artifacts
+already exist on disk (sweep manifests, emulator artifacts, refcache
+files keep their hashes — pinned in ``tests/test_provenance.py``); the
+MCMC segment identity is a deliberate, loud schema bump (see
+:func:`mcmc_segment_identity`).
+
+Identity rules, shared by construction:
+
+* **canonical encoding** — JSON parts are ``json.dumps(…,
+  sort_keys=True)``; array parts are contiguous float64 bytes;
+* **omit-at-default** — configs enter through
+  ``config.config_identity_dict`` (reference keys always, extension
+  keys only when non-default), so ADDING a framework field never
+  invalidates pre-existing artifacts;
+* **exclusion sets** — ``ROBUSTNESS_*`` (retry/fault gates),
+  ``SERVE_CONFIG_FIELDS`` (fleet shape), and ``CACHE_CONFIG_FIELDS``
+  (this layer's own knobs) never enter any identity: they are
+  host-side orchestration and cannot change a result bit;
+* **armed-fault inclusion** — an ARMED
+  :class:`~bdlz_tpu.faults.FaultPlan` DOES join identities
+  (``describe()`` payload, plus the absolute chunk window for chunk
+  keys, because injected faults are keyed by chunk index / point
+  index), so chaos-run entries can never collide with clean ones.
+
+The ``kind`` tag namespaces store paths and reports; it is deliberately
+NOT hashed — compatibility with the legacy digests requires byte-equal
+hash input, and the per-kind payload schemas are disjoint anyway.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import Any, Dict, Mapping, NamedTuple, Optional, Sequence, Tuple
+
+import numpy as np  # host-side use only; jitted paths go through the backend.py xp seam (bdlz-lint R1 audit)
+
+#: Bump when the MEANING of a payload schema changes incompatibly; new
+#: payload kinds carry it explicitly where legacy byte-compatibility is
+#: not required (e.g. sweep chunk keys, MCMC v2 segments).
+SCHEMA_VERSION = 1
+
+
+class Identity(NamedTuple):
+    """One content identity: a ``kind`` tag plus an ordered part list.
+
+    ``parts`` is a tuple of ``(tag, value)`` with tag one of
+
+    * ``"json"``  — hashed as canonical (sorted-keys) JSON;
+    * ``"text"``  — hashed as UTF-8 text;
+    * ``"bytes"`` — hashed raw (use :func:`array_part` for arrays).
+
+    The part ORDER is the hash order — identities with the same parts in
+    a different order are different identities by design.
+    """
+
+    kind: str
+    parts: Tuple[Tuple[str, Any], ...]
+
+    def digest(self, n: int = 16) -> str:
+        """First ``n`` hex chars of the SHA-256 over the canonical parts."""
+        h = hashlib.sha256()
+        for tag, value in self.parts:
+            if tag == "json":
+                h.update(json.dumps(value, sort_keys=True).encode())
+            elif tag == "text":
+                h.update(str(value).encode())
+            elif tag == "bytes":
+                h.update(value)
+            else:
+                raise ValueError(f"unknown identity part tag {tag!r}")
+        return h.hexdigest()[:n]
+
+    def describe(self) -> Dict[str, Any]:
+        """Human-oriented summary (payloads verbatim, bytes as lengths)."""
+        out: Dict[str, Any] = {"kind": self.kind, "parts": []}
+        for tag, value in self.parts:
+            if tag == "bytes":
+                out["parts"].append({"tag": tag, "n_bytes": len(value)})
+            else:
+                out["parts"].append({"tag": tag, "value": value})
+        return out
+
+
+def array_part(arr: Any) -> Tuple[str, bytes]:
+    """A ``bytes`` part from an array: contiguous float64, exactly the
+    byte rule every legacy key already used."""
+    return (
+        "bytes",
+        np.ascontiguousarray(np.asarray(arr, dtype=np.float64)).tobytes(),
+    )
+
+
+# ---------------------------------------------------------------------------
+# shared payload builders (the exclusion/omit-at-default rules, one home)
+# ---------------------------------------------------------------------------
+
+def config_payload(base) -> Dict[str, Any]:
+    """The config side of every identity: reference keys always,
+    result-affecting extensions at their resolved values, remaining
+    extensions omit-at-default, robustness/serve/cache knobs excluded
+    (see ``config.config_identity_dict``)."""
+    from bdlz_tpu.config import config_identity_dict
+
+    return config_identity_dict(base)
+
+
+def static_payload(static, *, normalize_quad: bool = False) -> list:
+    """The StaticChoices side: field values in declaration order with the
+    ``ROBUSTNESS_STATIC_FIELDS`` excluded.  ``normalize_quad=True``
+    additionally zeroes the quadrature tri-state out of the tuple — for
+    identities that carry the RESOLVED scheme as a separate key (the
+    emulator artifact's ``quad_panel_gl``)."""
+    from bdlz_tpu.config import ROBUSTNESS_STATIC_FIELDS
+
+    st = static._replace(quad_panel_gl=None) if normalize_quad else static
+    return [
+        v for f, v in zip(type(st)._fields, st)
+        if f not in ROBUSTNESS_STATIC_FIELDS
+    ]
+
+
+# ---------------------------------------------------------------------------
+# the four legacy identities (byte-compatible digests where pinned)
+# ---------------------------------------------------------------------------
+
+def sweep_identity(
+    base,
+    axes: Mapping[str, Sequence[float]],
+    n_y: int,
+    impl: str = "tabulated",
+    extra: Optional[Mapping[str, Any]] = None,
+) -> Identity:
+    """The sweep-directory resume identity (``grid_hash`` payload).
+
+    BYTE-COMPATIBLE with the pre-provenance ``parallel.sweep.grid_hash``
+    — existing sweep directories keep their manifests.  ``extra`` is
+    conditional (an unconditional key, even None, would churn every
+    existing hash)."""
+    payload: Dict[str, Any] = {
+        "base": config_payload(base),
+        "axes": {k: list(map(float, v)) for k, v in axes.items()},
+        "n_y": n_y,
+        "impl": impl,
+    }
+    if extra:
+        payload["extra"] = dict(extra)
+    return Identity("sweep", (("json", payload),))
+
+
+def emulator_artifact_identity(
+    axis_names: Sequence[str],
+    axis_nodes: Sequence[np.ndarray],
+    axis_scales: Sequence[str],
+    values: Mapping[str, np.ndarray],
+    identity: Mapping[str, Any],
+    schema_version: int,
+) -> Identity:
+    """The emulator artifact content identity (``artifact_hash`` payload):
+    JSON header (schema version, axes, scales, physics identity, field
+    list) followed by the field-sorted raw value bytes.  BYTE-COMPATIBLE
+    with the pre-provenance ``emulator.artifact.artifact_hash`` —
+    existing artifacts keep loading."""
+    payload = {
+        "schema_version": int(schema_version),
+        "axes": {
+            str(n): [float(v) for v in np.asarray(nodes)]
+            for n, nodes in zip(axis_names, axis_nodes)
+        },
+        "scales": [str(s) for s in axis_scales],
+        "identity": dict(identity),
+        "fields": sorted(values),
+    }
+    parts: list = [("json", payload)]
+    for name in sorted(values):
+        parts.append(("text", name))
+        parts.append(array_part(values[name]))
+    return Identity("emulator_artifact", tuple(parts))
+
+
+def refcache_identity(grid, static, n_y: "int | None") -> Identity:
+    """The accuracy-gate reference-cache key: population bytes, the
+    robustness-stripped static tuple + n_y, and the reference source
+    fingerprint (a code change to the reference path invalidates every
+    cached truth).  BYTE-COMPATIBLE with the pre-provenance key in
+    ``validation.reference_ratios_cached`` — existing ``ref_*.npy``
+    files keep hitting."""
+    ident = tuple(static_payload(static))
+    parts = [array_part(f) for f in grid]
+    parts.append(("text", repr((ident, n_y))))
+    parts.append(("text", reference_code_fingerprint()))
+    return Identity("refcache", tuple(parts))
+
+
+def mcmc_segment_identity(
+    init_walkers,
+    seed: int,
+    n_steps: int,
+    checkpoint_every: int,
+    a: float,
+    thin: int,
+    identity,
+    static=None,
+) -> Identity:
+    """The checkpointed-chain run identity.
+
+    With ``static=None`` the digest is byte-compatible with the
+    pre-provenance ``checkpoint._run_hash``.  Passing the RESOLVED
+    StaticChoices (what the likelihood actually ran with — quadrature
+    scheme included) is the PR-7 drift fix: the payload gains
+    ``static`` + ``schema: 2``, a LOUD version bump that invalidates
+    every pre-fix chain directory — by design, because those manifests
+    cannot say which scheme sampled them."""
+    payload: Dict[str, Any] = {
+        "init": hashlib.sha256(
+            np.ascontiguousarray(init_walkers).tobytes()
+        ).hexdigest(),
+        "seed": int(seed),
+        "n_steps": int(n_steps),
+        "checkpoint_every": int(checkpoint_every),
+        "a": float(a),
+        "thin": int(thin),
+        # the likelihood's identity: init walkers depend only on
+        # seed/bounds, so without this a resume would silently splice
+        # segments sampled from a *different* posterior
+        "identity": identity,
+    }
+    if static is not None:
+        payload["schema"] = 2
+        payload["static"] = static_payload(static)
+    return Identity("mcmc_segment", (("json", payload),))
+
+
+# ---------------------------------------------------------------------------
+# the new identities (sweep chunk cache, bench legs)
+# ---------------------------------------------------------------------------
+
+def sweep_chunk_identity(
+    core: Mapping[str, Any], pp_slice_arrays: Sequence[np.ndarray]
+) -> Identity:
+    """One sweep chunk's content key: the engine-core payload (see
+    ``parallel.sweep.chunk_cache_key`` — config/static identity, n_y,
+    impl, table nodes, platform, resolved engine extras, and the armed
+    fault window when a plan is live) plus the raw bytes of every
+    PointParams column over the UNPADDED ``[lo:hi)`` slice.
+
+    Axes/grid layout are deliberately NOT part of the key — the yield
+    surface is a pure function of the resolved config and the point
+    values, so an emulator rebuild whose hyperplanes repeat a slice an
+    earlier sweep paid for hits, whatever grid it came from."""
+    parts: list = [("json", dict(core))]
+    parts.extend(array_part(a) for a in pp_slice_arrays)
+    return Identity("sweep_chunk", tuple(parts))
+
+
+def bench_leg_identity(
+    leg: str, context: Mapping[str, Any]
+) -> Identity:
+    """One bench leg's result key: leg name + the measurement context
+    (platform, device count, the BDLZ_* env snapshot, and a source
+    fingerprint so a code change re-measures everything)."""
+    return Identity(
+        "bench_leg",
+        (("json", {"schema": SCHEMA_VERSION, "leg": str(leg),
+                   "context": dict(context)}),),
+    )
+
+
+# ---------------------------------------------------------------------------
+# source fingerprints
+# ---------------------------------------------------------------------------
+
+def code_fingerprint(modules: Sequence[Any]) -> str:
+    """Hash of the given modules' source text (16 hex chars)."""
+    import inspect
+
+    h = hashlib.sha256()
+    for mod in modules:
+        h.update(inspect.getsource(mod).encode())
+    return h.hexdigest()[:16]
+
+
+def reference_code_fingerprint() -> str:
+    """Hash of the source of every module the NumPy reference path runs.
+
+    Cache keys must invalidate when the reference implementation itself
+    changes — a stale cached "reference" would make the accuracy gate
+    compare an engine against an older version of the truth.  The module
+    list (and therefore the fingerprint) is byte-compatible with the
+    pre-provenance ``validation._reference_code_fingerprint``.
+    """
+    import bdlz_tpu.constants
+    import bdlz_tpu.models.yields_pipeline
+    import bdlz_tpu.ops.kjma_table
+    import bdlz_tpu.physics.percolation
+    import bdlz_tpu.physics.source
+    import bdlz_tpu.physics.thermo
+    import bdlz_tpu.solvers.panels
+    import bdlz_tpu.solvers.quadrature
+
+    return code_fingerprint((
+        bdlz_tpu.constants, bdlz_tpu.models.yields_pipeline,
+        bdlz_tpu.ops.kjma_table, bdlz_tpu.physics.percolation,
+        bdlz_tpu.physics.source, bdlz_tpu.physics.thermo,
+        bdlz_tpu.solvers.panels, bdlz_tpu.solvers.quadrature,
+    ))
+
+
+def package_source_fingerprint(*extra_paths: str) -> str:
+    """Hash of every ``*.py`` file under the installed ``bdlz_tpu``
+    package (plus any ``extra_paths`` files), for identities that must
+    go stale on ANY code change — the bench-leg cache: a cached CPU
+    metric from an older build is not evidence for this one."""
+    import os
+
+    import bdlz_tpu
+
+    h = hashlib.sha256()
+    pkg_root = os.path.dirname(os.path.abspath(bdlz_tpu.__file__))
+    files = []
+    for dirpath, _dirnames, filenames in os.walk(pkg_root):
+        for fn in filenames:
+            if fn.endswith(".py"):
+                files.append(os.path.join(dirpath, fn))
+    files.sort()
+    files.extend(p for p in extra_paths if os.path.exists(p))
+    for path in files:
+        h.update(os.path.relpath(path, pkg_root).encode())
+        with open(path, "rb") as f:
+            h.update(f.read())
+    return h.hexdigest()[:16]

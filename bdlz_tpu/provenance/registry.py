@@ -1,0 +1,106 @@
+"""Content-addressed emulator-artifact registry (docs/provenance.md).
+
+The serving tier's rollout story (``serve/rollout.py``) needs a way to
+move artifact builds between hosts that is as tamper-evident as the
+artifacts themselves: a build host PUBLISHES an artifact into the shared
+store under its content hash, and every serving host STAGES it by hash —
+the fetch re-verifies the full PR-3 validation chain (schema version,
+content hash, finite/positive tables) plus that the entry actually IS
+the requested hash, so a registry entry can never impersonate another
+build.
+
+Entries are directories ``<root>/emulator_artifact/<hash>/`` holding the
+standard ``artifact.npz`` + ``manifest.json`` pair (written by
+``emulator.artifact.save_artifact``).  Publication is atomic: the pair
+is written into a temp directory in the store root and renamed into
+place; a loser of a publish race simply discards its temp copy — the
+content under a hash is identical by construction.  A corrupt entry is
+deleted on fetch (one re-publish, never a poisoned stage).
+"""
+from __future__ import annotations
+
+import os
+import shutil
+import sys
+import tempfile
+
+from bdlz_tpu.provenance.store import Store
+
+ARTIFACT_KIND = "emulator_artifact"
+
+
+def publish_artifact(store: Store, artifact) -> str:
+    """Publish an :class:`~bdlz_tpu.emulator.artifact.EmulatorArtifact`
+    (or an artifact directory path) into ``store``; returns the content
+    hash it is addressable by."""
+    from bdlz_tpu.emulator.artifact import (
+        EmulatorArtifact,
+        load_artifact,
+        save_artifact,
+    )
+
+    if not isinstance(artifact, EmulatorArtifact):
+        artifact = load_artifact(str(artifact))
+    content_hash = artifact.content_hash
+    dest = os.path.join(store.root, ARTIFACT_KIND, content_hash)
+    os.makedirs(os.path.join(store.root, ARTIFACT_KIND), mode=0o700,
+                exist_ok=True)
+    if os.path.isdir(dest):
+        store.stats.hits += 1
+        return content_hash  # same hash = same bytes; nothing to do
+    tmp = tempfile.mkdtemp(dir=store.root, suffix=".tmp")
+    try:
+        save_artifact(tmp, artifact)
+        try:
+            os.rename(tmp, dest)
+        except OSError:
+            shutil.rmtree(tmp, ignore_errors=True)
+            # benign ONLY if a concurrent publisher won the rename
+            # (identical content under the same hash); any other rename
+            # failure must surface — returning a hash that was never
+            # published would strand every later fetch
+            if not os.path.isdir(dest):
+                raise
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+    store.stats.writes += 1
+    return content_hash
+
+
+def fetch_artifact(store: Store, content_hash: str):
+    """Load + fully validate the published artifact ``content_hash``.
+
+    Raises :class:`~bdlz_tpu.emulator.artifact.EmulatorArtifactError`
+    when the entry is absent, fails any load-time validation, or its
+    verified hash is not the requested one (an impersonating or
+    renamed entry); a corrupt entry is deleted first, so the next
+    publish starts clean."""
+    from bdlz_tpu.emulator.artifact import EmulatorArtifactError, load_artifact
+
+    path = os.path.join(store.root, ARTIFACT_KIND, str(content_hash))
+    if not os.path.isdir(path):
+        store.stats.misses += 1
+        raise EmulatorArtifactError(
+            f"no published emulator artifact {content_hash!r} in store "
+            f"{store.root}"
+        )
+    try:
+        artifact = load_artifact(path)
+    except EmulatorArtifactError:
+        print(
+            f"[registry] published artifact entry {path} failed validation; "
+            "deleting the corrupt entry",
+            file=sys.stderr,
+        )
+        shutil.rmtree(path, ignore_errors=True)
+        store.stats.dropped_corrupt += 1
+        raise
+    if artifact.content_hash != str(content_hash):
+        raise EmulatorArtifactError(
+            f"registry entry {path} verifies as {artifact.content_hash!r}, "
+            f"not the requested {content_hash!r}: refusing the impersonating "
+            "entry"
+        )
+    store.stats.hits += 1
+    return artifact

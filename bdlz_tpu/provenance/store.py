@@ -1,0 +1,285 @@
+"""Hardened content-addressed store (docs/provenance.md).
+
+One on-disk store for every cached/reusable result in the repo: sweep
+chunk results, accuracy-gate references, bench-leg results, published
+emulator artifacts.  Entries are named by their content key (an
+:class:`~bdlz_tpu.provenance.identity.Identity` digest), optionally
+namespaced one directory level deep by kind (``sweep_chunk/<key>.npz``).
+
+Trust and durability rules, inherited from the two places that already
+learned them the hard way (``validation.py``'s refcache and the
+atomic-write primitives in ``utils/io.py``):
+
+* the root is created ``0700`` and trusted only if it is a REAL
+  directory (``lstat`` — a symlink is refused outright, it could point
+  anywhere), owned by this uid, and not group/other-writable — cached
+  entries substitute for recomputed truth, so any path another local
+  user could write is poison (:class:`StoreUntrustedError`);
+* every write is ``mkstemp`` + ``os.replace`` in the FINAL directory —
+  concurrent readers see either the old complete entry or the new one,
+  never half a write, and two writers racing the same key are
+  last-writer-wins on (identical) content;
+* a corrupt entry is deleted and reported as a miss — one recompute,
+  never a crash, and the poisoned file is gone so the next hit is
+  clean;
+* stale ``*.tmp*`` droppings from writers that died mid-``mkstemp``
+  are evicted by age (:meth:`Store.evict_partials`) — recent ones are
+  left alone, they may belong to a live concurrent writer.
+
+The store never interprets entry contents; identity construction (what
+joins which key) lives in :mod:`bdlz_tpu.provenance.identity`.
+"""
+from __future__ import annotations
+
+import json
+import os
+import stat as statmod
+import sys
+import time
+from typing import Any, Dict, Mapping, Optional
+
+import numpy as np  # host-side IO only; jitted paths go through the backend.py xp seam (bdlz-lint R1 audit)
+
+_NAME_OK = frozenset(
+    "abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789._-"
+)
+
+
+class StoreUntrustedError(RuntimeError):
+    """The store root cannot be trusted (symlink, foreign owner, loose
+    permissions, not a directory).  Typed so callers can degrade to
+    caching-disabled LOUDLY instead of trusting a poisoned path."""
+
+
+class StoreStats:
+    """Per-instance hit/miss/write counters (mirrored into bench lines)."""
+
+    def __init__(self) -> None:
+        self.hits = 0
+        self.misses = 0
+        self.writes = 0
+        self.dropped_corrupt = 0
+
+    def as_dict(self) -> Dict[str, int]:
+        return {
+            "hits": self.hits,
+            "misses": self.misses,
+            "writes": self.writes,
+            "dropped_corrupt": self.dropped_corrupt,
+        }
+
+
+class Store:
+    """A hardened flat/one-level content-addressed file store."""
+
+    def __init__(self, root: str):
+        root = os.path.abspath(os.path.expanduser(str(root)))
+        os.makedirs(root, mode=0o700, exist_ok=True)
+        st = os.lstat(root)
+        if statmod.S_ISLNK(st.st_mode):
+            raise StoreUntrustedError(f"{root} is a symlink")
+        if not statmod.S_ISDIR(st.st_mode):
+            raise StoreUntrustedError(f"{root} is not a directory")
+        if st.st_uid != os.getuid():
+            raise StoreUntrustedError(
+                f"{root} is owned by uid {st.st_uid}, not {os.getuid()}"
+            )
+        if st.st_mode & 0o022:
+            raise StoreUntrustedError(
+                f"{root} is group/other-writable "
+                f"(mode {statmod.S_IMODE(st.st_mode):04o})"
+            )
+        self.root = root
+        self.stats = StoreStats()
+
+    # ---- paths -------------------------------------------------------
+
+    def path_for(self, name: str) -> str:
+        """Absolute path of entry ``name`` (``[kind/]filename``); creates
+        the one allowed kind subdirectory (0700) on demand."""
+        parts = str(name).split("/")
+        if (
+            not 1 <= len(parts) <= 2
+            or any(not p or p.startswith(".") for p in parts)
+            or any(set(p) - _NAME_OK for p in parts)
+        ):
+            raise ValueError(
+                f"invalid store entry name {name!r}: expected "
+                "'[kind/]filename' from [A-Za-z0-9._-], no leading dots"
+            )
+        if len(parts) == 2:
+            os.makedirs(
+                os.path.join(self.root, parts[0]), mode=0o700, exist_ok=True
+            )
+        return os.path.join(self.root, *parts)
+
+    def has(self, name: str) -> bool:
+        """Existence probe without a read (and without counter effects)."""
+        return os.path.exists(self.path_for(name))
+
+    def _drop_corrupt(self, path: str, exc: Exception) -> None:
+        # a torn write or disk corruption must cost one recompute, not
+        # the caller's run — and the poisoned file must go, or every
+        # future hit re-pays this branch
+        print(
+            f"[store] {path} is corrupt ({exc!r}); deleting and recomputing",
+            file=sys.stderr,
+        )
+        self.stats.dropped_corrupt += 1
+        try:
+            os.remove(path)
+        except OSError:
+            pass
+
+    # ---- typed entries ----------------------------------------------
+
+    def get_array(self, name: str) -> Optional[np.ndarray]:
+        path = self.path_for(name)
+        if not os.path.exists(path):
+            self.stats.misses += 1
+            return None
+        try:
+            out = np.load(path)
+        except Exception as exc:  # noqa: BLE001 — corrupt entry = miss
+            self._drop_corrupt(path, exc)
+            self.stats.misses += 1
+            return None
+        self.stats.hits += 1
+        return out
+
+    def put_array(self, name: str, arr: np.ndarray) -> str:
+        from bdlz_tpu.utils.io import atomic_save_npy
+
+        path = self.path_for(name)
+        atomic_save_npy(path, np.asarray(arr))
+        self.stats.writes += 1
+        return path
+
+    def get_npz(self, name: str) -> Optional[Dict[str, np.ndarray]]:
+        """Load every array of an ``.npz`` entry into host memory."""
+        path = self.path_for(name)
+        if not os.path.exists(path):
+            self.stats.misses += 1
+            return None
+        try:
+            with np.load(path) as data:
+                out = {k: np.asarray(data[k]) for k in data.files}
+        except Exception as exc:  # noqa: BLE001 — corrupt entry = miss
+            self._drop_corrupt(path, exc)
+            self.stats.misses += 1
+            return None
+        self.stats.hits += 1
+        return out
+
+    def put_npz(self, name: str, arrays: Mapping[str, np.ndarray]) -> str:
+        from bdlz_tpu.utils.io import atomic_savez
+
+        path = self.path_for(name)
+        atomic_savez(path, **dict(arrays))
+        self.stats.writes += 1
+        return path
+
+    def get_json(self, name: str) -> Optional[Any]:
+        path = self.path_for(name)
+        if not os.path.exists(path):
+            self.stats.misses += 1
+            return None
+        try:
+            with open(path, encoding="utf-8") as f:
+                out = json.load(f)
+        except Exception as exc:  # noqa: BLE001 — corrupt entry = miss
+            self._drop_corrupt(path, exc)
+            self.stats.misses += 1
+            return None
+        self.stats.hits += 1
+        return out
+
+    def put_json(self, name: str, payload: Any) -> str:
+        from bdlz_tpu.utils.io import atomic_write_json
+
+        path = self.path_for(name)
+        atomic_write_json(path, payload)
+        self.stats.writes += 1
+        return path
+
+    # ---- maintenance -------------------------------------------------
+
+    def evict_partials(self, max_age_s: float = 3600.0) -> int:
+        """Remove ``*.tmp*`` droppings older than ``max_age_s`` — temp
+        FILES from writers that died between ``mkstemp`` and
+        ``os.replace``, and temp DIRECTORIES from artifact publishers
+        that died before their rename (``registry.publish_artifact``).
+        Young temp entries are left alone — they may belong to a live
+        writer racing this process.  Returns the number evicted."""
+        import shutil
+
+        now = time.time()
+        evicted = 0
+        for dirpath, dirnames, filenames in os.walk(self.root):
+            for dn in list(dirnames):
+                if ".tmp" not in dn:
+                    continue
+                path = os.path.join(dirpath, dn)
+                try:
+                    if now - os.lstat(path).st_mtime >= max_age_s:
+                        shutil.rmtree(path, ignore_errors=True)
+                        evicted += 1
+                        dirnames.remove(dn)  # do not descend into it
+                except OSError:
+                    pass  # raced another evictor/publisher; fine
+            for fn in filenames:
+                if ".tmp" not in fn:
+                    continue
+                path = os.path.join(dirpath, fn)
+                try:
+                    if now - os.lstat(path).st_mtime >= max_age_s:
+                        os.remove(path)
+                        evicted += 1
+                except OSError:
+                    pass  # raced another evictor/writer; fine
+        return evicted
+
+
+def default_store_root() -> str:
+    """``$XDG_CACHE_HOME``/``~/.cache`` + ``bdlz_store`` — the user's
+    cache root, NOT the world-writable system temp dir (the refcache
+    lesson, ADVICE r5)."""
+    cache_root = os.environ.get(
+        "XDG_CACHE_HOME", os.path.join(os.path.expanduser("~"), ".cache")
+    )
+    return os.path.join(cache_root, "bdlz_store")
+
+
+def resolve_store(cache=None, base=None, label: str = "cache") -> Optional[Store]:
+    """THE tri-state resolver for the result cache (``ode_*`` pattern).
+
+    ``cache`` is an explicit :class:`Store`, a root path, or None.
+    Resolution: explicit store ▸ explicit path ▸ ``Config.cache_root`` ▸
+    ``BDLZ_CACHE_ROOT`` env.  The ``Config.cache_enabled`` tri-state
+    gates it: ``False`` forces caching off (even with an explicit
+    store), ``True`` turns it on at the default root
+    (:func:`default_store_root`) when no root is configured, and
+    ``None`` — the default — enables caching exactly when a root IS
+    configured (the ``fault_injection`` pattern: a knob nobody set
+    changes nothing).  An untrusted root degrades to caching-disabled
+    LOUDLY, never to trusting it.
+    """
+    enabled = getattr(base, "cache_enabled", None) if base is not None else None
+    if enabled is False:
+        return None
+    if isinstance(cache, Store):
+        return cache
+    root = cache if isinstance(cache, str) and cache else None
+    if root is None and base is not None:
+        root = getattr(base, "cache_root", None) or None
+    if root is None:
+        root = os.environ.get("BDLZ_CACHE_ROOT") or None
+    if root is None:
+        if enabled is not True:
+            return None
+        root = default_store_root()
+    try:
+        return Store(root)
+    except StoreUntrustedError as exc:
+        print(f"[{label}] {exc}; caching disabled", file=sys.stderr)
+        return None

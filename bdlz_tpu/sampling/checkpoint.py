@@ -19,7 +19,6 @@ mirrors the sweep engine's chunk+manifest scheme (`parallel/sweep.py`):
 """
 from __future__ import annotations
 
-import hashlib
 import json
 import os
 from typing import Callable, NamedTuple
@@ -46,21 +45,27 @@ class CheckpointedRun(NamedTuple):
 
 
 def _run_hash(init_walkers, seed: int, n_steps: int, checkpoint_every: int,
-              a: float, thin: int, identity) -> str:
-    payload = {
-        "init": hashlib.sha256(np.ascontiguousarray(init_walkers).tobytes()).hexdigest(),
-        "seed": int(seed),
-        "n_steps": int(n_steps),
-        "checkpoint_every": int(checkpoint_every),
-        "a": float(a),
-        "thin": int(thin),
-        # the likelihood's identity: init walkers depend only on
-        # seed/bounds, so without this a resume would silently splice
-        # segments sampled from a *different* posterior (e.g. the same
-        # --param bounds over a changed physics config)
-        "identity": identity,
-    }
-    return hashlib.sha256(json.dumps(payload, sort_keys=True).encode()).hexdigest()[:16]
+              a: float, thin: int, identity, static=None) -> str:
+    """Run identity through the shared provenance layer.
+
+    ``identity`` fingerprints the posterior (init walkers depend only on
+    seed/bounds, so without it a resume would silently splice segments
+    sampled from a *different* posterior).  ``static``, when given, is
+    the RESOLVED StaticChoices the likelihood actually evaluates with —
+    the PR-7 drift fix: the old hash ignored it, so a ``quad_panel_gl``
+    (or any tri-state engine knob) flip could silently resume a
+    trapezoid-era chain.  Passing it is a LOUD schema bump
+    (provenance.mcmc_segment_identity adds ``schema: 2``): pre-fix
+    chain directories invalidate and recompute, because their manifests
+    cannot say which scheme sampled them.  With ``static=None`` the
+    digest stays byte-compatible with the legacy hash.
+    """
+    from bdlz_tpu.provenance import mcmc_segment_identity
+
+    return mcmc_segment_identity(
+        init_walkers, seed, n_steps, checkpoint_every, a, thin, identity,
+        static=static,
+    ).digest(16)
 
 
 def run_ensemble_checkpointed(
@@ -75,6 +80,7 @@ def run_ensemble_checkpointed(
     mesh=None,
     event_log=None,
     identity=None,
+    static=None,
 ) -> CheckpointedRun:
     """Run (or resume) a checkpointed ensemble chain in ``out_dir``.
 
@@ -87,6 +93,13 @@ def run_ensemble_checkpointed(
     — e.g. the config dict plus sampled-parameter spec): the manifest is
     invalidated when it changes, because stored segments are samples *of
     that posterior* and must never be spliced into a different one.
+
+    ``static`` should be the RESOLVED StaticChoices the likelihood runs
+    with (tri-state engine knobs resolved to what actually executes —
+    see ``mcmc_cli``): it joins the run identity through the provenance
+    layer, so a resolved-scheme change (e.g. a ``quad_panel_gl`` flip)
+    invalidates resume instead of silently splicing chains sampled
+    under two different quadratures.
     """
     import jax
     import jax.numpy as jnp
@@ -106,7 +119,8 @@ def run_ensemble_checkpointed(
 
     os.makedirs(out_dir, exist_ok=True)
     manifest_path = os.path.join(out_dir, "manifest.json")
-    h = _run_hash(init_walkers, seed, n_steps, checkpoint_every, a, thin, identity)
+    h = _run_hash(init_walkers, seed, n_steps, checkpoint_every, a, thin,
+                  identity, static=static)
 
     # Resume plan: the COORDINATOR reads the manifest, validates the
     # longest loadable segment prefix, and broadcasts the count (same
@@ -127,7 +141,22 @@ def run_ensemble_checkpointed(
                     manifest = json.load(f)
             except Exception:
                 manifest = {}
-            if manifest.get("hash") != h:
+            if manifest.get("hash") not in (None, h):
+                # loud invalidation: a stale identity (changed posterior,
+                # changed resolved static — e.g. a quadrature-scheme
+                # flip, or the schema-2 bump itself) must never be
+                # silently spliced; say why nothing resumes
+                import sys
+
+                print(
+                    f"[mcmc] resume: {out_dir} was checkpointed under a "
+                    f"different run identity ({manifest.get('hash')} != "
+                    f"{h}: changed config/params/resolved static or a "
+                    "pre-static-identity chain); recomputing from scratch",
+                    file=sys.stderr,
+                )
+                manifest = {}
+            elif manifest.get("hash") != h:
                 manifest = {}
         done = set(int(i) for i in manifest.get("done", []))
         for k in range(n_segs):

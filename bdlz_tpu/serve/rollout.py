@@ -65,8 +65,16 @@ class ArtifactRollout:
     state; it only ever sees an atomic replica-set swap.
     """
 
-    def __init__(self, service: FleetService):
+    def __init__(self, service: FleetService, store=None):
+        from bdlz_tpu.provenance import resolve_store
+
         self.service = service
+        #: Optional provenance store (docs/provenance.md): when set, a
+        #: bare content hash can be staged directly — the artifact is
+        #: fetched from the shared registry with the full validation
+        #: chain (schema/content-hash/identity) re-verified, which is
+        #: how a serving fleet adopts a build another host published.
+        self.store = resolve_store(store, label="rollout")
         self._staged: Optional[ReplicaSet] = None
         #: The replica set retired by the last cutover (rollback seam).
         self.previous: Optional[ReplicaSet] = None
@@ -90,13 +98,25 @@ class ArtifactRollout:
     def stage(self, artifact, warm: bool = True) -> str:
         """Load/validate artifact N+1 and build its replicas beside N.
 
-        ``artifact`` is an :class:`EmulatorArtifact` or a directory path
-        (loaded with full validation).  Identity skew — physics the
+        ``artifact`` is an :class:`EmulatorArtifact`, a directory path
+        (loaded with full validation), or — when the rollout was
+        constructed with a ``store`` — a bare 16-hex content hash, which
+        is fetched from the provenance registry
+        (:func:`bdlz_tpu.provenance.fetch_artifact`: the entry must
+        verify as exactly that hash).  Identity skew — physics the
         service's exact fallback was not built for — raises
         ``EmulatorArtifactError`` here, loudly, before a single replica
         exists.  Re-staging replaces any previous stage.  Returns the
         staged content hash.
         """
+        if (
+            isinstance(artifact, str)
+            and self.store is not None
+            and _looks_like_content_hash(artifact)
+        ):
+            from bdlz_tpu.provenance import fetch_artifact
+
+            artifact = fetch_artifact(self.store, artifact)
         if not isinstance(artifact, EmulatorArtifact):
             artifact = load_artifact(str(artifact))
         # the PR-3 identity check: N+1 must be valid for the SAME
@@ -146,6 +166,19 @@ class ArtifactRollout:
         self._staged = None
         self.previous = old
         return old.artifact_hash, staged.artifact_hash
+
+
+def _looks_like_content_hash(s: str) -> bool:
+    """A 16-hex artifact content hash (vs a filesystem path).  A path
+    that happens to exist always wins — an operator staging a directory
+    literally named like a hash should get the directory."""
+    import os
+
+    return (
+        len(s) == 16
+        and all(c in "0123456789abcdef" for c in s)
+        and not os.path.exists(s)
+    )
 
 
 def _agree_cutover(staged_hash: str, warmed: bool) -> None:

@@ -76,6 +76,29 @@ def atomic_savez(path: str, **arrays: Any) -> None:
         raise
 
 
+def atomic_save_npy(path: str, arr: Any) -> None:
+    """``np.save`` with the mkstemp + ``os.replace`` atomicity of its
+    siblings above — the single-array primitive behind the provenance
+    store and the accuracy-gate reference cache.  Writing through the
+    open file descriptor sidesteps ``np.save``'s append-``.npy`` suffix
+    rule, so the rename target is exactly ``path``.
+    """
+    import numpy as np  # host-side IO only (bdlz-lint R1 audit)
+
+    d = os.path.dirname(os.path.abspath(path))
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp.npy")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.save(f, arr)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
+
+
 def _scalar(v: Any) -> Any:
     """Coerce numpy/jax scalars to plain Python types for JSON."""
     if hasattr(v, "item"):

@@ -447,36 +447,6 @@ def engine_population_max_rel(
     return population_max_rel(run_pop, chunk_pop, ref)
 
 
-def _reference_code_fingerprint() -> str:
-    """Hash of the source of every module the NumPy reference path runs.
-
-    Cache keys must invalidate when the reference implementation itself
-    changes — a stale cached "reference" would make the accuracy gate
-    compare an engine against an older version of the truth.
-    """
-    import hashlib
-    import inspect
-
-    import bdlz_tpu.constants
-    import bdlz_tpu.models.yields_pipeline
-    import bdlz_tpu.ops.kjma_table
-    import bdlz_tpu.physics.percolation
-    import bdlz_tpu.physics.source
-    import bdlz_tpu.physics.thermo
-    import bdlz_tpu.solvers.panels
-    import bdlz_tpu.solvers.quadrature
-
-    h = hashlib.sha256()
-    for mod in (
-        bdlz_tpu.constants, bdlz_tpu.models.yields_pipeline,
-        bdlz_tpu.ops.kjma_table, bdlz_tpu.physics.percolation,
-        bdlz_tpu.physics.source, bdlz_tpu.physics.thermo,
-        bdlz_tpu.solvers.panels, bdlz_tpu.solvers.quadrature,
-    ):
-        h.update(inspect.getsource(mod).encode())
-    return h.hexdigest()[:16]
-
-
 def reference_ratios_cached(
     grid, static, n_y: "int | None" = None, cache_dir: "str | None" = None,
     stats: "dict | None" = None,
@@ -492,23 +462,31 @@ def reference_ratios_cached(
     path's source (a code change invalidates the cache).  Set
     ``BDLZ_REF_CACHE_DIR=''`` to disable.
 
-    The default directory lives under the user's cache root
+    The cache rides the hardened provenance store
+    (:class:`bdlz_tpu.provenance.Store` — docs/provenance.md): the
+    default directory lives under the user's cache root
     (``$XDG_CACHE_HOME`` or ``~/.cache`` — NOT the world-writable system
-    temp dir), is created 0700, and a pre-existing directory is trusted
-    only if it is a real directory (``lstat`` — a symlink is refused
-    outright, it could point anywhere), owned by this uid, and not
-    group/other-writable — the cache IS the accuracy gate's ground
-    truth, so any path another local user could write substitutes the
-    truth (ADVICE r5).  A corrupt cached file is deleted and recomputed
-    instead of crashing the gate.  ``stats``, when given, records
-    ``{"cache_hit": bool}`` so evidence artifacts can stamp whether
-    their reference timing measured a recompute or a disk read.
+    temp dir), is created 0700, and is trusted only if it is a real
+    non-symlink directory owned by this uid and not group/other-writable
+    — the cache IS the accuracy gate's ground truth, so any path another
+    local user could write substitutes the truth (ADVICE r5).  A corrupt
+    cached file is deleted and recomputed instead of crashing the gate;
+    writes are atomic.  The key
+    (:func:`bdlz_tpu.provenance.refcache_identity` — population bytes,
+    robustness-stripped static, n_y, reference source fingerprint) and
+    the ``ref_*.npy`` layout are byte-compatible with the pre-provenance
+    cache, so existing directories keep hitting.  ``stats``, when given,
+    records ``{"cache_hit": bool}`` so evidence artifacts can stamp
+    whether their reference timing measured a recompute or a disk read.
     """
-    import hashlib
     import os
-    import stat as statmod
     import sys
-    import tempfile
+
+    from bdlz_tpu.provenance import (
+        Store,
+        StoreUntrustedError,
+        refcache_identity,
+    )
 
     if cache_dir is None:
         cache_root = os.environ.get(
@@ -522,63 +500,22 @@ def reference_ratios_cached(
         stats["cache_hit"] = False
     if not cache_dir:
         return reference_ratios(grid, static, n_y=n_y)
-
-    def _refuse(why: str):
-        print(f"[refcache] {cache_dir} {why}; refusing to trust it "
-              "(caching disabled)", file=sys.stderr)
+    try:
+        store = Store(cache_dir)
+    except StoreUntrustedError as exc:
+        print(f"[refcache] {exc}; refusing to trust it (caching disabled)",
+              file=sys.stderr)
         return reference_ratios(grid, static, n_y=n_y)
 
-    os.makedirs(cache_dir, mode=0o700, exist_ok=True)
-    st = os.lstat(cache_dir)
-    if statmod.S_ISLNK(st.st_mode):
-        return _refuse("is a symlink")
-    if not statmod.S_ISDIR(st.st_mode):
-        return _refuse("is not a directory")
-    if st.st_uid != os.getuid():
-        return _refuse(f"is owned by uid {st.st_uid}, not {os.getuid()}")
-    if st.st_mode & 0o022:
-        return _refuse(
-            f"is group/other-writable (mode {statmod.S_IMODE(st.st_mode):04o})"
-        )
-    h = hashlib.sha256()
-    for f in grid:
-        h.update(np.ascontiguousarray(np.asarray(f, dtype=np.float64)).tobytes())
-    # robustness knobs are orchestration-only (cannot change reference
-    # values) and are stripped so their addition/toggling never churns
-    # the cache key
-    from bdlz_tpu.config import ROBUSTNESS_STATIC_FIELDS
-
-    ident = tuple(
-        v for f, v in zip(type(static)._fields, static)
-        if f not in ROBUSTNESS_STATIC_FIELDS
-    )
-    h.update(repr((ident, n_y)).encode())
-    h.update(_reference_code_fingerprint().encode())
-    path = os.path.join(cache_dir, f"ref_{h.hexdigest()[:24]}.npy")
+    name = f"ref_{refcache_identity(grid, static, n_y).digest(24)}.npy"
     n = int(np.asarray(grid.m_chi_GeV).shape[0])
-    if os.path.exists(path):
-        try:
-            out = np.load(path)
-        except Exception as exc:
-            # a torn write or disk corruption must cost one recompute,
-            # not the whole gate run (ADVICE r5) — and the poisoned file
-            # must go, or every future hit re-pays this branch
-            print(f"[refcache] {path} is corrupt ({exc!r}); deleting and "
-                  "recomputing", file=sys.stderr)
-            try:
-                os.remove(path)
-            except OSError:
-                pass
-        else:
-            if out.shape == (n,):
-                if stats is not None:
-                    stats["cache_hit"] = True
-                return out
+    out = store.get_array(name)
+    if out is not None and out.shape == (n,):
+        if stats is not None:
+            stats["cache_hit"] = True
+        return out
     out = reference_ratios(grid, static, n_y=n_y)
-    fd, tmp = tempfile.mkstemp(dir=cache_dir, suffix=".npy")
-    os.close(fd)
-    np.save(tmp, out)
-    os.replace(tmp, path)  # atomic: concurrent tools never read half a file
+    store.put_array(name, out)
     return out
 
 

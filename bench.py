@@ -149,6 +149,103 @@ def main(argv=None) -> None:
     devices = jax.devices()
     n_dev = len(devices)
 
+    # ---- bench-leg result cache (docs/provenance.md, opportunistic
+    # benching) -------------------------------------------------------
+    # On a tpu_unavailable round every leg is a flagged CPU number —
+    # deterministic per (code, BDLZ_* knobs, platform) and worth many
+    # minutes per round (BENCH_r03–r05 re-paid the full CPU suite after
+    # every relay death).  Those legs are keyed by provenance identity
+    # (bench_leg_identity: leg name + env snapshot + a source
+    # fingerprint, so ANY code change re-measures) and replayed with
+    # ``"cached": true`` on each reused metric line; when the relay
+    # returns, the round runs on hardware and never consults the cache
+    # — only the CPU legs are reused, only while they are still
+    # evidence for this exact build.  BDLZ_BENCH_LEG_CACHE=0 disables.
+    _capture_stack: list = []
+
+    def emit(payload) -> None:
+        """Print one metric JSON line (and record it for leg caching)."""
+        print(json.dumps(payload))
+        for buf in _capture_stack:
+            buf.append(payload)
+
+    leg_store = None
+    leg_ctx = None
+    _leg_cache_on = (
+        tpu_unavailable and os.environ.get("BDLZ_BENCH_LEG_CACHE", "1") != "0"
+    ) or os.environ.get("BDLZ_BENCH_LEG_CACHE") == "force"  # tests only
+    if _leg_cache_on:
+        from bdlz_tpu.provenance import (
+            Store,
+            StoreUntrustedError,
+            default_store_root,
+            package_source_fingerprint,
+        )
+
+        try:
+            leg_store = Store(
+                os.environ.get("BDLZ_CACHE_ROOT") or default_store_root()
+            )
+        except StoreUntrustedError as exc:
+            print(f"[bench] leg cache disabled: {exc}", file=sys.stderr)
+        if leg_store is not None:
+            leg_ctx = {
+                "platform": jax.devices()[0].platform,
+                "n_dev": n_dev,
+                "env": {
+                    k: v for k, v in sorted(os.environ.items())
+                    if k.startswith("BDLZ_") and k != "BDLZ_CACHE_ROOT"
+                },
+                "fingerprint": package_source_fingerprint(
+                    os.path.abspath(__file__)
+                ),
+            }
+
+    def _leg_entry_name(leg: str) -> str:
+        from bdlz_tpu.provenance import bench_leg_identity
+
+        return f"bench_leg/{bench_leg_identity(leg, leg_ctx).digest(24)}.json"
+
+    def leg_lookup(leg: str):
+        """Replay a cached leg's metric lines (``cached: true``); the
+        stored ``{"lines", "summary"}`` entry, or None on miss."""
+        if leg_store is None:
+            return None
+        ent = leg_store.get_json(_leg_entry_name(leg))
+        if not isinstance(ent, dict) or "lines" not in ent:
+            return None
+        print(
+            f"[bench] {leg}: reusing the cached CPU measurement (relay "
+            "down; a code or BDLZ_* knob change re-measures)",
+            file=sys.stderr,
+        )
+        for line in ent["lines"]:
+            emit({**line, "cached": True})
+        return ent
+
+    def leg_record(leg: str, lines, summary) -> None:
+        if leg_store is not None:
+            leg_store.put_json(
+                _leg_entry_name(leg), {"lines": lines, "summary": summary}
+            )
+
+    def run_leg(leg: str, fn):
+        """One cacheable bench leg: replay on hit; capture, run, and
+        record on miss.  A leg that raises is never recorded (it should
+        re-attempt next round), and the exception propagates to the
+        caller's best-effort handler."""
+        hit = leg_lookup(leg)
+        if hit is not None:
+            return hit.get("summary")
+        buf: list = []
+        _capture_stack.append(buf)
+        try:
+            summary = fn()
+        finally:
+            _capture_stack.pop()
+        leg_record(leg, buf, summary)
+        return summary
+
     base = config_from_dict(
         {
             "regime": "nonthermal",
@@ -345,74 +442,104 @@ def main(argv=None) -> None:
     # fast path on real TPU hardware; fall back to the pure-XLA tabulated
     # path if it fails to compile/run or misses the 1e-6 contract.
     default_impl = "pallas" if jax.devices()[0].platform != "cpu" else "tabulated"
-    impl = os.environ.get("BDLZ_BENCH_IMPL", default_impl)
-    run_chunk = None
-    preflight = None
-    pallas_reduce = None  # the tier actually benched (for the JSON)
-    if impl == "pallas":
-        # Tier selection through the SHARED resolver
-        # (bdlz_tpu.parallel.sweep.resolve_pallas_tier): the reduction
-        # kernel degrades to the streaming kernel exactly like the
-        # production sweep would, so the bench cannot report a pallas
-        # number the sweep engine wouldn't reproduce.
-        try:
-            from bdlz_tpu.parallel.sweep import resolve_pallas_tier
 
-            fuse = os.environ.get("BDLZ_BENCH_FUSE_EXP", "0") == "1"
-            # at the bench's own n_y — lowering failures are
-            # shape-dependent (the r2 RecursionError needed n_y=8000)
-            tier, preflight = resolve_pallas_tier(
-                static.chi_stats, n_y, fuse_exp=fuse
-            )
-            if preflight is not None:
-                print(f"[bench] pallas preflight {preflight}", file=sys.stderr)
-            if tier is None:
-                raise RuntimeError(f"preflight {preflight}")
-            run_chunk = make_run_chunk("pallas", reduce=tier)
-            max_rel = max(
-                accuracy_gate(run_chunk),
-                population_gate("pallas", reduce=tier),
-            )
-            if max_rel > 1e-6:
-                raise RuntimeError(
-                    f"pallas(reduce={tier}) rel err {max_rel:.3e} > 1e-6"
+    def main_measurement():
+        """Engine selection + accuracy gates + the timed full-grid sweep
+        — the expensive heart of the main metric line, returned as a
+        JSON-serializable dict so a tpu_unavailable round can reuse a
+        prior round's CPU measurement through the leg cache instead of
+        re-paying the full sweep after every relay death."""
+        impl = os.environ.get("BDLZ_BENCH_IMPL", default_impl)
+        run_chunk = None
+        preflight = None
+        pallas_reduce = None  # the tier actually benched (for the JSON)
+        max_rel = None
+        if impl == "pallas":
+            # Tier selection through the SHARED resolver
+            # (bdlz_tpu.parallel.sweep.resolve_pallas_tier): the reduction
+            # kernel degrades to the streaming kernel exactly like the
+            # production sweep would, so the bench cannot report a pallas
+            # number the sweep engine wouldn't reproduce.
+            try:
+                from bdlz_tpu.parallel.sweep import resolve_pallas_tier
+
+                fuse = os.environ.get("BDLZ_BENCH_FUSE_EXP", "0") == "1"
+                # at the bench's own n_y — lowering failures are
+                # shape-dependent (the r2 RecursionError needed n_y=8000)
+                tier, preflight = resolve_pallas_tier(
+                    static.chi_stats, n_y, fuse_exp=fuse
                 )
-            pallas_reduce = tier
-        except Exception as exc:  # noqa: BLE001 — any failure → safe path
-            print(f"[bench] pallas path unavailable ({exc}); falling back",
-                  file=sys.stderr)
-            impl, run_chunk = "tabulated", None
-    gate_error = None
-    if run_chunk is None:
-        from bdlz_tpu.validation import GateFailure
+                if preflight is not None:
+                    print(f"[bench] pallas preflight {preflight}",
+                          file=sys.stderr)
+                if tier is None:
+                    raise RuntimeError(f"preflight {preflight}")
+                run_chunk = make_run_chunk("pallas", reduce=tier)
+                max_rel = max(
+                    accuracy_gate(run_chunk),
+                    population_gate("pallas", reduce=tier),
+                )
+                if max_rel > 1e-6:
+                    raise RuntimeError(
+                        f"pallas(reduce={tier}) rel err {max_rel:.3e} > 1e-6"
+                    )
+                pallas_reduce = tier
+            except Exception as exc:  # noqa: BLE001 — any failure → safe path
+                print(f"[bench] pallas path unavailable ({exc}); falling back",
+                      file=sys.stderr)
+                impl, run_chunk = "tabulated", None
+        gate_error = None
+        if run_chunk is None:
+            from bdlz_tpu.validation import GateFailure
 
-        run_chunk = make_run_chunk(impl)
-        try:
-            max_rel = max(
-                accuracy_gate(run_chunk, static_run=static_for(impl)),
-                population_gate(impl),
-            )
-        except GateFailure as exc:
-            # non-finite gate output on the LAST-RESORT engine: report
-            # the failure in-band (null rel err + gate_error) rather
-            # than dying without the driver-parsed final line.  Only the
-            # dedicated type — a misconfigured grid should still die
-            # loudly, not emit a normal-looking metric line.
-            max_rel, gate_error = None, str(exc)
-            print(f"[bench] accuracy gate failed: {exc}", file=sys.stderr)
+            run_chunk = make_run_chunk(impl)
+            try:
+                max_rel = max(
+                    accuracy_gate(run_chunk, static_run=static_for(impl)),
+                    population_gate(impl),
+                )
+            except GateFailure as exc:
+                # non-finite gate output on the LAST-RESORT engine: report
+                # the failure in-band (null rel err + gate_error) rather
+                # than dying without the driver-parsed final line.  Only the
+                # dedicated type — a misconfigured grid should still die
+                # loudly, not emit a normal-looking metric line.
+                max_rel, gate_error = None, str(exc)
+                print(f"[bench] accuracy gate failed: {exc}", file=sys.stderr)
 
-    # --- timed sweep over the full grid ---
-    t0 = time.time()
-    done = 0
-    while done < n_total:
-        hi = min(done + chunk, n_total)
-        out = run_chunk(done, hi)
-        done = hi
-    out.block_until_ready()
-    seconds = time.time() - t0
+        # --- timed sweep over the full grid ---
+        t0 = time.time()
+        done = 0
+        while done < n_total:
+            hi = min(done + chunk, n_total)
+            out = run_chunk(done, hi)
+            done = hi
+        out.block_until_ready()
+        seconds = time.time() - t0
+        return {
+            "impl": impl,
+            "preflight": preflight,
+            "pallas_reduce": pallas_reduce,
+            "max_rel": None if max_rel is None else float(max_rel),
+            "gate_error": gate_error,
+            "seconds": seconds,
+            "per_chip": n_total / seconds / n_dev,
+        }
 
-    pps = n_total / seconds
-    per_chip = pps / n_dev
+    _main_hit = leg_lookup("main_sweep")
+    main_cached = _main_hit is not None
+    if main_cached:
+        meas = _main_hit["summary"]
+    else:
+        meas = main_measurement()
+        leg_record("main_sweep", [], meas)
+    impl = meas["impl"]
+    preflight = meas["preflight"]
+    pallas_reduce = meas["pallas_reduce"]
+    max_rel = meas["max_rel"]
+    gate_error = meas["gate_error"]
+    seconds = meas["seconds"]
+    per_chip = meas["per_chip"]
 
     main_static = static_for(impl)
     quad_impl_main = "panel_gl" if main_static.quad_panel_gl else "trap"
@@ -474,6 +601,8 @@ def main(argv=None) -> None:
             "n_failed": int((~np.isfinite(vals_gl)).sum()),
             "n_quarantined": None,
             "n_retries": None,
+            "cache_hits": None,
+            "cache_misses": None,
             "quad_impl": "panel_gl",
             "n_quad_nodes": n_quad_gl,
             "vs_trapezoid": round(per_chip_gl / max(per_chip_tr, 1e-9), 1),
@@ -492,7 +621,7 @@ def main(argv=None) -> None:
             "platform": jax.devices()[0].platform,
             "tpu_unavailable": tpu_unavailable,
         }
-        print(json.dumps(payload))
+        emit(payload)
         return {
             k: payload[k] for k in (
                 "value", "vs_trapezoid", "rel_err_vs_reference",
@@ -502,7 +631,7 @@ def main(argv=None) -> None:
 
     quad_gl_summary = None
     try:
-        quad_gl_summary = quad_gl_metric()
+        quad_gl_summary = run_leg("quad_gl", quad_gl_metric)
     except Exception as exc:  # noqa: BLE001 — secondary metric is best-effort
         print(f"[bench] quad_gl metric unavailable: {exc}", file=sys.stderr)
 
@@ -605,8 +734,8 @@ def main(argv=None) -> None:
                 rel_ref[name] = (
                     err if rel_ref[name] is None else max(rel_ref[name], err)
                 )
-        print(
-            json.dumps({
+        emit(
+            {
                 "metric": "esdirk_sweep_points_per_sec_per_chip",
                 "value": per_chip_ode,
                 "unit": "stiff ODE param-points/sec/chip (Gamma_wash grid)",
@@ -615,6 +744,8 @@ def main(argv=None) -> None:
                 # this leg times raw engine steps (no chunk-healing loop)
                 "n_quarantined": None,
                 "n_retries": None,
+                "cache_hits": None,
+                "cache_misses": None,
                 "seconds": round(esdirk_seconds, 3),
                 # the lockstep A/B: same grid, same tolerances, legacy
                 # engine — vs_lockstep is the repacking+accelerations
@@ -646,13 +777,13 @@ def main(argv=None) -> None:
                 "n_quad_nodes": None,
                 "platform": jax.devices()[0].platform,
                 "tpu_unavailable": tpu_unavailable,
-            })
+            }
         )
         return per_chip_ode
 
     esdirk_per_chip = None
     try:
-        esdirk_per_chip = esdirk_metric()
+        esdirk_per_chip = run_leg("esdirk", esdirk_metric)
     except Exception as exc:  # noqa: BLE001 — secondary metric is best-effort
         print(f"[bench] esdirk metric unavailable: {exc}", file=sys.stderr)
 
@@ -724,6 +855,8 @@ def main(argv=None) -> None:
             "n_failed": int(res_chaos.n_failed),
             "n_quarantined": int(res_chaos.n_quarantined),
             "n_retries": int(res_chaos.n_retries),
+            "cache_hits": res_chaos.cache_hits,
+            "cache_misses": res_chaos.cache_misses,
             "clean_points_per_sec_per_chip": per_chip_clean,
             "vs_clean": round(per_chip_chaos / max(per_chip_clean, 1e-9), 3),
             "bitwise_equal_unaffected": bitwise,
@@ -735,7 +868,7 @@ def main(argv=None) -> None:
             "platform": jax.devices()[0].platform,
             "tpu_unavailable": tpu_unavailable,
         }
-        print(json.dumps(payload))
+        emit(payload)
         return {
             k: payload[k] for k in (
                 "value", "vs_clean", "n_failed", "n_quarantined",
@@ -745,9 +878,99 @@ def main(argv=None) -> None:
 
     chaos_summary = None
     try:
-        chaos_summary = chaos_metric()
+        chaos_summary = run_leg("chaos", chaos_metric)
     except Exception as exc:  # noqa: BLE001 — secondary metric is best-effort
         print(f"[bench] chaos metric unavailable: {exc}", file=sys.stderr)
+
+    # --- secondary metric: the provenance sweep-chunk cache ------------
+    # Builds a small emulator box COLD into a fresh content-addressed
+    # store, then rebuilds it WARM against the same store
+    # (docs/provenance.md): the line records the warm/cold speedup, the
+    # warm hit rate, and — the contract that makes caching admissible at
+    # all — that the warm surface is BIT-identical to the cold one.
+    # Quadrature is pinned to the trapezoid so both legs skip the
+    # (equal-cost) audit and the cold compute is an honest heavyweight.
+    def sweep_cache_metric():
+        import shutil
+        import tempfile
+
+        from bdlz_tpu.emulator import AxisSpec, build_emulator
+        from bdlz_tpu.provenance import Store
+
+        nodes0 = int(os.environ.get("BDLZ_BENCH_CACHE_NODES", 4))
+        cache_ny = int(os.environ.get("BDLZ_BENCH_CACHE_NY", n_y))
+        probes = int(os.environ.get("BDLZ_BENCH_CACHE_PROBES", 16))
+        rounds = int(os.environ.get("BDLZ_BENCH_CACHE_ROUNDS", 2))
+        static_cc = static._replace(quad_panel_gl=False)
+        spec = {
+            "m_chi_GeV": AxisSpec(0.3, 3.0, nodes0, "log"),
+            "T_p_GeV": AxisSpec(60.0, 200.0, nodes0, "log"),
+        }
+        root = tempfile.mkdtemp(prefix="bdlz_bench_sweep_cache_")
+        try:
+            kw = dict(
+                rtol=1e-3, n_probe=probes, max_rounds=rounds,
+                n_y=cache_ny, impl="tabulated", mesh=mesh,
+                chunk_size=max(64, n_dev), seed=5,
+            )
+            store_cold = Store(root)
+            t1 = time.time()
+            art_cold, rep_cold = build_emulator(
+                base, spec, static_cc, cache=store_cold, **kw
+            )
+            cold_s = time.time() - t1
+            store_warm = Store(root)
+            t2 = time.time()
+            art_warm, _rep_warm = build_emulator(
+                base, spec, static_cc, cache=store_warm, **kw
+            )
+            warm_s = time.time() - t2
+        finally:
+            shutil.rmtree(root, ignore_errors=True)
+        bitwise = all(
+            np.array_equal(art_cold.values[f], art_warm.values[f])
+            for f in art_cold.values
+        )
+        probed = store_warm.stats.hits + store_warm.stats.misses
+        speedup = cold_s / max(warm_s, 1e-9)
+        payload = {
+            "metric": "sweep_cache_warm_vs_cold",
+            "value": round(speedup, 1),
+            "unit": "x speedup (warm rebuild of the same emulator box "
+                    "through the content-addressed sweep chunk cache vs "
+                    "cold build; trapezoid n_y=%d)" % cache_ny,
+            "cold_seconds": round(cold_s, 3),
+            "warm_seconds": round(warm_s, 3),
+            "cache_hits": int(store_warm.stats.hits),
+            "cache_misses": int(store_warm.stats.misses),
+            "hit_rate": round(store_warm.stats.hits / max(probed, 1), 4),
+            "bitwise_equal": bitwise,
+            "n_grid_points": art_cold.n_points,
+            "n_exact_evals": rep_cold.n_exact_evals,
+            # schema: the build raises on any failed/quarantined grid
+            # point, so a line that printed at all had zero of each
+            "n_failed": 0,
+            "n_quarantined": None,
+            "n_retries": None,
+            "quad_impl": "trap",
+            "n_quad_nodes": max(cache_ny, 2000),
+            "platform": jax.devices()[0].platform,
+            "tpu_unavailable": tpu_unavailable,
+        }
+        emit(payload)
+        return {
+            k: payload[k] for k in (
+                "value", "cold_seconds", "warm_seconds", "cache_hits",
+                "cache_misses", "hit_rate", "bitwise_equal",
+            )
+        }
+
+    sweep_cache_summary = None
+    try:
+        sweep_cache_summary = run_leg("sweep_cache", sweep_cache_metric)
+    except Exception as exc:  # noqa: BLE001 — secondary metric is best-effort
+        print(f"[bench] sweep_cache metric unavailable: {exc}",
+              file=sys.stderr)
 
     # --- secondary metric: the yield-surface emulator + query service ---
     # Builds a small adaptive emulator (bdlz_tpu/emulator) over the bench
@@ -855,7 +1078,7 @@ def main(argv=None) -> None:
             "platform": jax.devices()[0].platform,
             "tpu_unavailable": tpu_unavailable,
         }
-        print(json.dumps(payload))
+        emit(payload)
         summary = {
             k: payload[k] for k in (
                 "build_seconds", "refinement_rounds", "max_rel_err",
@@ -868,10 +1091,21 @@ def main(argv=None) -> None:
 
     emulator_summary = None
     emu_artifact = None
+    _emu_box: list = []
+
+    def emulator_leg():
+        # the artifact itself is not JSON (not cacheable); it rides a
+        # side box so a cache HIT yields summary-only — the serve leg
+        # then answers from its own cached entry or skips loudly
+        s, art = emulator_metric()
+        _emu_box.append(art)
+        return s
+
     try:
-        emulator_summary, emu_artifact = emulator_metric()
+        emulator_summary = run_leg("emulator", emulator_leg)
     except Exception as exc:  # noqa: BLE001 — secondary metric is best-effort
         print(f"[bench] emulator metric unavailable: {exc}", file=sys.stderr)
+    emu_artifact = _emu_box[0] if _emu_box else None
 
     # --- secondary metric: the sharded serving fleet (serve_bench) ----
     # The serving counterpart of sweep_points_per_sec_per_chip
@@ -1021,7 +1255,7 @@ def main(argv=None) -> None:
             "platform": jax.devices()[0].platform,
             "tpu_unavailable": tpu_unavailable,
         }
-        print(json.dumps(payload))
+        emit(payload)
         return {
             k: payload[k] for k in (
                 "value", "qps", "replica_scaling", "p50_latency_s",
@@ -1031,15 +1265,23 @@ def main(argv=None) -> None:
         }
 
     serve_summary = None
-    if emu_artifact is not None:
-        try:
-            serve_summary = serve_bench_metric(emu_artifact)
-        except Exception as exc:  # noqa: BLE001 — secondary metric is best-effort
-            print(f"[bench] serve_bench metric unavailable: {exc}",
-                  file=sys.stderr)
-    else:
-        print("[bench] serve_bench skipped: no emulator artifact this "
-              "round", file=sys.stderr)
+    try:
+        _serve_hit = leg_lookup("serve_bench")
+        if _serve_hit is not None:
+            serve_summary = _serve_hit.get("summary")
+        elif emu_artifact is None:
+            # no fresh artifact (emulator leg failed, or it was itself a
+            # cache hit without a matching serve entry — possible only
+            # if the prior round's serve leg failed): nothing to serve
+            print("[bench] serve_bench skipped: no emulator artifact this "
+                  "round", file=sys.stderr)
+        else:
+            serve_summary = run_leg(
+                "serve_bench", lambda: serve_bench_metric(emu_artifact)
+            )
+    except Exception as exc:  # noqa: BLE001 — secondary metric is best-effort
+        print(f"[bench] serve_bench metric unavailable: {exc}",
+              file=sys.stderr)
 
     # --- secondary metrics: the LZ sweeps (BASELINE.json's metric name) --
     # Per-point P derived from a bounce profile through the two-channel
@@ -1085,8 +1327,8 @@ def main(argv=None) -> None:
         out.block_until_ready()
         lz_seconds = (time.time() - t1) + t_derive
         per_chip_lz = round(n_lz / lz_seconds / n_dev, 2)
-        print(
-            json.dumps({
+        emit(
+            {
                 "metric": metric_name,
                 "value": per_chip_lz,
                 "unit": "param-points/sec/chip (%s + full pipeline, "
@@ -1095,6 +1337,8 @@ def main(argv=None) -> None:
                 "n_failed": None,
                 "n_quarantined": None,
                 "n_retries": None,
+                "cache_hits": None,
+                "cache_misses": None,
                 "lz_derive_seconds": round(t_derive, 3),
                 "seconds": round(lz_seconds, 3),
                 "rel_err_vs_reference": float(f"{lz_rel:.3e}"),
@@ -1103,7 +1347,7 @@ def main(argv=None) -> None:
                 "n_quad_nodes": n_quad_main,
                 "platform": jax.devices()[0].platform,
                 "tpu_unavailable": tpu_unavailable,
-            })
+            }
         )
         return per_chip_lz
 
@@ -1137,7 +1381,12 @@ def main(argv=None) -> None:
          lz_coherent_P),
     ):
         try:
-            val = lz_metric(name, detail, derive)
+            val = run_leg(
+                attr.replace("_per_chip", ""),
+                lambda name=name, detail=detail, derive=derive: lz_metric(
+                    name, detail, derive
+                ),
+            )
         except Exception as exc:  # noqa: BLE001 — secondary metric is best-effort
             print(f"[bench] {name} unavailable: {exc}", file=sys.stderr)
             val = None
@@ -1162,6 +1411,16 @@ def main(argv=None) -> None:
                 "n_failed": None,
                 "n_quarantined": None,
                 "n_retries": None,
+                # provenance schema: the timed loop bypasses the chunk
+                # cache by design (a cached headline number is not a
+                # throughput measurement); the sweep_cache line carries
+                # the real counters
+                "cache_hits": None,
+                "cache_misses": None,
+                # the main MEASUREMENT (gates + timed sweep) was reused
+                # from a prior round's leg-cache entry — only ever true
+                # on a tpu_unavailable round with identical code/knobs
+                **({"cached": True} if main_cached else {}),
                 "seconds": round(seconds, 3),
                 "rel_err_vs_reference": (
                     None if max_rel is None else float(f"{max_rel:.3e}")
@@ -1192,6 +1451,10 @@ def main(argv=None) -> None:
                 # the chaos (fault-injected self-healing sweep) summary
                 # (null = leg failed; its secondary line has the detail)
                 "chaos": chaos_summary,
+                # the provenance chunk-cache A/B (warm-vs-cold emulator
+                # box rebuild: speedup, hit rate, bitwise check; null =
+                # leg failed — its secondary line has the detail)
+                "sweep_cache": sweep_cache_summary,
                 # the emulator/serving metric (null = build or measure
                 # failed; the secondary line carries the full detail)
                 "emulator": emulator_summary,

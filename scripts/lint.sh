@@ -19,7 +19,9 @@ fi
 
 # the whole package tree, including the emulator + serve layers (their
 # jitted query kernel / batcher hot path are prime R1/R3 surfaces —
-# tests/test_lint.py additionally pins those two packages per-file)
+# tests/test_lint.py additionally pins those two packages per-file) and
+# the provenance package (host-side identity/store code — pinned
+# per-file in test_lint.py so cache plumbing stays out of jit paths)
 echo "[lint] python -m bdlz_tpu.lint bdlz_tpu/"
 python -m bdlz_tpu.lint bdlz_tpu/ || rc=1
 

@@ -1,0 +1,82 @@
+"""Worker for the real 2-process jax.distributed chunk-cache test.
+
+Launched twice by ``tests/test_multihost.py::test_two_process_chunk_cache``
+as ``python _mp_cache_worker.py <port> <process_id> <out_dir>``.  Both
+processes join one distributed runtime over a SHARED store root: the
+cold sweep's entries are written by the coordinator only, then the warm
+sweep's broadcast hit-plan makes every process — including process 1,
+which never wrote a byte — read the chunks the other host's coordinator
+stored and reproduce the cold outputs bitwise.  That is the fleet
+contract: no host recomputes a chunk any host has already paid for.
+"""
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from _mp_common import force_local_device_count, pin_worker_platform
+
+# must run before the first `import jax` (overrides the parent pytest
+# process's 8-device flag)
+force_local_device_count(2)
+
+
+def main() -> None:
+    port, pid, out_dir = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+
+    import jax
+
+    pin_worker_platform(jax, 2)
+
+    from bdlz_tpu.parallel.multihost import init_multihost
+
+    assert init_multihost(f"localhost:{port}", 2, pid) is True
+    assert jax.process_count() == 2, jax.process_count()
+    assert len(jax.devices()) == 4, jax.devices()
+
+    import numpy as np
+
+    from bdlz_tpu.config import config_from_dict, static_choices_from_config
+    from bdlz_tpu.parallel import make_mesh, run_sweep
+
+    cfg = config_from_dict({
+        "regime": "nonthermal",
+        "P_chi_to_B": 0.14925839040304145,
+        "source_shape_sigma_y": 9.0,
+        "incident_flux_scale": 1.07e-9,
+        "Y_chi_init": 4.90e-10,
+    })
+    # explicit quadrature: skip the (identical, but slow) per-process audit
+    static = static_choices_from_config(cfg)._replace(quad_panel_gl=False)
+    axes = {"m_chi_GeV": np.geomspace(0.3, 3.0, 8).tolist()}
+    mesh = make_mesh(shape=(4, 1))  # all 4 global devices on dp
+    store_root = f"{out_dir}/store"
+
+    cold = run_sweep(
+        cfg, axes, static, mesh=mesh, chunk_size=4, n_y=2000,
+        cache=store_root,
+    )
+    assert cold.n_failed == 0
+    assert cold.cache_hits == 0 and cold.cache_misses == cold.chunks == 2
+
+    # warm pass: the broadcast hit-plan must serve every chunk from the
+    # shared store on BOTH processes identically (divergence would
+    # deadlock, which the parent's timeout converts into a failure);
+    # process 1 reads chunks it never wrote — the cross-host reuse pin
+    warm = run_sweep(
+        cfg, axes, static, mesh=mesh, chunk_size=4, n_y=2000,
+        cache=store_root,
+    )
+    assert warm.cache_hits == 2 and warm.cache_misses == 0, (
+        warm.cache_hits, warm.cache_misses,
+    )
+    np.testing.assert_array_equal(
+        cold.outputs["DM_over_B"], warm.outputs["DM_over_B"]
+    )
+
+    np.savez(f"{out_dir}/result_p{pid}.npz", **warm.outputs)
+    print(f"worker {pid} OK")
+
+
+if __name__ == "__main__":
+    main()

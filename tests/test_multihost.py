@@ -295,3 +295,49 @@ def test_divergent_kernel_knob_raises_fleetwide(tmp_path):
     for rc, out, err in outs:
         assert_worker_ok(rc, out, err)
         assert "KNOB-MISMATCH-RAISED" in out
+
+
+def test_two_process_chunk_cache(tmp_path):
+    """The provenance-plane fleet pin: a 2-process run writes its chunk
+    entries into a SHARED content-addressed store (coordinator-only
+    writes), then a warm 2-process run serves every chunk from the
+    broadcast hit-plan — process 1, which never wrote a byte, reads the
+    chunks the coordinator stored and reproduces the cold outputs
+    bitwise.  Plan divergence would deadlock; the parent timeout
+    converts that into a failure."""
+    port = _free_port()
+    worker = os.path.join(os.path.dirname(__file__), "_mp_cache_worker.py")
+
+    env = dict(os.environ)
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    for k in ("XLA_FLAGS", "JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES",
+              "JAX_PROCESS_ID", "BDLZ_CACHE_ROOT"):
+        env.pop(k, None)
+
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, str(port), str(pid), str(tmp_path)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
+        for pid in (0, 1)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=540)
+            outs.append((p.returncode, out, err))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for rc, out, err in outs:
+        assert_worker_ok(rc, out, err)
+        assert "OK" in out
+
+    # both processes gathered the identical warm (cache-served) result
+    r0 = np.load(tmp_path / "result_p0.npz")
+    r1 = np.load(tmp_path / "result_p1.npz")
+    np.testing.assert_array_equal(r0["DM_over_B"], r1["DM_over_B"])
+    # and the shared store holds exactly the sweep's two chunk entries
+    entries = sorted(os.listdir(tmp_path / "store" / "sweep_chunk"))
+    assert len(entries) == 2 and all(e.endswith(".npz") for e in entries)

@@ -1,0 +1,653 @@
+"""Provenance plane pins (docs/provenance.md).
+
+Three load-bearing claims:
+
+* **identity byte-compatibility** — the four legacy content-identity
+  systems (sweep manifest hash, emulator artifact hash, validation
+  refcache key, MCMC segment hash) now construct through
+  ``bdlz_tpu/provenance`` and their digests are BYTE-identical to the
+  pre-provenance hand-rolled implementations, so every artifact already
+  on disk keeps resolving — each compat test re-implements the legacy
+  hash inline and compares;
+* **store hardening** — untrusted roots refused, corrupt entries
+  deleted-and-missed, partial writes evicted by age, concurrent writers
+  safe, armed-fault identities disjoint from clean ones;
+* **chunk-cache semantics** — a warm ``run_sweep``/``build_emulator``
+  re-run serves BIT-identical results from the store, directory resume
+  wins over the cache, identity changes miss, and the self-healing
+  bookkeeping (quarantine masks, retry counters) round-trips through
+  entries.
+"""
+import hashlib
+import json
+import os
+
+import numpy as np
+import pytest
+
+from bdlz_tpu.config import (
+    ROBUSTNESS_STATIC_FIELDS,
+    config_from_dict,
+    config_identity_dict,
+    static_choices_from_config,
+)
+from bdlz_tpu.provenance import (
+    Store,
+    StoreUntrustedError,
+    fetch_artifact,
+    mcmc_segment_identity,
+    publish_artifact,
+    refcache_identity,
+    resolve_store,
+)
+
+
+def _base(**over):
+    return config_from_dict({
+        "regime": "nonthermal",
+        "P_chi_to_B": 0.14925839040304145,
+        "source_shape_sigma_y": 9.0,
+        "incident_flux_scale": 1.07e-9,
+        "Y_chi_init": 4.90e-10,
+        **over,
+    })
+
+
+AXES = {"m_chi_GeV": np.geomspace(0.3, 3.0, 8).tolist()}
+
+
+class TestIdentityCompat:
+    """Digest byte-compatibility with the pre-provenance constructions."""
+
+    def test_sweep_identity_matches_legacy_grid_hash(self):
+        from bdlz_tpu.parallel.sweep import grid_hash
+
+        base = _base()
+        for extra in (None, {"quad": {"panel_gl": True}}):
+            payload = {
+                "base": config_identity_dict(base),
+                "axes": {k: list(map(float, v)) for k, v in AXES.items()},
+                "n_y": 2000,
+                "impl": "tabulated",
+            }
+            if extra:
+                payload["extra"] = dict(extra)
+            legacy = hashlib.sha256(
+                json.dumps(payload, sort_keys=True).encode()
+            ).hexdigest()[:16]
+            assert grid_hash(base, AXES, 2000, extra=extra) == legacy
+
+    def test_artifact_hash_matches_legacy_construction(self, tiny_emulator):
+        from bdlz_tpu.emulator.artifact import SCHEMA_VERSION, artifact_hash
+
+        _, _, art, _ = tiny_emulator
+        payload = {
+            "schema_version": SCHEMA_VERSION,
+            "axes": {
+                str(n): [float(v) for v in np.asarray(nodes)]
+                for n, nodes in zip(art.axis_names, art.axis_nodes)
+            },
+            "scales": [str(s) for s in art.axis_scales],
+            "identity": dict(art.identity),
+            "fields": sorted(art.values),
+        }
+        h = hashlib.sha256()
+        h.update(json.dumps(payload, sort_keys=True).encode())
+        for name in sorted(art.values):
+            h.update(name.encode())
+            h.update(np.ascontiguousarray(
+                np.asarray(art.values[name], dtype=np.float64)
+            ).tobytes())
+        legacy = h.hexdigest()[:16]
+        assert artifact_hash(
+            art.axis_names, art.axis_nodes, art.axis_scales, art.values,
+            art.identity,
+        ) == legacy
+        # and the saved artifact's recorded hash still verifies
+        assert art.content_hash == legacy
+
+    def test_refcache_key_matches_legacy_construction(self, tmp_path):
+        """A ``ref_*.npy`` written under the LEGACY key must be a HIT for
+        the provenance-routed cache — pre-existing refcache dirs keep
+        paying out."""
+        from bdlz_tpu.validation import (
+            build_audit_population,
+            reference_ratios_cached,
+        )
+
+        base = _base()
+        static = static_choices_from_config(base)
+        pop = build_audit_population(base, 4, seed=7)
+
+        # the pre-provenance key construction, verbatim
+        import bdlz_tpu.constants
+        import bdlz_tpu.models.yields_pipeline
+        import bdlz_tpu.ops.kjma_table
+        import bdlz_tpu.physics.percolation
+        import bdlz_tpu.physics.source
+        import bdlz_tpu.physics.thermo
+        import bdlz_tpu.solvers.panels
+        import bdlz_tpu.solvers.quadrature
+        import inspect
+
+        fp = hashlib.sha256()
+        for mod in (
+            bdlz_tpu.constants, bdlz_tpu.models.yields_pipeline,
+            bdlz_tpu.ops.kjma_table, bdlz_tpu.physics.percolation,
+            bdlz_tpu.physics.source, bdlz_tpu.physics.thermo,
+            bdlz_tpu.solvers.panels, bdlz_tpu.solvers.quadrature,
+        ):
+            fp.update(inspect.getsource(mod).encode())
+        h = hashlib.sha256()
+        for f in pop.grid:
+            h.update(np.ascontiguousarray(
+                np.asarray(f, dtype=np.float64)
+            ).tobytes())
+        ident = tuple(
+            v for f, v in zip(type(static)._fields, static)
+            if f not in ROBUSTNESS_STATIC_FIELDS
+        )
+        h.update(repr((ident, 200)).encode())
+        h.update(fp.hexdigest()[:16].encode())
+        legacy_key = h.hexdigest()[:24]
+        assert refcache_identity(pop.grid, static, 200).digest(24) == legacy_key
+
+        # plant a sentinel under the legacy filename: the new code must
+        # HIT it (never recompute), proving key + layout compatibility
+        d = tmp_path / "rc"
+        d.mkdir(mode=0o700)
+        sentinel = np.arange(4, dtype=np.float64)
+        np.save(d / f"ref_{legacy_key}.npy", sentinel)
+        stats = {}
+        out = reference_ratios_cached(
+            pop.grid, static, n_y=200, cache_dir=str(d), stats=stats
+        )
+        assert stats["cache_hit"] is True
+        np.testing.assert_array_equal(out, sentinel)
+
+    def test_mcmc_segment_identity_legacy_and_schema_bump(self):
+        init = 0.1 * np.arange(8, dtype=np.float64).reshape(4, 2)
+        ident = {"config": "A", "params": {"m_chi_GeV": [0.1, 10.0]}}
+        payload = {
+            "init": hashlib.sha256(
+                np.ascontiguousarray(init).tobytes()
+            ).hexdigest(),
+            "seed": 5, "n_steps": 60, "checkpoint_every": 20,
+            "a": 2.0, "thin": 1, "identity": ident,
+        }
+        legacy = hashlib.sha256(
+            json.dumps(payload, sort_keys=True).encode()
+        ).hexdigest()[:16]
+        no_static = mcmc_segment_identity(init, 5, 60, 20, 2.0, 1, ident)
+        assert no_static.digest(16) == legacy
+        # folding the resolved static in is a LOUD bump: different hash,
+        # and a resolved-knob flip changes it again
+        st = static_choices_from_config(_base())._replace(quad_panel_gl=False)
+        with_static = mcmc_segment_identity(
+            init, 5, 60, 20, 2.0, 1, ident, static=st
+        )
+        assert with_static.digest(16) != legacy
+        flipped = mcmc_segment_identity(
+            init, 5, 60, 20, 2.0, 1, ident,
+            static=st._replace(quad_panel_gl=True),
+        )
+        assert flipped.digest(16) != with_static.digest(16)
+
+    def test_cache_knobs_excluded_from_every_identity(self):
+        """cache_enabled/cache_root are orchestration: toggling them must
+        stale nothing (CACHE_CONFIG_FIELDS exclusion)."""
+        from bdlz_tpu.parallel.sweep import grid_hash
+
+        base = _base()
+        tuned = _base(cache_enabled=True, cache_root="/tmp/elsewhere")
+        assert config_identity_dict(base) == config_identity_dict(tuned)
+        assert grid_hash(base, AXES, 2000) == grid_hash(tuned, AXES, 2000)
+
+    def test_fault_armed_chunk_keys_never_collide_with_clean(self):
+        from bdlz_tpu.faults import FaultPlan
+        from bdlz_tpu.parallel.sweep import (
+            build_grid,
+            chunk_cache_key,
+            engine_identity_extra,
+        )
+
+        base = _base()
+        static = static_choices_from_config(base)._replace(quad_panel_gl=False)
+        pp = build_grid(base, AXES)
+        plan = FaultPlan.from_obj({"faults": [
+            {"site": "step", "kind": "poison", "point": 2},
+        ]})
+        kw = dict(n_y=400, impl="tabulated")
+        clean = chunk_cache_key(base, static, pp, 0, 4, extra={}, **kw)
+        armed = chunk_cache_key(
+            base, static, pp, 0, 4,
+            extra=engine_identity_extra(static, "tabulated", faults=plan),
+            fault_ctx=("step", 0, 0, 4), **kw,
+        )
+        assert clean != armed
+        # the injection WINDOW keys too: same slice at another chunk
+        # position is a different injected result
+        armed_shifted = chunk_cache_key(
+            base, static, pp, 0, 4,
+            extra=engine_identity_extra(static, "tabulated", faults=plan),
+            fault_ctx=("step", 1, 4, 8), **kw,
+        )
+        assert armed != armed_shifted
+        # and the platform is part of the clean core (no cross-platform
+        # bit reuse)
+        other = chunk_cache_key(
+            base, static, pp, 0, 4, extra={}, platform="tpu", **kw
+        )
+        assert clean != other
+
+
+class TestStore:
+    def test_typed_roundtrips_and_counters(self, tmp_path):
+        s = Store(str(tmp_path / "store"))
+        assert s.get_json("a.json") is None          # miss
+        s.put_json("a.json", {"x": 1})
+        assert s.get_json("a.json") == {"x": 1}      # hit
+        s.put_array("kind/b.npy", np.arange(3.0))
+        np.testing.assert_array_equal(
+            s.get_array("kind/b.npy"), np.arange(3.0)
+        )
+        s.put_npz("kind/c.npz", {"v": np.ones(2), "m": np.zeros(2, bool)})
+        ent = s.get_npz("kind/c.npz")
+        np.testing.assert_array_equal(ent["v"], np.ones(2))
+        assert s.stats.hits == 3 and s.stats.misses == 1 and s.stats.writes == 3
+        # one-level kind dirs are created 0700
+        assert (tmp_path / "store" / "kind").is_dir()
+
+    def test_entry_name_validation(self, tmp_path):
+        s = Store(str(tmp_path / "store"))
+        for bad in ("../x.npy", "a/b/c.npy", ".hidden", "a b.npy", ""):
+            with pytest.raises(ValueError):
+                s.path_for(bad)
+
+    def test_corrupt_entry_deleted_and_missed(self, tmp_path, capsys):
+        s = Store(str(tmp_path / "store"))
+        s.put_npz("sweep_chunk/x.npz", {"v": np.ones(2)})
+        path = s.path_for("sweep_chunk/x.npz")
+        with open(path, "wb") as f:
+            f.write(b"not a zip")
+        assert s.get_npz("sweep_chunk/x.npz") is None
+        assert "corrupt" in capsys.readouterr().err
+        assert not os.path.exists(path)              # poisoned file gone
+        assert s.stats.dropped_corrupt == 1
+        # a rewrite makes the next read a clean hit
+        s.put_npz("sweep_chunk/x.npz", {"v": np.ones(2)})
+        assert s.get_npz("sweep_chunk/x.npz") is not None
+
+    def test_partial_write_eviction_by_age(self, tmp_path):
+        s = Store(str(tmp_path / "store"))
+        old = tmp_path / "store" / "stale.tmp.npy"
+        old.write_bytes(b"dead writer dropping")
+        os.utime(old, (1, 1))                        # ancient mtime
+        young = tmp_path / "store" / "live.tmp.npy"
+        young.write_bytes(b"in-flight writer")
+        # a publisher that died before its rename leaves a temp DIRECTORY
+        # (registry.publish_artifact) — aged ones must go too
+        old_dir = tmp_path / "store" / "pubXYZ.tmp"
+        old_dir.mkdir()
+        (old_dir / "artifact.npz").write_bytes(b"half a publish")
+        os.utime(old_dir, (1, 1))
+        assert s.evict_partials(max_age_s=3600) == 2
+        assert not old.exists() and not old_dir.exists()
+        assert young.exists()                        # may be a live writer
+
+    def test_untrusted_roots_refused(self, tmp_path, capsys):
+        real = tmp_path / "real"
+        real.mkdir(mode=0o700)
+        link = tmp_path / "link"
+        link.symlink_to(real)
+        with pytest.raises(StoreUntrustedError, match="symlink"):
+            Store(str(link))
+        loose = tmp_path / "loose"
+        loose.mkdir()
+        os.chmod(loose, 0o770)
+        with pytest.raises(StoreUntrustedError, match="group/other-writable"):
+            Store(str(loose))
+        # resolve_store degrades to caching-disabled LOUDLY, never trusts
+        assert resolve_store(str(link), label="test") is None
+        assert "symlink" in capsys.readouterr().err
+
+    def test_concurrent_writers_same_key(self, tmp_path):
+        """Two processes racing the same entry: last-writer-wins on
+        identical content, and the entry is readable afterwards (atomic
+        mkstemp+replace — no torn zip)."""
+        import multiprocessing as mp
+
+        root = str(tmp_path / "store")
+        Store(root)  # create+harden once, parent-side
+
+        ctx = mp.get_context("spawn")
+        procs = [
+            ctx.Process(target=_race_writer, args=(root, i))
+            for i in range(2)
+        ]
+        for p in procs:
+            p.start()
+        for p in procs:
+            p.join(timeout=120)
+            assert p.exitcode == 0
+        ent = Store(root).get_npz("sweep_chunk/raced.npz")
+        assert ent is not None
+        np.testing.assert_array_equal(ent["v"], np.arange(64.0))
+
+
+def _race_writer(root: str, worker: int) -> None:
+    """Spawned by test_concurrent_writers_same_key: hammer the same key."""
+    import numpy as _np
+
+    from bdlz_tpu.provenance import Store as _Store
+
+    s = _Store(root)
+    for _ in range(25):
+        s.put_npz("sweep_chunk/raced.npz", {"v": _np.arange(64.0)})
+        ent = s.get_npz("sweep_chunk/raced.npz")
+        assert ent is not None and ent["v"].shape == (64,)
+
+
+class TestResolveStore:
+    def test_tri_state_resolution(self, tmp_path, monkeypatch):
+        monkeypatch.delenv("BDLZ_CACHE_ROOT", raising=False)
+        base = _base()
+        # default: no root configured anywhere -> caching off
+        assert resolve_store(None, base) is None
+        # explicit path wins
+        st = resolve_store(str(tmp_path / "a"), base)
+        assert isinstance(st, Store)
+        # config root
+        st = resolve_store(None, _base(cache_root=str(tmp_path / "b")))
+        assert st is not None and st.root == str(tmp_path / "b")
+        # env root
+        monkeypatch.setenv("BDLZ_CACHE_ROOT", str(tmp_path / "c"))
+        assert resolve_store(None, base).root == str(tmp_path / "c")
+        # cache_enabled=False force-disables even an explicit store
+        off = _base(cache_enabled=False, cache_root=str(tmp_path / "b"))
+        assert resolve_store(Store(str(tmp_path / "a")), off) is None
+        # cache_enabled=True with no root -> the XDG default
+        monkeypatch.delenv("BDLZ_CACHE_ROOT", raising=False)
+        monkeypatch.setenv("XDG_CACHE_HOME", str(tmp_path / "xdg"))
+        st = resolve_store(None, _base(cache_enabled=True))
+        assert st is not None and st.root.endswith("bdlz_store")
+        assert st.root.startswith(str(tmp_path / "xdg"))
+
+
+class TestSweepChunkCache:
+    def _setup(self):
+        base = _base()
+        static = static_choices_from_config(base)._replace(
+            quad_panel_gl=False  # skip the audit: keep the unit fast
+        )
+        return base, static
+
+    def test_warm_rerun_hits_bitwise(self, tmp_path):
+        from bdlz_tpu.parallel.sweep import run_sweep
+
+        base, static = self._setup()
+        root = str(tmp_path / "store")
+        cold = run_sweep(base, AXES, static, chunk_size=4, n_y=400,
+                         cache=root)
+        assert cold.cache_hits == 0 and cold.cache_misses == cold.chunks == 2
+        warm = run_sweep(base, AXES, static, chunk_size=4, n_y=400,
+                         cache=root)
+        assert warm.cache_hits == 2 and warm.cache_misses == 0
+        np.testing.assert_array_equal(
+            cold.outputs["DM_over_B"], warm.outputs["DM_over_B"]
+        )
+        assert not warm.failed_mask.any()
+        # no store configured -> counters are null, outputs still computed
+        plain = run_sweep(base, AXES, static, chunk_size=4, n_y=400)
+        assert plain.cache_hits is None and plain.cache_misses is None
+
+    def test_identity_change_misses(self, tmp_path):
+        from bdlz_tpu.parallel.sweep import run_sweep
+
+        base, static = self._setup()
+        root = str(tmp_path / "store")
+        run_sweep(base, AXES, static, chunk_size=4, n_y=400, cache=root)
+        other = run_sweep(base, AXES, static, chunk_size=4, n_y=800,
+                          cache=root)
+        assert other.cache_hits == 0 and other.cache_misses == 2
+
+    def test_overlapping_grid_reuses_slices(self, tmp_path):
+        """Keys carry no axes/chunk position: a different sweep whose
+        chunk slices repeat point values another sweep paid for hits."""
+        from bdlz_tpu.parallel.sweep import run_sweep
+
+        base, static = self._setup()
+        root = str(tmp_path / "store")
+        run_sweep(base, AXES, static, chunk_size=4, n_y=400, cache=root)
+        # the first half of AXES as its own sweep: its single chunk is
+        # byte-identical to the first chunk of the full sweep
+        half = {"m_chi_GeV": AXES["m_chi_GeV"][:4]}
+        res = run_sweep(base, half, static, chunk_size=4, n_y=400,
+                        cache=root)
+        assert res.cache_hits == 1 and res.cache_misses == 0
+
+    def test_out_dir_resume_wins_over_cache(self, tmp_path):
+        from bdlz_tpu.parallel.sweep import run_sweep
+
+        base, static = self._setup()
+        root = str(tmp_path / "store")
+        out = str(tmp_path / "sweep")
+        first = run_sweep(base, AXES, static, chunk_size=4, n_y=400,
+                          cache=root, out_dir=out)
+        again = run_sweep(base, AXES, static, chunk_size=4, n_y=400,
+                          cache=root, out_dir=out)
+        assert again.resumed_chunks == first.chunks == 2
+        assert again.cache_hits == 0          # resume won every chunk
+        np.testing.assert_array_equal(
+            first.outputs["DM_over_B"], again.outputs["DM_over_B"]
+        )
+        # a FRESH out_dir falls through to the cache and REBUILDS the
+        # sweep directory from cached bytes (still resumable after)
+        out2 = str(tmp_path / "sweep2")
+        cached = run_sweep(base, AXES, static, chunk_size=4, n_y=400,
+                           cache=root, out_dir=out2)
+        assert cached.cache_hits == 2
+        assert os.path.exists(os.path.join(out2, "chunk_00000.npz"))
+        resumed = run_sweep(base, AXES, static, chunk_size=4, n_y=400,
+                            out_dir=out2)
+        assert resumed.resumed_chunks == 2
+        np.testing.assert_array_equal(
+            first.outputs["DM_over_B"], resumed.outputs["DM_over_B"]
+        )
+
+    def test_quarantine_retry_roundtrip_under_armed_plan(self, tmp_path):
+        """PR-5 semantics survive the cache bit-for-bit: a chaos run's
+        quarantine mask AND retry counters come back identical on a warm
+        hit, without re-running the healing machinery."""
+        from bdlz_tpu.faults import FaultPlan
+        from bdlz_tpu.parallel.sweep import run_sweep
+        from bdlz_tpu.utils.retry import RetryPolicy
+
+        base, static = self._setup()
+        root = str(tmp_path / "store")
+        plan = FaultPlan.from_obj({"faults": [
+            {"site": "step", "kind": "transient", "key": 0, "times": 1},
+            {"site": "step", "kind": "poison", "point": 2},
+        ]})
+        retry = RetryPolicy(max_attempts=2, backoff_s=0.0,
+                            sleep=lambda s: None)
+        cold = run_sweep(base, AXES, static, chunk_size=4, n_y=400,
+                         cache=root, fault_plan=plan, retry=retry)
+        assert cold.n_quarantined == 1 and cold.n_retries >= 1
+        warm = run_sweep(base, AXES, static, chunk_size=4, n_y=400,
+                         cache=root, fault_plan=plan, retry=retry)
+        assert warm.cache_hits == 2
+        assert warm.n_quarantined == cold.n_quarantined
+        assert warm.n_retries == cold.n_retries
+        np.testing.assert_array_equal(
+            cold.quarantined_mask, warm.quarantined_mask
+        )
+        np.testing.assert_array_equal(
+            cold.outputs["DM_over_B"], warm.outputs["DM_over_B"]
+        )
+
+    def test_clean_run_never_hits_armed_entries(self, tmp_path):
+        from bdlz_tpu.faults import FaultPlan
+        from bdlz_tpu.parallel.sweep import run_sweep
+        from bdlz_tpu.utils.retry import RetryPolicy
+
+        base, static = self._setup()
+        root = str(tmp_path / "store")
+        plan = FaultPlan.from_obj({"faults": [
+            {"site": "step", "kind": "poison", "point": 2},
+        ]})
+        retry = RetryPolicy(max_attempts=2, backoff_s=0.0,
+                            sleep=lambda s: None)
+        chaos = run_sweep(base, AXES, static, chunk_size=4, n_y=400,
+                          cache=root, fault_plan=plan, retry=retry)
+        assert chaos.n_quarantined == 1
+        clean = run_sweep(base, AXES, static, chunk_size=4, n_y=400,
+                          cache=root)
+        assert clean.cache_hits == 0          # armed entries invisible
+        assert clean.n_failed == 0            # and no NaN leaked through
+        # ... and the chaos run can still hit its OWN entries
+        rechaos = run_sweep(base, AXES, static, chunk_size=4, n_y=400,
+                            cache=root, fault_plan=plan, retry=retry)
+        assert rechaos.cache_hits == 2
+
+
+class TestEmulatorBuildCache:
+    def test_warm_rebuild_is_bitwise_and_fully_hit(self, tmp_path):
+        from bdlz_tpu.emulator import AxisSpec, build_emulator
+
+        base = _base()
+        static = static_choices_from_config(base)._replace(
+            quad_panel_gl=False
+        )
+        spec = {
+            "m_chi_GeV": AxisSpec(0.9, 1.1, 3, "log"),
+            "T_p_GeV": AxisSpec(90.0, 110.0, 3, "log"),
+        }
+        root = str(tmp_path / "store")
+        kw = dict(rtol=1e-3, n_probe=8, n_holdout=16, max_rounds=2,
+                  n_y=400, chunk_size=32, seed=3)
+        s1 = Store(root)
+        art1, _ = build_emulator(base, spec, static, cache=s1, **kw)
+        assert s1.stats.writes > 0
+        s2 = Store(root)
+        art2, _ = build_emulator(base, spec, static, cache=s2, **kw)
+        assert s2.stats.misses == 0 and s2.stats.hits > 0
+        for f in art1.values:
+            np.testing.assert_array_equal(art1.values[f], art2.values[f])
+        assert art1.content_hash == art2.content_hash
+
+
+class TestCheckpointStaticIdentity:
+    """The PR-7 drift fix: the resolved StaticChoices joins the MCMC run
+    identity, so a quadrature-scheme flip invalidates resume instead of
+    silently splicing a trapezoid-era chain."""
+
+    def _logp(self):
+        import jax.numpy as jnp
+
+        def logp(theta):
+            r = (theta - jnp.array([1.0, -2.0])) / jnp.array([0.7, 1.3])
+            return -0.5 * jnp.sum(r * r)
+
+        return logp
+
+    def _init(self, W=16):
+        import jax
+
+        return 0.1 * np.asarray(
+            jax.random.normal(jax.random.PRNGKey(3), (W, 2))
+        )
+
+    def test_resolved_static_flip_invalidates_resume(self, tmp_path, capsys):
+        from bdlz_tpu.sampling import run_ensemble_checkpointed
+
+        st = static_choices_from_config(_base())._replace(
+            quad_panel_gl=False, ode_auto_h0=False,
+            ode_pi_controller=False, ode_tabulated_av=False,
+        )
+        out = str(tmp_path / "chain")
+        full = run_ensemble_checkpointed(
+            5, self._logp(), self._init(), n_steps=40, out_dir=out,
+            checkpoint_every=20, static=st,
+        )
+        assert full.segments == 2 and full.resumed_segments == 0
+        # same resolved static -> full resume
+        again = run_ensemble_checkpointed(
+            5, self._logp(), self._init(), n_steps=40, out_dir=out,
+            checkpoint_every=20, static=st,
+        )
+        assert again.resumed_segments == 2
+        # the resolved quadrature flips (the exact PR-4 hazard) -> the
+        # manifest is invalidated LOUDLY and nothing resumes
+        flipped = run_ensemble_checkpointed(
+            5, self._logp(), self._init(), n_steps=40, out_dir=out,
+            checkpoint_every=20, static=st._replace(quad_panel_gl=True),
+        )
+        assert flipped.resumed_segments == 0
+        assert "different run identity" in capsys.readouterr().err
+        # and a legacy (static-less) caller is also invalidated by the
+        # schema bump rather than resuming the static-keyed chain
+        legacy = run_ensemble_checkpointed(
+            5, self._logp(), self._init(), n_steps=40, out_dir=out,
+            checkpoint_every=20,
+        )
+        assert legacy.resumed_segments == 0
+
+
+class TestRegistryAndRollout:
+    def test_publish_fetch_roundtrip(self, tmp_path, tiny_emulator):
+        _, _, art, _ = tiny_emulator
+        store = Store(str(tmp_path / "store"))
+        h = publish_artifact(store, art)
+        assert h == art.content_hash
+        fetched = fetch_artifact(store, h)
+        for f in art.values:
+            np.testing.assert_array_equal(fetched.values[f], art.values[f])
+        # republishing the same content is a no-op (same hash = same bytes)
+        assert publish_artifact(store, art) == h
+
+    def test_fetch_rejects_absent_and_impersonating(self, tmp_path,
+                                                    tiny_emulator):
+        from bdlz_tpu.emulator.artifact import EmulatorArtifactError
+
+        _, _, art, _ = tiny_emulator
+        store = Store(str(tmp_path / "store"))
+        with pytest.raises(EmulatorArtifactError, match="no published"):
+            fetch_artifact(store, "0" * 16)
+        h = publish_artifact(store, art)
+        # rename the entry under a different hash: the fetch re-verifies
+        # and refuses the impersonating entry
+        src = os.path.join(store.root, "emulator_artifact", h)
+        dst = os.path.join(store.root, "emulator_artifact", "f" * 16)
+        os.rename(src, dst)
+        with pytest.raises(EmulatorArtifactError, match="impersonating"):
+            fetch_artifact(store, "f" * 16)
+
+    def test_corrupt_registry_entry_deleted_on_fetch(self, tmp_path,
+                                                     tiny_emulator):
+        from bdlz_tpu.emulator.artifact import EmulatorArtifactError
+
+        _, _, art, _ = tiny_emulator
+        store = Store(str(tmp_path / "store"))
+        h = publish_artifact(store, art)
+        npz = os.path.join(store.root, "emulator_artifact", h, "artifact.npz")
+        with open(npz, "wb") as f:
+            f.write(b"torn copy")
+        with pytest.raises(EmulatorArtifactError):
+            fetch_artifact(store, h)
+        assert not os.path.exists(os.path.dirname(npz))  # entry evicted
+        # a re-publish starts clean
+        assert publish_artifact(store, art) == h
+        assert fetch_artifact(store, h).content_hash == h
+
+    def test_rollout_stage_by_content_hash(self, tmp_path, tiny_emulator):
+        from bdlz_tpu.serve.fleet import FleetService
+        from bdlz_tpu.serve.rollout import ArtifactRollout
+
+        base, _, art, _ = tiny_emulator
+        store = Store(str(tmp_path / "store"))
+        h = publish_artifact(store, art)
+        svc = FleetService(art, base, max_batch_size=8, n_replicas=1)
+        rollout = ArtifactRollout(svc, store=store)
+        staged = rollout.stage(h, warm=False)
+        assert staged == h and rollout.staged_hash == h
