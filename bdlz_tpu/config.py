@@ -172,6 +172,31 @@ class Config:
     # Store root for cached sweep chunks / published artifacts; None
     # defers to the BDLZ_CACHE_ROOT env var.
     cache_root: Optional[str] = None
+    # ---- emulator seam/gating knobs (bdlz_tpu/emulator/multidomain.py,
+    # serve/service.py; docs/perf_notes.md "Seam-split emulator
+    # domains") ----
+    # Tri-state seam-split gate (ode_* pattern): None = engine decides
+    # (build_emulator splits along the T = m/3 flux-seam band iff the
+    # box crosses it), True = require the split (a non-crossing box is
+    # an error), False = force the legacy single-domain build.
+    seam_split: Optional[bool] = None
+    # Exact-fallback error gate for the serving layer: None = engine
+    # decides (gate at the artifact's recorded rtol_target when it
+    # carries per-cell predicted-error estimates), false = gate on
+    # domain membership only (the pre-gate behavior), a positive float
+    # = gate at that relative tolerance.  Serving-side only: it selects
+    # WHICH path answers a query, so it is excluded from every result
+    # identity (EMULATOR_CONFIG_FIELDS below).
+    error_gate_tol: "Optional[bool | float]" = None
+    # Posterior weighting of the emulator build's refinement criterion:
+    # None = curvature-only (the legacy build), "planck" = multiply the
+    # a-posteriori interval estimates and probe errors by the Planck
+    # 2018 likelihood weight of the interim surface, so the build spends
+    # exact sweep points where posterior mass concentrates and coarsens
+    # dead regions.  Node placement (and therefore the artifact bytes)
+    # depends on it, so the RESOLVED value joins the artifact identity
+    # as its own key (emulator.artifact.build_identity).
+    posterior_weight: Optional[str] = None
 
 
 def default_config() -> Dict[str, Any]:
@@ -251,6 +276,25 @@ SERVE_CONFIG_FIELDS = ("n_replicas", "queue_bound")
 #: artifact the moment an operator pointed the cache at a new disk.
 CACHE_CONFIG_FIELDS = ("cache_enabled", "cache_root")
 
+#: Emulator seam/gating knobs, excluded from the CONFIG identity payload
+#: deliberately (pinned in tests/test_config.py):
+#: * ``seam_split`` — build orchestration: it changes the artifact's
+#:   STRUCTURE (one surface vs a stitched bundle), and every structure
+#:   self-identifies through its own content hash — keying the knob into
+#:   config identities would stale sweep manifests it cannot affect;
+#: * ``error_gate_tol`` — pure serving policy: it selects WHICH path
+#:   answers a query (emulator vs exact), never what either path
+#:   computes, exactly like the fleet-shape knobs above;
+#: * ``posterior_weight`` — DOES affect artifact bytes (node placement),
+#:   but its single identity home is the artifact's own
+#:   ``posterior_weight`` key (``emulator.artifact.build_identity``),
+#:   mirroring ``quad_panel_gl`` — folding it into the shared config
+#:   payload would also stale sweep/MCMC identities it cannot touch.
+EMULATOR_CONFIG_FIELDS = ("seam_split", "error_gate_tol", "posterior_weight")
+
+#: Valid values of the ``posterior_weight`` knob (None = off).
+VALID_POSTERIOR_WEIGHTS = ("planck",)
+
 
 def config_identity_dict(cfg: Config) -> Dict[str, Any]:
     """The config as a resume-identity payload.
@@ -272,6 +316,7 @@ def config_identity_dict(cfg: Config) -> Dict[str, Any]:
             or k in ROBUSTNESS_CONFIG_FIELDS
             or k in SERVE_CONFIG_FIELDS
             or k in CACHE_CONFIG_FIELDS
+            or k in EMULATOR_CONFIG_FIELDS
         ):
             continue
         if k in RESULT_AFFECTING_EXTENSIONS or getattr(cfg, k) != defaults[k]:
@@ -342,10 +387,32 @@ def validate(cfg: Config, backend: Optional[str] = None) -> Config:
         raise ConfigError("ode_rtol and ode_atol must be positive")
     for k in ("ode_auto_h0", "ode_pi_controller", "ode_tabulated_av",
               "quad_panel_gl", "fault_injection", "retry_enabled",
-              "cache_enabled"):
+              "cache_enabled", "seam_split"):
         v = getattr(cfg, k)
         if v is not None and not isinstance(v, bool):
             raise ConfigError(f"{k} must be true, false, or null, got {v!r}")
+    egt = cfg.error_gate_tol
+    if egt is not None:
+        if egt is True:
+            raise ConfigError(
+                "error_gate_tol=true is ambiguous: use null for the "
+                "artifact's recorded rtol_target, false to disable the "
+                "gate, or a positive tolerance"
+            )
+        if egt is not False and not (
+            isinstance(egt, (int, float)) and float(egt) > 0.0
+        ):
+            raise ConfigError(
+                f"error_gate_tol must be null, false, or a positive "
+                f"relative tolerance, got {egt!r}"
+            )
+    if cfg.posterior_weight is not None and (
+        cfg.posterior_weight not in VALID_POSTERIOR_WEIGHTS
+    ):
+        raise ConfigError(
+            f"posterior_weight={cfg.posterior_weight!r} is not one of "
+            f"{VALID_POSTERIOR_WEIGHTS} (or null)"
+        )
     if cfg.retry_max_attempts < 1:
         raise ConfigError("retry_max_attempts must be >= 1")
     if cfg.retry_backoff_s < 0.0:

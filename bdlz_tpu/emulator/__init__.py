@@ -20,11 +20,31 @@ from bdlz_tpu.emulator.build import (  # noqa: F401
     BuildReport,
     EmulatorBuildError,
     build_emulator,
+    cell_error_estimates,
     make_exact_evaluator,
 )
 from bdlz_tpu.emulator.grid import (  # noqa: F401
+    artifact_hull,
+    domain_artifacts,
+    domain_error_table,
+    error_floor,
+    has_error_grid,
     in_domain_one,
     interp_log_fields,
     make_domain_fn,
+    make_error_fn,
     make_query_fn,
+    predicted_error_one,
+    select_domains,
+)
+from bdlz_tpu.emulator.multidomain import (  # noqa: F401
+    MULTI_SCHEMA_VERSION,
+    MultiDomainArtifact,
+    MultiDomainBuildError,
+    MultiDomainBuildReport,
+    build_seam_split_emulator,
+    load_any_artifact,
+    load_multidomain_artifact,
+    save_multidomain_artifact,
+    seam_band_for_box,
 )

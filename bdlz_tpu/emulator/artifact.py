@@ -31,7 +31,11 @@ import numpy as np  # host-side use only; jitted paths go through the backend.py
 
 #: Bump whenever the artifact layout or manifest meaning changes: a
 #: version mismatch at load is an explicit error, never a reinterpret.
-SCHEMA_VERSION = 1
+#: v2 (seam-split PR): artifacts persist the per-cell a-posteriori
+#: predicted-error grid the refiner computes (the serve layer's exact-
+#: fallback gate) — it joins the content hash, so v1 artifacts reject
+#: LOUDLY at the version check and must be rebuilt.
+SCHEMA_VERSION = 2
 
 #: The pipeline outputs an artifact carries (YieldsResult field order).
 FIELDS = ("Y_B", "Y_chi", "rho_B_kg_m3", "rho_DM_kg_m3", "DM_over_B")
@@ -54,6 +58,14 @@ class EmulatorArtifact(NamedTuple):
     values: Dict[str, np.ndarray]          # field -> (n_1, ..., n_d) f64
     identity: Dict[str, Any]               # resolved config/static/n_y/impl
     manifest: Dict[str, Any]               # full manifest payload
+    #: Per-cell a-posteriori relative-error estimate (|f2|h^2/8*ln10,
+    #: maxed over fields and axes), shape ``(n_1-1, ..., n_d-1)`` — the
+    #: numbers the refiner steered on, persisted so the serving layer
+    #: can gate exact fallback on PREDICTED error instead of only on
+    #: domain membership.  None on artifacts that never computed one
+    #: (hand-assembled fixtures); the serve gate then degrades to the
+    #: artifact-level held-out number.
+    predicted_error: "np.ndarray | None" = None
 
     @property
     def domain(self) -> Dict[str, Tuple[float, float]]:
@@ -61,6 +73,16 @@ class EmulatorArtifact(NamedTuple):
             name: (float(nodes[0]), float(nodes[-1]))
             for name, nodes in zip(self.axis_names, self.axis_nodes)
         }
+
+    @property
+    def hull(self) -> Tuple[np.ndarray, np.ndarray]:
+        """(lo, hi) corner vectors of the box, in axis order — the one
+        rule every warm-start probe and bench trace generator uses, and
+        the piece of the interface a multi-domain bundle shares."""
+        return (
+            np.asarray([float(n[0]) for n in self.axis_nodes]),
+            np.asarray([float(n[-1]) for n in self.axis_nodes]),
+        )
 
     @property
     def n_points(self) -> int:
@@ -83,10 +105,14 @@ class EmulatorArtifact(NamedTuple):
         return artifact_hash(
             self.axis_names, self.axis_nodes, self.axis_scales,
             self.values, self.identity,
+            predicted_error=self.predicted_error,
         )
 
 
-def build_identity(base, static, n_y: int, impl: str) -> Dict[str, Any]:
+def build_identity(
+    base, static, n_y: int, impl: str,
+    posterior_weight: "str | None" = None,
+) -> Dict[str, Any]:
     """The physics identity an artifact is valid for.
 
     Same ingredients as ``parallel.sweep.grid_hash`` (config through
@@ -104,11 +130,23 @@ def build_identity(base, static, n_y: int, impl: str) -> Dict[str, Any]:
     :func:`check_identity` / the serve + likelihood layers).  The knob
     is normalized OUT of the static tuple so this key is its single
     home in the identity.
+
+    ``posterior_weight`` follows the same single-home pattern: when the
+    build's refinement criterion was posterior-weighted (explicit
+    argument, else the base config's knob), the resolved weight name is
+    its own ``posterior_weight`` key — weighted and unweighted surfaces
+    over the same box place nodes differently and must never be
+    confused, while a consumer that states no expectation matches
+    either (``check_identity``'s wildcard rule).  The knob is excluded
+    from the config payload (``config.EMULATOR_CONFIG_FIELDS``), so
+    this key is its single home too.
     """
     from bdlz_tpu.config import ROBUSTNESS_STATIC_FIELDS, config_identity_dict
 
     quad = static.quad_panel_gl
     st = static._replace(quad_panel_gl=None)
+    if posterior_weight is None:
+        posterior_weight = getattr(base, "posterior_weight", None)
     out = {
         "base": config_identity_dict(base),
         # robustness knobs (retry/fault gates) are orchestration-only
@@ -123,6 +161,8 @@ def build_identity(base, static, n_y: int, impl: str) -> Dict[str, Any]:
     }
     if quad is not None:
         out["quad_panel_gl"] = bool(quad)
+    if posterior_weight is not None:
+        out["posterior_weight"] = str(posterior_weight)
     return out
 
 
@@ -132,24 +172,28 @@ def artifact_hash(
     axis_scales: Sequence[str],
     values: Mapping[str, np.ndarray],
     identity: Mapping[str, Any],
+    predicted_error: "np.ndarray | None" = None,
 ) -> str:
-    """Content hash over axes + value bytes + identity + schema version.
+    """Content hash over axes + value bytes + error grid + identity +
+    schema version.
 
     The axis SCALES are part of the identity: they select each axis's
     interpolation coordinate, so the same table queried under a
-    different scale list returns different numbers.
+    different scale list returns different numbers.  The per-cell
+    predicted-error grid is hashed too: the serve layer gates exact
+    fallback on it, so tampering with it must be as loud as tampering
+    with the value table.
 
     Construction lives in the shared provenance layer
-    (:func:`bdlz_tpu.provenance.emulator_artifact_identity`); the digest
-    is byte-compatible with the pre-provenance implementation, so every
-    existing artifact on disk keeps loading (pinned in
-    ``tests/test_provenance.py``).
+    (:func:`bdlz_tpu.provenance.emulator_artifact_identity`); the pinned
+    construction in ``tests/test_provenance.py`` documents the current
+    (schema-2) byte rule.
     """
     from bdlz_tpu.provenance import emulator_artifact_identity
 
     return emulator_artifact_identity(
         axis_names, axis_nodes, axis_scales, values, identity,
-        SCHEMA_VERSION,
+        SCHEMA_VERSION, predicted_error=predicted_error,
     ).digest(16)
 
 
@@ -219,6 +263,20 @@ def _validate_table(artifact: EmulatorArtifact, where: str) -> None:
                 f"non-positive cell(s), first at grid index {idx} — the "
                 "log-space query kernel needs strictly positive values"
             )
+    if artifact.predicted_error is not None:
+        err = np.asarray(artifact.predicted_error)
+        cells = tuple(max(n - 1, 1) for n in shape)
+        if err.shape != cells:
+            raise EmulatorArtifactError(
+                f"{where}: predicted-error grid has shape {err.shape}, "
+                f"expected the cell shape {cells} from the axis node "
+                "counts"
+            )
+        if not np.all(np.isfinite(err)) or (err < 0.0).any():
+            raise EmulatorArtifactError(
+                f"{where}: predicted-error grid must be finite and "
+                ">= 0 — the serve layer gates exact fallback on it"
+            )
 
 
 def save_artifact(out_dir: str, artifact: EmulatorArtifact) -> str:
@@ -240,6 +298,10 @@ def save_artifact(out_dir: str, artifact: EmulatorArtifact) -> str:
         arrays[f"axis_{name}"] = np.asarray(nodes, dtype=np.float64)
     for name, vals in artifact.values.items():
         arrays[f"field_{name}"] = np.asarray(vals, dtype=np.float64)
+    if artifact.predicted_error is not None:
+        arrays["predicted_error"] = np.asarray(
+            artifact.predicted_error, dtype=np.float64
+        )
     from bdlz_tpu.utils.io import atomic_savez
 
     atomic_savez(npz_path, **arrays)
@@ -251,10 +313,12 @@ def save_artifact(out_dir: str, artifact: EmulatorArtifact) -> str:
         n: s for n, s in zip(artifact.axis_names, artifact.axis_scales)
     }
     manifest["fields"] = sorted(artifact.values)
+    manifest["error_grid"] = artifact.predicted_error is not None
     manifest["identity"] = artifact.identity
     manifest["hash"] = artifact_hash(
         artifact.axis_names, artifact.axis_nodes, artifact.axis_scales,
         artifact.values, artifact.identity,
+        predicted_error=artifact.predicted_error,
     )
     atomic_write_json(os.path.join(out_dir, "manifest.json"), manifest, indent=2)
     return out_dir
@@ -287,6 +351,13 @@ def load_artifact(
         raise EmulatorArtifactError(
             f"cannot read emulator manifest {manifest_path}: {exc!r}"
         ) from exc
+    if manifest.get("kind") == "multi_domain":
+        raise EmulatorArtifactError(
+            f"{path} is a MULTI-DOMAIN emulator bundle (seam-split "
+            "domains stitched at query time); load it with "
+            "emulator.multidomain.load_multidomain_artifact or the "
+            "kind-dispatching emulator.load_any_artifact"
+        )
     version = manifest.get("schema_version")
     if version != SCHEMA_VERSION:
         raise EmulatorArtifactError(
@@ -316,6 +387,10 @@ def load_artifact(
                 n: np.asarray(data[f"field_{n}"], dtype=np.float64)
                 for n in field_names
             }
+            predicted_error = (
+                np.asarray(data["predicted_error"], dtype=np.float64)
+                if "predicted_error" in data.files else None
+            )
     except EmulatorArtifactError:
         raise
     except Exception as exc:
@@ -323,7 +398,10 @@ def load_artifact(
             f"cannot read emulator table {npz_path}: {exc!r}"
         ) from exc
 
-    got_hash = artifact_hash(axis_names, axis_nodes, axis_scales, values, identity)
+    got_hash = artifact_hash(
+        axis_names, axis_nodes, axis_scales, values, identity,
+        predicted_error=predicted_error,
+    )
     if got_hash != manifest.get("hash"):
         raise EmulatorArtifactError(
             f"emulator artifact {path} failed its content-hash check "
@@ -338,6 +416,7 @@ def load_artifact(
         values=values,
         identity=identity,
         manifest=manifest,
+        predicted_error=predicted_error,
     )
     _validate_table(artifact, where=f"load {path}")
     if expect_identity is not None:
@@ -363,12 +442,17 @@ def check_identity(
     expectation carries no key (tri-state ``None`` — "use whatever the
     artifact used") matches either; such callers must adopt the
     artifact's recorded scheme for their exact-fallback path, which the
-    serve/likelihood layers do.
+    serve/likelihood layers do.  The ``posterior_weight`` key follows
+    the same rule: strict when the caller names a weighting, wildcard
+    when their knob is unset (weighting moves nodes, never what the
+    exact engine computes at them — the fallback path is unaffected).
     """
     stored = dict(artifact.identity)
     want = dict(expect)
     if "quad_panel_gl" not in want:
         stored.pop("quad_panel_gl", None)
+    if "posterior_weight" not in want:
+        stored.pop("posterior_weight", None)
     sb = dict(stored.get("base", {}))
     wb = dict(want.get("base", {}))
     for key in set(exempt_config_keys) | set(artifact.axis_names):

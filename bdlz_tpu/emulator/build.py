@@ -83,6 +83,14 @@ class BuildReport(NamedTuple):
     #: that still aborts the build loudly).  The refinement continues
     #: around them; the count is mirrored into the artifact manifest.
     quarantined_probes: int = 0
+    #: The posterior weighting the refinement criterion ran under (None
+    #: = curvature-only).  With a weight armed, ``converged`` and the
+    #: splitting criterion are WEIGHTED statements; ``max_rel_err``
+    #: stays the raw held-out number (dead regions may exceed rtol by
+    #: design — the serve layer's error gate covers them), and
+    #: ``weighted_max_rel_err`` is the held-out error under the weight.
+    posterior_weight: "str | None" = None
+    weighted_max_rel_err: "float | None" = None
 
 
 def _axis_nodes(spec: AxisSpec) -> np.ndarray:
@@ -425,6 +433,7 @@ def _axis_interval_estimates(
     nodes: List[np.ndarray],
     scales: List[str],
     k: int,
+    weights: "np.ndarray | None" = None,
 ) -> "np.ndarray | None":
     """Per-interval a-posteriori error estimate along axis ``k``.
 
@@ -438,6 +447,12 @@ def _axis_interval_estimates(
     get split when their estimate exceeds the target.  Returns one
     estimate per interval (len n_k − 1), or None for a 2-node axis (no
     curvature information until a probe forces a split).
+
+    ``weights`` (node-level tensor over the full grid, in [floor, 1] —
+    see :func:`_posterior_node_weights`) multiplies the curvature
+    BEFORE the max over the rest of the grid: with the posterior hook
+    armed, an interval only demands a split where posterior mass and
+    curvature coincide, so the build coarsens dead regions by design.
     """
     u = np.asarray(axis_coord(np.asarray(nodes[k]), scales[k], np))
     n_k = len(u)
@@ -445,15 +460,126 @@ def _axis_interval_estimates(
         return None
     du = np.diff(u)
     c = np.zeros(n_k - 2)
+    w_flat = (
+        None if weights is None
+        else np.moveaxis(weights, k, 0).reshape(n_k, -1)
+    )
     for logv in log_values.values():
         f = np.moveaxis(logv, k, 0).reshape(n_k, -1)
         d1 = np.diff(f, axis=0) / du[:, None]
         d2 = 2.0 * np.diff(d1, axis=0) / (du[:-1] + du[1:])[:, None]
-        c = np.maximum(c, np.max(np.abs(d2), axis=1))
+        d2 = np.abs(d2)
+        if w_flat is not None:
+            d2 = d2 * w_flat[1:-1]
+        c = np.maximum(c, np.max(d2, axis=1))
     # node-level curvature (ends take their neighbor's), then per
     # interval the worse endpoint
     c_node = np.concatenate([c[:1], c, c[-1:]])
     return np.maximum(c_node[:-1], c_node[1:]) * du * du / 8.0 * _LN10
+
+
+def _node_to_cell_max(arr: np.ndarray) -> np.ndarray:
+    """Reduce a node-level tensor to cell level: per cell, the max over
+    its 2^d corners (pairwise max along every axis)."""
+    for k in range(arr.ndim):
+        lo = tuple(
+            slice(None, -1) if j == k else slice(None)
+            for j in range(arr.ndim)
+        )
+        hi = tuple(
+            slice(1, None) if j == k else slice(None)
+            for j in range(arr.ndim)
+        )
+        arr = np.maximum(arr[lo], arr[hi])
+    return arr
+
+
+def cell_error_estimates(
+    log_values: Dict[str, np.ndarray],
+    nodes: List[np.ndarray],
+    scales: List[str],
+) -> np.ndarray:
+    """Per-CELL a-posteriori relative-error estimate of the final table.
+
+    The same ``|f''|·h²/8·ln10`` linear-interpolation bound the
+    refinement steers on, but evaluated LOCALLY (no max over the rest
+    of the grid): for each axis the second divided differences of every
+    field in the axis's scale coordinate, endpoint-extended to node
+    level, reduced to cells by corner max, scaled by the cell's own
+    axis width, then maxed over axes and fields.  A 2-node axis carries
+    no curvature information and contributes 0 (its error is vouched
+    for by the probe pool alone — exactly the build's refinement
+    contract).  Shape ``(n_1-1, …, n_d-1)``; persisted into the
+    artifact so the serving layer can gate exact fallback per query.
+
+    Always UNWEIGHTED, even under a posterior-weighted build: the gate
+    must see the surface's honest local error — dead regions a weighted
+    build deliberately left coarse then fall back to the exact path,
+    which is the whole point of composing the two features.
+    """
+    d = len(nodes)
+    cells = tuple(len(a) - 1 for a in nodes)
+    total = np.zeros(cells)
+    for k in range(d):
+        u = np.asarray(axis_coord(np.asarray(nodes[k]), scales[k], np))
+        n_k = len(u)
+        if n_k < 3:
+            continue
+        du = np.diff(u)
+        du_shape = tuple(len(du) if j == k else 1 for j in range(d))
+        c_node = None
+        for logv in log_values.values():
+            f = np.moveaxis(logv, k, 0)
+            d1 = np.diff(f, axis=0) / du.reshape(-1, *([1] * (d - 1)))
+            d2 = 2.0 * np.diff(d1, axis=0) / (
+                (du[:-1] + du[1:]).reshape(-1, *([1] * (d - 1)))
+            )
+            d2 = np.abs(d2)
+            ext = np.concatenate([d2[:1], d2, d2[-1:]], axis=0)
+            ext = np.moveaxis(ext, 0, k)
+            c_node = ext if c_node is None else np.maximum(c_node, ext)
+        est_k = _node_to_cell_max(c_node) * (
+            du.reshape(du_shape) ** 2
+        ) / 8.0 * _LN10
+        total = np.maximum(total, est_k)
+    return total
+
+
+def _posterior_node_weights(
+    log_values: Dict[str, np.ndarray], floor: float = 1e-3
+) -> Tuple[np.ndarray, float]:
+    """Planck-likelihood weight of every grid node, from the surface
+    itself: ``w = clip(exp(logp − max logp), floor, 1)`` with the
+    Planck Gaussian logp evaluated on the stored log10(ρ_B), log10(ρ_DM)
+    tables (``sampling.likelihoods.planck_gaussian_logp`` — the hook the
+    tentpole names).  The floor keeps dead regions under COARSE control
+    instead of none (a served query there still meets rtol/floor, and
+    the per-cell error gate covers the rest).  Returns (weights,
+    max_logp) — the max is the normalization probes reuse.
+    """
+    from bdlz_tpu.constants import RHO_CRIT_OVER_H2_KG_M3
+    from bdlz_tpu.sampling.likelihoods import planck_gaussian_logp
+
+    ob = 10.0 ** log_values["rho_B_kg_m3"] / RHO_CRIT_OVER_H2_KG_M3
+    od = 10.0 ** log_values["rho_DM_kg_m3"] / RHO_CRIT_OVER_H2_KG_M3
+    lp = np.asarray(planck_gaussian_logp(ob, od))
+    lp_max = float(lp.max())
+    return np.clip(np.exp(lp - lp_max), floor, 1.0), lp_max
+
+
+def _posterior_probe_weights(
+    exact: Dict[str, np.ndarray], lp_max: float, floor: float = 1e-3
+) -> np.ndarray:
+    """The same weight at probe points, from their EXACT values (paid
+    anyway), normalized against the node grid's max logp."""
+    from bdlz_tpu.constants import RHO_CRIT_OVER_H2_KG_M3
+    from bdlz_tpu.sampling.likelihoods import planck_gaussian_logp
+
+    lp = np.asarray(planck_gaussian_logp(
+        exact["rho_B_kg_m3"] / RHO_CRIT_OVER_H2_KG_M3,
+        exact["rho_DM_kg_m3"] / RHO_CRIT_OVER_H2_KG_M3,
+    ))
+    return np.clip(np.exp(lp - lp_max), floor, 1.0)
 
 
 def build_emulator(
@@ -478,6 +604,8 @@ def build_emulator(
     fault_plan=None,
     retry=None,
     cache=None,
+    seam_split: Optional[bool] = None,
+    posterior_weight: Optional[str] = None,
 ) -> Tuple[EmulatorArtifact, BuildReport]:
     """Build (and optionally save) an error-controlled yield-surface emulator.
 
@@ -501,8 +629,27 @@ def build_emulator(
     to gather with a bit-identical surface (the ``sweep_cache`` bench
     line measures exactly this), and an overlapping rebuild reuses
     whatever hyperplane slices an earlier build already paid for.
+
+    ``seam_split`` (tri-state, ``Config.seam_split`` when None): a box
+    crossing the T = m/3 flux-seam band is split at the band into one
+    single-scheme sub-artifact per side and returned as a
+    :class:`~bdlz_tpu.emulator.multidomain.MultiDomainArtifact` (with a
+    :class:`~bdlz_tpu.emulator.multidomain.MultiDomainBuildReport`)
+    instead of grinding first-order refinement against the diagonal
+    kink — see ``emulator/multidomain.py``.  ``posterior_weight``
+    ("planck", or ``Config.posterior_weight`` when None) multiplies the
+    refinement criterion by the Planck-likelihood weight of the interim
+    surface: the build spends exact sweep points where posterior mass
+    concentrates and coarsens dead regions (their held-out error may
+    exceed ``rtol`` by design — the persisted per-cell estimates keep
+    the serving layer's error gate honest there), and the resolved
+    weight name joins the artifact identity.
     """
-    from bdlz_tpu.config import static_choices_from_config, validate
+    from bdlz_tpu.config import (
+        VALID_POSTERIOR_WEIGHTS,
+        static_choices_from_config,
+        validate,
+    )
     from bdlz_tpu.parallel.sweep import AXIS_MAP
 
     t0 = time.time()
@@ -518,6 +665,35 @@ def build_emulator(
     if unknown:
         raise EmulatorBuildError(
             f"unknown emulator axes {unknown}; valid: {sorted(AXIS_MAP)}"
+        )
+    pw = (
+        posterior_weight if posterior_weight is not None
+        else getattr(base, "posterior_weight", None)
+    )
+    if pw is not None and pw not in VALID_POSTERIOR_WEIGHTS:
+        raise EmulatorBuildError(
+            f"posterior_weight={pw!r} is not one of "
+            f"{VALID_POSTERIOR_WEIGHTS} (or None)"
+        )
+
+    # --- seam-split resolution (tri-state; emulator/multidomain.py) ---
+    from bdlz_tpu.emulator.multidomain import (
+        build_seam_split_emulator,
+        resolve_seam_split,
+    )
+
+    band = resolve_seam_split(
+        base, spec, seam_split, rtol=float(rtol), safety=float(safety),
+    )
+    if band is not None:
+        return build_seam_split_emulator(
+            base, spec, static, band=band, out_dir=out_dir,
+            event_log=event_log, rtol=rtol, safety=safety,
+            n_probe=n_probe, n_holdout=n_holdout, max_rounds=max_rounds,
+            max_nodes_per_axis=max_nodes_per_axis, seed=seed, n_y=n_y,
+            impl=impl, chunk_size=chunk_size, mesh=mesh,
+            require_converged=require_converged, fault_plan=fault_plan,
+            retry=retry, cache=cache, posterior_weight=pw,
         )
     # Engine resolution mirrors run_sweep, and is done HERE (once) so the
     # product population, the probe evaluations, and the artifact identity
@@ -646,10 +822,22 @@ def build_emulator(
         pool_probes = np.concatenate([pool_probes, probes])
         for f in FIELDS:
             pool_exact[f] = np.concatenate([pool_exact[f], exact[f]])
+        # posterior weighting (armed hook): node- and probe-level Planck
+        # weights of the CURRENT surface, recomputed each round — the
+        # criterion below then asks for accuracy only where posterior
+        # mass lives, coarsening dead regions by the weight floor
+        w_nodes = None
+        lp_max = 0.0
+        if pw is not None:
+            w_nodes, lp_max = _posterior_node_weights(log_values)
         if pool_probes.shape[0]:
             emu = _emulated_fields(nodes, scales, log_values, pool_probes)
             errs = _probe_errors(emu, pool_exact)
-            failing = np.flatnonzero(errs > refine_tol)
+            score = (
+                errs * _posterior_probe_weights(pool_exact, lp_max)
+                if pw is not None else errs
+            )
+            failing = np.flatnonzero(score > refine_tol)
         else:
             # every probe so far was infrastructure-quarantined: nothing
             # to score this round (and nothing to converge on — the
@@ -664,7 +852,9 @@ def build_emulator(
         # 200-node axis has more intervals than a round has probes).
         curv: Dict[int, List[Tuple[float, float]]] = {}
         for k in range(len(axis_names)):
-            est = _axis_interval_estimates(log_values, nodes, scales, k)
+            est = _axis_interval_estimates(
+                log_values, nodes, scales, k, weights=w_nodes
+            )
             if est is None:
                 continue
             ax = nodes[k]
@@ -792,14 +982,27 @@ def build_emulator(
         _emulated_fields(nodes, scales, log_values, held), exact
     )
     max_rel_err = float(held_errs.max())
+    weighted_max_rel_err = None
+    if pw is not None:
+        _w_final, lp_max_final = _posterior_node_weights(log_values)
+        weighted_max_rel_err = float(
+            (held_errs * _posterior_probe_weights(exact, lp_max_final)).max()
+        )
     if not converged:
         msg = (
             f"emulator refinement exhausted {max_rounds} rounds with "
             f"held-out max rel err {max_rel_err:.3e} vs target {rtol:.1e}"
         )
+        if pw is not None:
+            msg += f" (posterior-weighted: {weighted_max_rel_err:.3e})"
         if require_converged:
             raise EmulatorBuildError(msg)
         print(f"[emulator] WARNING: {msg}", file=sys.stderr)
+
+    # the per-cell a-posteriori error grid the refinement steered on —
+    # persisted (and content-hashed) so the serving layer can gate exact
+    # fallback on PREDICTED error instead of only on domain membership
+    predicted = cell_error_estimates(log_values, nodes, scales)
 
     seconds = time.time() - t0
     report = BuildReport(
@@ -811,27 +1014,35 @@ def build_emulator(
         build_seconds=round(seconds, 3),
         axis_nodes={k: len(a) for k, a in zip(axis_names, nodes)},
         quarantined_probes=int(n_quarantined_probes),
+        posterior_weight=pw,
+        weighted_max_rel_err=weighted_max_rel_err,
     )
+    manifest = {
+        "rtol_target": float(rtol),
+        "max_rel_err": max_rel_err,
+        "converged": bool(converged),
+        "refinement_rounds": len(rounds),
+        "build_seconds": report.build_seconds,
+        "n_exact_evals": report.n_exact_evals,
+        "quarantined_probes": int(n_quarantined_probes),
+        "max_cell_est": float(predicted.max(initial=0.0)),
+        "axis_scales": {k: spec[k].scale for k in axis_names},
+        "domain": {
+            k: [float(a[0]), float(a[-1])]
+            for k, a in zip(axis_names, nodes)
+        },
+    }
+    if pw is not None:
+        manifest["posterior_weight"] = pw
+        manifest["weighted_max_rel_err"] = weighted_max_rel_err
     artifact = EmulatorArtifact(
         axis_names=tuple(axis_names),
         axis_nodes=tuple(nodes),
         axis_scales=tuple(scales),
         values=values,
-        identity=build_identity(base, static, n_y, impl),
-        manifest={
-            "rtol_target": float(rtol),
-            "max_rel_err": max_rel_err,
-            "converged": bool(converged),
-            "refinement_rounds": len(rounds),
-            "build_seconds": report.build_seconds,
-            "n_exact_evals": report.n_exact_evals,
-            "quarantined_probes": int(n_quarantined_probes),
-            "axis_scales": {k: spec[k].scale for k in axis_names},
-            "domain": {
-                k: [float(a[0]), float(a[-1])]
-                for k, a in zip(axis_names, nodes)
-            },
-        },
+        identity=build_identity(base, static, n_y, impl, posterior_weight=pw),
+        manifest=manifest,
+        predicted_error=predicted,
     )
     if event_log is not None:
         event_log.emit(

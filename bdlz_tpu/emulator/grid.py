@@ -11,16 +11,77 @@ to hit.  Everything inside the kernel is pure gathers + FMAs on
 ``jnp`` arrays captured at closure time — trace-safe, vmapped, jitted
 once per artifact shape (the closure pins the arrays, so one compiled
 program serves every query batch of the same length).
+
+Every kernel builder here accepts a single-domain
+:class:`~bdlz_tpu.emulator.artifact.EmulatorArtifact` OR a seam-split
+:class:`~bdlz_tpu.emulator.multidomain.MultiDomainArtifact`: the
+multi-domain case evaluates every domain's (identical-arithmetic)
+stencil and routes each query to the domain that contains it with a
+``where`` select — per-domain values are therefore BIT-identical to a
+standalone query of that sub-artifact (pinned in
+``tests/test_multidomain.py``); a query inside no domain (the seam
+band, or outside the hull) is simply out-of-domain and takes whatever
+fallback policy the caller owns.
+
+The per-cell PREDICTED ERROR kernel (:func:`make_error_fn`) gathers the
+artifact's persisted a-posteriori estimate for the cell a query lands
+in; an artifact that missed its advertised tolerance (``converged``
+false — its estimates demonstrably under-predicted somewhere) is
+floored at its held-out ``max_rel_err``, so the serve layer's error
+gate can never trust a surface more than its own validation did.
 """
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, Sequence
+from typing import Any, Callable, Dict, Sequence, Tuple
 
 import numpy as np  # host-side use only; jitted paths go through the backend.py xp seam (bdlz-lint R1 audit)
 
 from bdlz_tpu.emulator.artifact import EmulatorArtifact
 
 Array = Any
+
+
+def domain_artifacts(artifact) -> Tuple[EmulatorArtifact, ...]:
+    """The single-domain artifacts behind ``artifact`` (itself, or a
+    multi-domain bundle's ordered domain tuple) — the one adapter every
+    kernel builder and serving front goes through."""
+    domains = getattr(artifact, "domains", None)
+    if domains is not None:
+        return tuple(domains)
+    return (artifact,)
+
+
+def artifact_hull(artifact) -> Tuple[np.ndarray, np.ndarray]:
+    """(lo, hi) corner vectors of the artifact's overall box (the union
+    hull for a multi-domain bundle) — warm-start probes and bench trace
+    generators use this instead of reaching into ``axis_nodes``."""
+    return artifact.hull
+
+
+def error_floor(artifact) -> float:
+    """The artifact-level lower bound on its predicted error.
+
+    A build that CONVERGED (pool clean, every interval estimate under
+    the internal target, held-out inside tolerance) has earned per-cell
+    trust: floor 0.0.  A build that missed its contract gets +inf: its
+    own estimates demonstrably failed to control the error (MEASURED on
+    the seam box: a held-out draw can score 8e-5 while the surface
+    serves answers 8e-3 wrong — second differences straddling a kink
+    under-predict), so no finite per-cell statement from it is
+    trustworthy and any active error gate routes every in-domain query
+    to the exact path — the old "serve exact" policy for untrusted
+    surfaces, now automatic and measured.  An operator who wants the
+    unverified surface anyway disables the gate explicitly
+    (``error_gate_tol=false``).
+    """
+    return 0.0 if artifact.manifest.get("converged") is True else float("inf")
+
+
+def has_error_grid(artifact) -> bool:
+    """True when every domain carries a per-cell predicted-error grid."""
+    return all(
+        d.predicted_error is not None for d in domain_artifacts(artifact)
+    )
 
 
 def axis_coord(x: Array, scale: str, xp) -> Array:
@@ -127,41 +188,147 @@ def device_tables(artifact: EmulatorArtifact, fields: Sequence[str]):
     return nodes, logv
 
 
-def make_query_fn(
-    artifact: EmulatorArtifact, field: str = "DM_over_B"
-) -> Callable:
+def predicted_error_one(
+    theta: Array,
+    axis_nodes: Sequence[Array],
+    error_grid: Array,
+    floor,
+    xp,
+) -> Array:
+    """Predicted relative error of the cell one (d,) query lands in.
+
+    Same clamped bracketing rule as :func:`interp_log_fields` (so the
+    gathered cell IS the interpolation cell), then a single gather from
+    the persisted ``(n_1-1, ..., n_d-1)`` estimate grid, floored at the
+    artifact-level :func:`error_floor`.  Trace-safe: pure clips,
+    searchsorted, and one gather.
+    """
+    idx = []
+    for k, nodes in enumerate(axis_nodes):
+        n_k = nodes.shape[0]
+        x = xp.clip(theta[k], nodes[0], nodes[-1])
+        idx.append(xp.clip(
+            xp.searchsorted(nodes, x, side="right") - 1, 0, n_k - 2
+        ).astype("int32"))
+    return xp.maximum(error_grid[tuple(idx)], floor)
+
+
+def domain_error_table(dom: EmulatorArtifact, xp):
+    """The device-resident (error_grid, floor) pair of one domain; a
+    grid-less domain degrades to a constant grid at its floor."""
+    floor = error_floor(dom)
+    if dom.predicted_error is None:
+        cells = tuple(len(n) - 1 for n in dom.axis_nodes)
+        grid = np.zeros(cells)
+    else:
+        grid = np.asarray(dom.predicted_error, dtype=np.float64)
+    return xp.asarray(grid), floor
+
+
+def select_domains(theta, tables, eval_one, xp):
+    """THE multi-domain routing rule, shared by every jitted consumer
+    (query/error kernels here, the fleet's fused replica kernel, the
+    likelihood fast mode): evaluate ``eval_one(table, theta) ->
+    (payload_tuple, inside)`` per domain and fold a ``where`` select —
+    the FIRST domain's payload is the out-of-domain default (edge-
+    clamped; callers mask via the returned ``inside_any``), later
+    domains overwrite where they contain the query.  Domains are
+    disjoint by construction, so at most one select fires and a
+    contained query's payload is BIT-identical to evaluating that
+    domain alone.  Returns ``(payload_tuple, inside_any)``."""
+    out = None
+    inside_any = False
+    for table in tables:
+        payload, inside = eval_one(table, theta)
+        if out is None:
+            out = list(payload)
+        else:
+            out = [xp.where(inside, p, o) for p, o in zip(payload, out)]
+        inside_any = xp.logical_or(inside_any, inside)
+    return tuple(out), inside_any
+
+
+def make_query_fn(artifact, field: str = "DM_over_B") -> Callable:
     """Jitted, vmapped ``query(thetas (B, d)) -> values (B,)``.
 
     Compiles once per (artifact shape, batch length): the node/value
     arrays are closure-captured device constants, so repeated calls at
     a fixed batch size reuse one XLA program — the serving layer pads
-    its batches to a fixed size for exactly this reason.
+    its batches to a fixed size for exactly this reason.  A
+    multi-domain bundle routes each query to its containing domain via
+    a ``where`` select over the per-domain stencils (domains are
+    disjoint, so at most one select fires; a query in no domain returns
+    the FIRST domain's edge-clamped value, which the caller masks via
+    :func:`make_domain_fn`).
     """
-    if field not in artifact.values:
-        raise KeyError(
-            f"field {field!r} not in artifact (has {sorted(artifact.values)})"
-        )
+    doms = domain_artifacts(artifact)
+    for dom in doms:
+        if field not in dom.values:
+            raise KeyError(
+                f"field {field!r} not in artifact "
+                f"(has {sorted(dom.values)})"
+            )
     import jax
     import jax.numpy as jnp
 
-    nodes, logv = device_tables(artifact, (field,))
-    scales = artifact.axis_scales
+    tables = [(device_tables(d, (field,)), d.axis_scales) for d in doms]
+
+    def eval_one(table, theta):
+        (nodes, logv), scales = table
+        val = 10.0 ** interp_log_fields(theta, nodes, scales, logv, jnp)[field]
+        return (val,), in_domain_one(theta, nodes, jnp)
 
     def one(theta):
-        log_f = interp_log_fields(theta, nodes, scales, logv, jnp)[field]
-        return 10.0 ** log_f
+        (val,), _inside = select_domains(theta, tables, eval_one, jnp)
+        return val
 
     return jax.jit(jax.vmap(one))
 
 
-def make_domain_fn(artifact: EmulatorArtifact) -> Callable:
-    """Jitted, vmapped ``in_domain(thetas (B, d)) -> bool (B,)``."""
+def make_domain_fn(artifact) -> Callable:
+    """Jitted, vmapped ``in_domain(thetas (B, d)) -> bool (B,)`` — for a
+    multi-domain bundle, True iff SOME domain contains the query (the
+    seam band between domains is out-of-domain by construction)."""
     import jax
     import jax.numpy as jnp
 
-    nodes, _ = device_tables(artifact, ())
+    all_nodes = [device_tables(d, ())[0] for d in domain_artifacts(artifact)]
+
+    def eval_one(nodes, theta):
+        return (), in_domain_one(theta, nodes, jnp)
 
     def one(theta):
-        return in_domain_one(theta, nodes, jnp)
+        _none, inside = select_domains(theta, all_nodes, eval_one, jnp)
+        return inside
+
+    return jax.jit(jax.vmap(one))
+
+
+def make_error_fn(artifact) -> Callable:
+    """Jitted, vmapped ``predicted_error(thetas (B, d)) -> err (B,)``.
+
+    The serving layer's gate input: the per-cell a-posteriori estimate
+    of the cell each query lands in (floored at the artifact-level
+    :func:`error_floor`), routed to the containing domain exactly like
+    :func:`make_query_fn`.  Out-of-domain queries return the first
+    domain's clamped-cell value — meaningless but harmless, because the
+    gate only applies to in-domain traffic (OOD already falls back).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    doms = domain_artifacts(artifact)
+    tables = [
+        (device_tables(d, ())[0], domain_error_table(d, jnp)) for d in doms
+    ]
+
+    def eval_one(table, theta):
+        nodes, (grid, floor) = table
+        err = predicted_error_one(theta, nodes, grid, floor, jnp)
+        return (err,), in_domain_one(theta, nodes, jnp)
+
+    def one(theta):
+        (err,), _inside = select_domains(theta, tables, eval_one, jnp)
+        return err
 
     return jax.jit(jax.vmap(one))

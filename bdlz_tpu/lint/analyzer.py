@@ -104,6 +104,15 @@ STATIC_PARAM_NAMES = {
     # rule as above.
     "cache_enabled",
     "cache_root",
+    # emulator seam/gating knobs (emulator/multidomain.py, serve
+    # gating): the seam-split tri-state and posterior-weight name steer
+    # host-side build orchestration, and the error-gate tolerance is a
+    # host float compared against a GATHERED estimate on the host side
+    # of the layer boundary — none of them is ever tracer-valued.  Same
+    # specific-names-only rule as above.
+    "seam_split",
+    "error_gate_tol",
+    "posterior_weight",
     "n_y",
     "nz",
     "n_mu",

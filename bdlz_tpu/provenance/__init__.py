@@ -12,6 +12,7 @@ from bdlz_tpu.provenance.identity import (
     config_payload,
     emulator_artifact_identity,
     mcmc_segment_identity,
+    multidomain_artifact_identity,
     package_source_fingerprint,
     refcache_identity,
     reference_code_fingerprint,
@@ -41,6 +42,7 @@ __all__ = [
     "config_payload",
     "emulator_artifact_identity",
     "mcmc_segment_identity",
+    "multidomain_artifact_identity",
     "package_source_fingerprint",
     "refcache_identity",
     "reference_code_fingerprint",

@@ -173,12 +173,19 @@ def emulator_artifact_identity(
     values: Mapping[str, np.ndarray],
     identity: Mapping[str, Any],
     schema_version: int,
+    predicted_error: "np.ndarray | None" = None,
 ) -> Identity:
     """The emulator artifact content identity (``artifact_hash`` payload):
     JSON header (schema version, axes, scales, physics identity, field
-    list) followed by the field-sorted raw value bytes.  BYTE-COMPATIBLE
-    with the pre-provenance ``emulator.artifact.artifact_hash`` —
-    existing artifacts keep loading."""
+    list) followed by the field-sorted raw value bytes, then — schema 2
+    — the per-cell predicted-error grid bytes when the artifact carries
+    one (the serve layer GATES exact fallback on those numbers, so a
+    tampered error grid must fail the content hash exactly like a
+    tampered value table).  The schema-1 construction was
+    byte-compatible with the pre-provenance
+    ``emulator.artifact.artifact_hash``; schema 2 is a deliberate loud
+    bump (old artifacts reject at the version check, before any hash
+    work)."""
     payload = {
         "schema_version": int(schema_version),
         "axes": {
@@ -189,11 +196,42 @@ def emulator_artifact_identity(
         "identity": dict(identity),
         "fields": sorted(values),
     }
+    if predicted_error is not None:
+        payload["error_grid"] = True  # omit-at-absent: a grid-less
+        # artifact hashes exactly like a payload without the key
     parts: list = [("json", payload)]
     for name in sorted(values):
         parts.append(("text", name))
         parts.append(array_part(values[name]))
+    if predicted_error is not None:
+        parts.append(("text", "predicted_error"))
+        parts.append(array_part(predicted_error))
     return Identity("emulator_artifact", tuple(parts))
+
+
+def multidomain_artifact_identity(
+    domain_hashes: Sequence[str],
+    seam_band: Mapping[str, Any],
+    identity: Mapping[str, Any],
+    schema_version: int,
+) -> Identity:
+    """The composite identity of a multi-domain emulator bundle
+    (``emulator.multidomain.MultiDomainArtifact``): the ORDERED
+    per-domain content hashes (each already covering that domain's axes,
+    values, error grid, and physics identity), the seam-band descriptor
+    that routed the split, and the shared physics identity.  Any change
+    to any domain's bytes, to the band, or to the physics therefore
+    changes the composite hash — the registry and the rollout layer
+    agree on bundles through this one digest."""
+    return Identity(
+        "emulator_multidomain",
+        (("json", {
+            "schema_version": int(schema_version),
+            "domains": [str(h) for h in domain_hashes],
+            "seam_band": dict(seam_band),
+            "identity": dict(identity),
+        }),),
+    )
 
 
 def refcache_identity(grid, static, n_y: "int | None") -> Identity:

@@ -30,17 +30,20 @@ ARTIFACT_KIND = "emulator_artifact"
 
 
 def publish_artifact(store: Store, artifact) -> str:
-    """Publish an :class:`~bdlz_tpu.emulator.artifact.EmulatorArtifact`
-    (or an artifact directory path) into ``store``; returns the content
-    hash it is addressable by."""
-    from bdlz_tpu.emulator.artifact import (
-        EmulatorArtifact,
-        load_artifact,
-        save_artifact,
+    """Publish an :class:`~bdlz_tpu.emulator.artifact.EmulatorArtifact`,
+    a seam-split :class:`~bdlz_tpu.emulator.multidomain.MultiDomainArtifact`
+    bundle, or an artifact/bundle directory path into ``store``; returns
+    the content hash it is addressable by (the COMPOSITE hash for a
+    bundle — the whole bundle moves as one unit)."""
+    from bdlz_tpu.emulator.artifact import EmulatorArtifact, save_artifact
+    from bdlz_tpu.emulator.multidomain import (
+        MultiDomainArtifact,
+        load_any_artifact,
+        save_multidomain_artifact,
     )
 
-    if not isinstance(artifact, EmulatorArtifact):
-        artifact = load_artifact(str(artifact))
+    if not isinstance(artifact, (EmulatorArtifact, MultiDomainArtifact)):
+        artifact = load_any_artifact(str(artifact))
     content_hash = artifact.content_hash
     dest = os.path.join(store.root, ARTIFACT_KIND, content_hash)
     os.makedirs(os.path.join(store.root, ARTIFACT_KIND), mode=0o700,
@@ -50,7 +53,10 @@ def publish_artifact(store: Store, artifact) -> str:
         return content_hash  # same hash = same bytes; nothing to do
     tmp = tempfile.mkdtemp(dir=store.root, suffix=".tmp")
     try:
-        save_artifact(tmp, artifact)
+        if isinstance(artifact, MultiDomainArtifact):
+            save_multidomain_artifact(tmp, artifact)
+        else:
+            save_artifact(tmp, artifact)
         try:
             os.rename(tmp, dest)
         except OSError:
@@ -69,14 +75,16 @@ def publish_artifact(store: Store, artifact) -> str:
 
 
 def fetch_artifact(store: Store, content_hash: str):
-    """Load + fully validate the published artifact ``content_hash``.
+    """Load + fully validate the published artifact ``content_hash``
+    (kind-dispatched: a single artifact or a multi-domain bundle).
 
     Raises :class:`~bdlz_tpu.emulator.artifact.EmulatorArtifactError`
     when the entry is absent, fails any load-time validation, or its
     verified hash is not the requested one (an impersonating or
     renamed entry); a corrupt entry is deleted first, so the next
     publish starts clean."""
-    from bdlz_tpu.emulator.artifact import EmulatorArtifactError, load_artifact
+    from bdlz_tpu.emulator.artifact import EmulatorArtifactError
+    from bdlz_tpu.emulator.multidomain import load_any_artifact
 
     path = os.path.join(store.root, ARTIFACT_KIND, str(content_hash))
     if not os.path.isdir(path):
@@ -86,7 +94,7 @@ def fetch_artifact(store: Store, content_hash: str):
             f"{store.root}"
         )
     try:
-        artifact = load_artifact(path)
+        artifact = load_any_artifact(path)
     except EmulatorArtifactError:
         print(
             f"[registry] published artifact entry {path} failed validation; "

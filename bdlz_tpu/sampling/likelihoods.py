@@ -85,7 +85,11 @@ def make_pipeline_logprob(
     the Planck data.
 
     ``emulator`` (a loaded :class:`bdlz_tpu.emulator.EmulatorArtifact`,
-    or an artifact-directory path) switches logp to the EMULATOR-BACKED
+    a seam-split :class:`bdlz_tpu.emulator.MultiDomainArtifact` bundle
+    — identity-checked against its composite hash at load, walkers
+    routed to their containing domain in-jit, seam-band walkers scoring
+    −inf like any out-of-domain point — or an artifact/bundle directory
+    path) switches logp to the EMULATOR-BACKED
     FAST MODE: ρ_B and ρ_DM come from the artifact's jitted log-space
     interpolation instead of the per-walker exact pipeline — the whole
     reason the emulator exists, since every MCMC step evaluates the
@@ -194,14 +198,17 @@ def _make_emulator_logprob(
     """
     from bdlz_tpu.emulator import (
         EmulatorArtifact,
+        MultiDomainArtifact,
         build_identity,
         check_identity,
-        load_artifact,
+        domain_artifacts,
+        load_any_artifact,
     )
     from bdlz_tpu.emulator.grid import (
         device_tables,
         in_domain_one,
         interp_log_fields,
+        select_domains,
     )
 
     if n_lz:
@@ -210,8 +217,12 @@ def _make_emulator_logprob(
             "derivations: bake the LZ seam into the emulator's axes (e.g. "
             "sweep v_w with lz_profile at BUILD time) instead"
         )
-    if not isinstance(emulator, EmulatorArtifact):
-        emulator = load_artifact(str(emulator))
+    if not isinstance(emulator, (EmulatorArtifact, MultiDomainArtifact)):
+        # kind-dispatching load: a seam-split multi-domain bundle rides
+        # the same fast mode (its composite hash was verified at load;
+        # walkers inside the seam band belong to no domain and score
+        # -inf like any other out-of-domain point)
+        emulator = load_any_artifact(str(emulator))
     missing = [k for k in param_keys if k not in emulator.axis_names]
     if missing:
         raise ValueError(
@@ -235,8 +246,9 @@ def _make_emulator_logprob(
             str(emulator.identity.get("impl", "tabulated")),
         ),
     )
+    doms = domain_artifacts(emulator)
     pinned: dict = {}
-    for name, nodes in zip(emulator.axis_names, emulator.axis_nodes):
+    for k_ax, name in enumerate(emulator.axis_names):
         if name in param_keys:
             continue
         v = getattr(base, name)
@@ -246,19 +258,39 @@ def _make_emulator_logprob(
                 "pins it to None; set a concrete value"
             )
         v = float(v)
-        if not (float(nodes[0]) <= v <= float(nodes[-1])):
+        # membership per DOMAIN, not per hull: a value pinned inside a
+        # seam-split bundle's band would pass a hull check and then
+        # score every walker -inf — fail loudly here instead
+        spans = [
+            (float(d.axis_nodes[k_ax][0]), float(d.axis_nodes[k_ax][-1]))
+            for d in doms
+        ]
+        if not any(lo <= v <= hi for lo, hi in spans):
             raise ValueError(
-                f"base config {name}={v} lies outside the emulator's "
-                f"[{float(nodes[0])}, {float(nodes[-1])}] box for that axis"
+                f"base config {name}={v} lies outside every emulator "
+                f"domain for that axis (domains span {spans}; a gap is "
+                "the seam band — every walker would score -inf)"
             )
         pinned[name] = v
 
-    nodes_j, logv = device_tables(
-        emulator, ("rho_B_kg_m3", "rho_DM_kg_m3")
-    )
-    scales = emulator.axis_scales
+    # one (nodes, log-tables) pair per domain: a single artifact has
+    # exactly one; a seam-split bundle routes each walker through the
+    # SAME select_domains rule the serve kernels use
+    domains = [
+        (device_tables(dom, ("rho_B_kg_m3", "rho_DM_kg_m3")),
+         dom.axis_scales)
+        for dom in domain_artifacts(emulator)
+    ]
     axis_order = emulator.axis_names
     key_pos = {k: i for i, k in enumerate(param_keys)}
+
+    def _eval_domain(table, tvec):
+        (nodes_j, logv), scales = table
+        logs = interp_log_fields(tvec, nodes_j, scales, logv, jnp)
+        return (
+            (logs["rho_B_kg_m3"], logs["rho_DM_kg_m3"]),
+            in_domain_one(tvec, nodes_j, jnp),
+        )
 
     def logp(theta):
         lp = jnp.zeros(())
@@ -276,12 +308,14 @@ def _make_emulator_logprob(
             sampled[name] if name in key_pos else jnp.float64(pinned[name])
             for name in axis_order
         ])
-        # outside the artifact's box the surface is extrapolation-free by
-        # design — score -inf (implicit prior; documented)
-        inside = in_domain_one(tvec, nodes_j, jnp)
-        logs = interp_log_fields(tvec, nodes_j, scales, logv, jnp)
-        ob = 10.0 ** logs["rho_B_kg_m3"] / RHO_CRIT_OVER_H2_KG_M3
-        od = 10.0 ** logs["rho_DM_kg_m3"] / RHO_CRIT_OVER_H2_KG_M3
+        # outside every domain (beyond the hull, or inside a seam band)
+        # the surface is extrapolation-free by design — score -inf
+        # (implicit prior; documented)
+        (log_b, log_d), inside = select_domains(
+            tvec, domains, _eval_domain, jnp
+        )
+        ob = 10.0 ** log_b / RHO_CRIT_OVER_H2_KG_M3
+        od = 10.0 ** log_d / RHO_CRIT_OVER_H2_KG_M3
         lp = lp + planck_gaussian_logp(ob, od)
         lp = jnp.where(inside, lp, -jnp.inf)
         return jnp.where(jnp.isfinite(lp), lp, -jnp.inf)

@@ -26,7 +26,12 @@ from bdlz_tpu.serve.fleet import (  # noqa: F401
 )
 from bdlz_tpu.serve.rollout import ArtifactRollout, RolloutError  # noqa: F401
 from bdlz_tpu.serve.service import (  # noqa: F401
+    REASON_OOD,
+    REASON_PREDICTED_ERROR,
     ExactFallback,
+    ServeAnswer,
     YieldService,
+    gate_fallback_masks,
+    resolve_error_gate,
     resolve_service_static,
 )

@@ -61,7 +61,7 @@ class QueueFull(RuntimeError):
 
 class BatchResult(NamedTuple):
     """What a process_batch callback returns: per-request values plus
-    how many of them took the out-of-domain exact fallback.
+    how many of them took the exact-pipeline fallback.
 
     ``errors`` (optional, same length as ``values``) carries per-request
     failures — a request with a non-None entry gets its exception
@@ -69,12 +69,19 @@ class BatchResult(NamedTuple):
     (error isolation: one poisoned request must not fail the batch).
     ``n_retries`` counts evaluation retries the batch paid (degraded-
     mode accounting for :class:`~bdlz_tpu.utils.profiling.ServeStats`).
+    ``n_gated`` is the subset of ``n_fallback`` the predicted-error gate
+    routed (the rest missed the domain), and ``reasons`` (optional, same
+    length as ``values``) carries each request's fallback reason —
+    ``"ood"`` | ``"predicted_error"`` | None — for fronts that surface
+    it per answer (the serve CLI's JSONL records).
     """
 
     values: Sequence[float]
     n_fallback: int = 0
     errors: Optional[Sequence[Optional[BaseException]]] = None
     n_retries: int = 0
+    n_gated: int = 0
+    reasons: Optional[Sequence[Optional[str]]] = None
 
 
 class MicroBatcher:
@@ -271,6 +278,7 @@ class MicroBatcher:
             seconds=float(seconds),
             n_retries=int(result.n_retries),
             n_error=sum(e is not None for e in errors),
+            n_gated=int(result.n_gated),
         )
         self._batch_index += 1
         for p, v, e in zip(batch, values, errors):

@@ -51,11 +51,21 @@ from typing import (
 import numpy as np  # host-side use only; jitted paths go through the backend.py xp seam (bdlz-lint R1 audit)
 
 from bdlz_tpu.emulator.artifact import EmulatorArtifact
-from bdlz_tpu.emulator.grid import in_domain_one, interp_log_fields
+from bdlz_tpu.emulator.grid import (
+    artifact_hull,
+    domain_artifacts,
+    domain_error_table,
+    in_domain_one,
+    interp_log_fields,
+    predicted_error_one,
+    select_domains,
+)
 from bdlz_tpu.serve.batcher import DeadlineExceeded, QueueFull
 from bdlz_tpu.serve.service import (
     ExactFallback,
     _pad_rows,
+    gate_fallback_masks,
+    resolve_error_gate,
     resolve_service_static,
 )
 from bdlz_tpu.utils.profiling import ServeStats
@@ -64,8 +74,10 @@ ROUTING_POLICIES = ("round_robin", "least_loaded")
 
 
 class FleetResponse(NamedTuple):
-    """One answered request: the value, which artifact computed it, and
-    which device replica ran the batch.  The hash is stamped at DISPATCH
+    """One answered request: the value, which artifact computed it,
+    which device replica ran the batch, and — when the request took the
+    exact fallback — WHY (``"ood"`` | ``"predicted_error"``; None = the
+    emulator fast path answered).  The hash is stamped at DISPATCH
     time — during a rollout, in-flight batches resolve with the artifact
     they were actually answered by, never the one that became active
     afterwards."""
@@ -73,61 +85,91 @@ class FleetResponse(NamedTuple):
     value: float
     artifact_hash: str
     replica: int
+    fallback_reason: Optional[str] = None
 
 
 class _Replica:
     """One device-local copy of the artifact's fused query kernel.
 
-    The node/value tables are ``device_put`` onto this replica's device
-    at construction, so the jitted closure compiles and executes there;
-    the kernel fuses interpolation and the domain test into ONE dispatch
-    per batch (the single-process service pays two).
+    The node/value/error tables of EVERY domain (one for a plain
+    artifact, one per side for a seam-split bundle) are ``device_put``
+    onto this replica's device at construction, so the jitted closure
+    compiles and executes there; the kernel fuses interpolation, the
+    domain test, and the predicted-error gather into ONE dispatch per
+    batch, routing each query through the shared
+    :func:`~bdlz_tpu.emulator.grid.select_domains` rule — per-domain
+    values bit-identical to a standalone query of that sub-artifact
+    (pinned in tests).  ``error_gate=False`` (a fleet serving with the
+    gate disabled) skips the error tables and gathers entirely: the
+    kernel returns a constant 0 estimate, so the gate-off hot path pays
+    no extra device work or transfer.
     """
 
-    def __init__(self, artifact: EmulatorArtifact, device, field: str,
-                 index: int):
+    def __init__(self, artifact, device, field: str, index: int,
+                 error_gate: bool = True):
         from bdlz_tpu.backend import ensure_x64
 
         ensure_x64()
         import jax
         import jax.numpy as jnp
 
-        if field not in artifact.values:
-            raise KeyError(
-                f"field {field!r} not in artifact "
-                f"(has {sorted(artifact.values)})"
-            )
+        doms = domain_artifacts(artifact)
+        for dom in doms:
+            if field not in dom.values:
+                raise KeyError(
+                    f"field {field!r} not in artifact "
+                    f"(has {sorted(dom.values)})"
+                )
         self.device = device
         self.index = int(index)
         #: Batches dispatched but not yet gathered (the least-loaded
         #: router's signal).
         self.in_flight = 0
-        scales = artifact.axis_scales
-        nodes = tuple(
-            jax.device_put(
-                jnp.asarray(np.asarray(n, dtype=np.float64)), device
+        tables = []
+        for dom in doms:
+            nodes = tuple(
+                jax.device_put(
+                    jnp.asarray(np.asarray(n, dtype=np.float64)), device
+                )
+                for n in dom.axis_nodes
             )
-            for n in artifact.axis_nodes
-        )
-        logv = {
-            field: jax.device_put(
-                jnp.asarray(np.log10(
-                    np.asarray(artifact.values[field], dtype=np.float64)
-                )),
-                device,
+            logv = {
+                field: jax.device_put(
+                    jnp.asarray(np.log10(
+                        np.asarray(dom.values[field], dtype=np.float64)
+                    )),
+                    device,
+                )
+            }
+            if error_gate:
+                err_grid, err_floor = domain_error_table(dom, jnp)
+                err_table = (jax.device_put(err_grid, device), err_floor)
+            else:
+                err_table = None
+            tables.append((nodes, dom.axis_scales, logv, err_table))
+
+        def eval_one(table, theta):
+            nodes, scales, logv, err_table = table
+            v = 10.0 ** interp_log_fields(
+                theta, nodes, scales, logv, jnp
+            )[field]
+            e = (
+                predicted_error_one(theta, nodes, *err_table, jnp)
+                if err_table is not None else jnp.zeros(())
             )
-        }
+            return (v, e), in_domain_one(theta, nodes, jnp)
 
         def one(theta):
-            log_f = interp_log_fields(theta, nodes, scales, logv, jnp)[field]
-            inside = in_domain_one(theta, nodes, jnp)
-            return 10.0 ** log_f, inside
+            (value, err), inside = select_domains(
+                theta, tables, eval_one, jnp
+            )
+            return value, inside, err
 
         self._fn = jax.jit(jax.vmap(one))
 
     def dispatch(self, padded: np.ndarray):
         """Launch one padded batch on this replica's device (async);
-        returns ``(values, inside)`` device arrays."""
+        returns ``(values, inside, pred_err)`` device arrays."""
         import jax
 
         return self._fn(jax.device_put(padded, self.device))
@@ -139,6 +181,7 @@ class _Handle(NamedTuple):
     replica: _Replica
     values: Any          # (bucket,) device array
     inside: Any          # (bucket,) bool device array
+    pred_err: Any        # (bucket,) device array — per-cell estimate
     n: int               # live rows (bucket - n = padding)
 
     def done(self) -> bool:
@@ -150,18 +193,20 @@ class _Handle(NamedTuple):
         except AttributeError:  # older jax: no is_ready on arrays
             return True
 
-    def gather(self) -> Tuple[np.ndarray, np.ndarray]:
-        """Block for and fetch the batch's ``(values, inside)`` host
-        arrays (writable — the fallback patches OOD slots), releasing
-        the replica's in-flight slot — even when the deferred device
-        error surfaces here (a leaked slot would bias least_loaded
-        routing away from this replica forever)."""
+    def gather(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Block for and fetch the batch's ``(values, inside,
+        pred_err)`` host arrays (values writable — the fallback patches
+        the gated/OOD slots), releasing the replica's in-flight slot —
+        even when the deferred device error surfaces here (a leaked
+        slot would bias least_loaded routing away from this replica
+        forever)."""
         try:
             values = np.array(self.values, dtype=np.float64)[: self.n]
             inside = np.asarray(self.inside)[: self.n]
+            pred_err = np.asarray(self.pred_err)[: self.n]
         finally:
             self.replica.in_flight -= 1
-        return values, inside
+        return values, inside, pred_err
 
 
 class ReplicaSet:
@@ -192,6 +237,7 @@ class ReplicaSet:
         routing: str = "least_loaded",
         warm: bool = True,
         stats: Optional[ServeStats] = None,
+        error_gate: bool = True,
     ):
         import jax
 
@@ -215,8 +261,13 @@ class ReplicaSet:
         self.max_batch_size = int(max_batch_size)
         self.routing = routing
         self.stats = stats
+        #: Whether the replicas carry predicted-error tables (False = a
+        #: gate-disabled fleet: the kernels return constant-0 estimates
+        #: and pay no error gathers on the hot path).
+        self.error_gate = bool(error_gate)
         self.replicas: List[_Replica] = [
-            _Replica(artifact, devices[i % len(devices)], field, i)
+            _Replica(artifact, devices[i % len(devices)], field, i,
+                     error_gate=self.error_gate)
             for i in range(n)
         ]
         self._rr = 0
@@ -247,7 +298,7 @@ class ReplicaSet:
         import jax
 
         t0 = time.monotonic()
-        lower = np.asarray([n[0] for n in self.artifact.axis_nodes])
+        lower, _hi = artifact_hull(self.artifact)
         probe = np.tile(lower, (self.max_batch_size, 1))
         for r in self.replicas:
             jax.block_until_ready(r.dispatch(probe))
@@ -290,9 +341,12 @@ class ReplicaSet:
         # dispatch failure must not permanently bias least_loaded
         # routing away from this replica (the matching decrement lives
         # in _Handle.gather's finally)
-        values, inside = replica.dispatch(padded)
+        values, inside, pred_err = replica.dispatch(padded)
         replica.in_flight += 1
-        return _Handle(replica=replica, values=values, inside=inside, n=b)
+        return _Handle(
+            replica=replica, values=values, inside=inside,
+            pred_err=pred_err, n=b,
+        )
 
 
 class _Pending(NamedTuple):
@@ -326,8 +380,12 @@ class FleetService:
     * **deadline shedding** — requests older than ``deadline_s`` at
       dispatch are answered with ``DeadlineExceeded`` (age-ordered
       prefix, before the batch is sliced);
-    * **out-of-domain fallback** — the shared :class:`ExactFallback`
-      (retried once, fault-injectable, isolated per request);
+    * **exact fallback** — the shared :class:`ExactFallback` (retried
+      once, fault-injectable, isolated per request) for out-of-domain
+      AND predicted-error-gated requests; every
+      :class:`FleetResponse` names its ``fallback_reason`` so
+      shed/fallback telemetry can tell geometry misses ("ood") from
+      accuracy gating ("predicted_error");
     * **rollout seam** — :meth:`swap_replica_set` replaces the active
       replicas atomically under the dispatch lock; in-flight batches
       keep their old handles and resolve with the OLD artifact's hash
@@ -341,7 +399,7 @@ class FleetService:
 
     def __init__(
         self,
-        artifact: EmulatorArtifact,
+        artifact,
         base,
         static=None,
         field: str = "DM_over_B",
@@ -358,10 +416,16 @@ class FleetService:
         fault_plan=None,
         stats: Optional[ServeStats] = None,
         warm: bool = True,
+        error_gate_tol=None,
     ):
         from bdlz_tpu.emulator.artifact import build_identity
 
         static, n_y, impl = resolve_service_static(artifact, base, static)
+        #: The exact-fallback error gate (shared resolution with
+        #: YieldService — resolve_error_gate): None = membership-only.
+        self.error_gate_tol = resolve_error_gate(
+            artifact, base, error_gate_tol
+        )
         if n_replicas is None:
             n_replicas = getattr(base, "n_replicas", None)
         if queue_bound is None:
@@ -400,6 +464,7 @@ class FleetService:
             artifact, field=field, n_replicas=n_replicas, devices=devices,
             max_batch_size=self.max_batch_size, routing=routing,
             warm=warm, stats=self.stats,
+            error_gate=self.error_gate_tol is not None,
         )
         self._queue: Deque[_Pending] = deque()
         self._inflight: Deque[_InFlight] = deque()
@@ -567,22 +632,25 @@ class FleetService:
             if not block and not self._inflight[0].handle.done():
                 return 0
             item = self._inflight.popleft()
-        values, inside = item.handle.gather()  # blocks if still running
+        values, inside, pred_err = item.handle.gather()  # blocks if running
         b = len(item.batch)
-        n_fallback = int((~inside).sum())
+        fallback, gated, reasons = gate_fallback_masks(
+            inside, pred_err, self.error_gate_tol
+        )
+        n_fallback = int(fallback.sum())
         errors: "list[Optional[BaseException]]" = [None] * b
         retries_box = [0]
         if n_fallback:
-            ood = _pad_rows(item.thetas[~inside], self.max_batch_size)
+            ood = _pad_rows(item.thetas[fallback], self.max_batch_size)
             axes = {
                 name: ood[:, k]
                 for k, name in enumerate(self.artifact.axis_names)
             }
             try:
                 exact_fields = self._fallback(axes, retries_box)
-                values[~inside] = exact_fields[self.field][:n_fallback]
+                values[fallback] = exact_fields[self.field][:n_fallback]
             except Exception as exc:  # noqa: BLE001 — isolated per request
-                for i in np.flatnonzero(~inside):
+                for i in np.flatnonzero(fallback):
                     errors[int(i)] = exc
                     values[int(i)] = np.nan
         now = self._clock()
@@ -595,10 +663,11 @@ class FleetService:
             seconds=float(now - item.dispatched_at),
             n_retries=retries_box[0],
             n_error=sum(e is not None for e in errors),
+            n_gated=int(gated.sum()),
             artifact_hash=item.artifact_hash,
             replica=item.handle.replica.index,
         )
-        for p, v, e in zip(item.batch, values, errors):
+        for p, v, e, reason in zip(item.batch, values, errors, reasons):
             self.stats.record_latency(now - p.enqueued_at)
             # per-request error isolation: a poisoned request gets its
             # exception, its batchmates still get their values
@@ -609,6 +678,7 @@ class FleetService:
                     value=float(v),
                     artifact_hash=item.artifact_hash,
                     replica=item.handle.replica.index,
+                    fallback_reason=reason,
                 ))
         return b
 

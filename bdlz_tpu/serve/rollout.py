@@ -38,11 +38,8 @@ from typing import Optional, Tuple
 
 import numpy as np  # host-side use only; jitted paths go through the backend.py xp seam (bdlz-lint R1 audit)
 
-from bdlz_tpu.emulator.artifact import (
-    EmulatorArtifact,
-    check_identity,
-    load_artifact,
-)
+from bdlz_tpu.emulator.artifact import EmulatorArtifact, check_identity
+from bdlz_tpu.emulator.multidomain import MultiDomainArtifact, load_any_artifact
 from bdlz_tpu.serve.fleet import FleetService, ReplicaSet
 
 #: Fixed width of the hash-agreement broadcast (content hashes are 16
@@ -117,8 +114,10 @@ class ArtifactRollout:
             from bdlz_tpu.provenance import fetch_artifact
 
             artifact = fetch_artifact(self.store, artifact)
-        if not isinstance(artifact, EmulatorArtifact):
-            artifact = load_artifact(str(artifact))
+        if not isinstance(artifact, (EmulatorArtifact, MultiDomainArtifact)):
+            # kind-dispatching load: a staged directory may hold a
+            # single artifact or a seam-split bundle
+            artifact = load_any_artifact(str(artifact))
         # the PR-3 identity check: N+1 must be valid for the SAME
         # physics/engine/quadrature the service (and its exact fallback)
         # was constructed for — content (axes, values, hash) may differ
@@ -133,6 +132,7 @@ class ArtifactRollout:
             routing=active.routing,
             warm=False,
             stats=self.service.stats,
+            error_gate=getattr(active, "error_gate", True),
         )
         if warm:
             staged.warm()

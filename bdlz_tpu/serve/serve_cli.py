@@ -17,7 +17,10 @@ Requests are JSON lines, one query each, either an object mapping the
 artifact's axis names to values (``{"m_chi_GeV": 0.95, "T_p_GeV":
 100.0}``) or ``{"theta": [0.95, 100.0]}`` in artifact axis order; an
 optional ``"id"`` is echoed back.  Responses are JSON lines on stdout:
-``{"id", "value", "latency_s"}`` in request order (``latency_s`` is
+``{"id", "value", "fallback_reason", "latency_s"}`` in request order
+(``fallback_reason`` is null when the emulator fast path answered,
+``"ood"`` for a domain miss, ``"predicted_error"`` when the per-cell
+error gate routed the request to the exact path; ``latency_s`` is
 submit→result through the batcher, after a warm-up call so the first
 batch does not carry the XLA compile), followed by a ``serve_done``
 summary event on stderr (or the ``--events`` log) carrying the
@@ -87,13 +90,15 @@ def main(argv: Optional[list] = None) -> int:
     ensure_x64()
 
     from bdlz_tpu.config import load_config, validate
-    from bdlz_tpu.emulator import load_artifact
+    from bdlz_tpu.emulator import load_any_artifact
     from bdlz_tpu.serve.service import YieldService
     from bdlz_tpu.utils.logging import EventLog
 
     event_log = EventLog(path=args.events) if args.events else EventLog()
     base = validate(load_config(args.config))
-    artifact = load_artifact(args.artifact)
+    # kind-dispatching load: single artifacts AND seam-split bundles
+    # (multi-domain, stitched at query time) serve through one front
+    artifact = load_any_artifact(args.artifact)
     fleet = None
     if args.replicas is not None:
         from bdlz_tpu.serve.fleet import FleetService
@@ -199,12 +204,19 @@ def main(argv: Optional[list] = None) -> int:
     # warm the exact-fallback path too (the query/domain kernels are
     # already warmed at construction) so the first request's latency_s
     # measures serving, not the XLA compile
-    service.evaluate(np.array([[nodes[0] for nodes in artifact.axis_nodes]]))
+    from bdlz_tpu.emulator import artifact_hull
+
+    service.evaluate(np.array([artifact_hull(artifact)[0]]))
+    # annotate=True: futures resolve to ServeAnswer(value, reason) so
+    # every JSONL answer names what produced it — emulator fast path
+    # (null), out-of-domain ("ood"), or the error gate
+    # ("predicted_error")
     batcher = service.make_batcher(
         max_wait_s=args.max_wait_ms / 1e3,
         deadline_s=(
             None if args.deadline_ms is None else args.deadline_ms / 1e3
         ),
+        annotate=True,
     )
     batcher.start()
     # latency is stamped at SUBMIT — file parsing above is not queue time
@@ -213,7 +225,7 @@ def main(argv: Optional[list] = None) -> int:
     try:
         for rid, t0, fut in futures:
             try:
-                value = fut.result()
+                answer = fut.result()
             except Exception as exc:  # noqa: BLE001 — report per request
                 # per-request failures (DeadlineExceeded, a dead exact
                 # fallback) answer THIS line; the rest keep serving
@@ -226,7 +238,8 @@ def main(argv: Optional[list] = None) -> int:
             n_ok += 1
             print(json.dumps({
                 "id": rid,
-                "value": float(value),
+                "value": float(answer.value),
+                "fallback_reason": answer.fallback_reason,
                 "latency_s": round(time.monotonic() - t0, 6),
             }))
     finally:
@@ -296,6 +309,7 @@ def _serve_requests_fleet(fleet, requests) -> int:
             "value": float(resp.value),
             "artifact_hash": resp.artifact_hash,
             "replica": resp.replica,
+            "fallback_reason": resp.fallback_reason,
             "latency_s": latency,
         }))
     return n_ok
@@ -304,10 +318,10 @@ def _serve_requests_fleet(fleet, requests) -> int:
 def _bench_fleet(fleet, n: int, event_log) -> int:
     """--bench through the fleet: random in-domain traffic, closed-loop
     pumped so the replicas stay overlapped."""
+    from bdlz_tpu.emulator import artifact_hull
+
     rng = np.random.default_rng(0)
-    art = fleet.artifact
-    lo = np.array([nodes[0] for nodes in art.axis_nodes])
-    hi = np.array([nodes[-1] for nodes in art.axis_nodes])
+    lo, hi = artifact_hull(fleet.artifact)
     thetas = rng.uniform(lo, hi, size=(n, len(lo)))
     t0 = time.monotonic()
     futures = []
@@ -340,9 +354,10 @@ def _bench_fleet(fleet, n: int, event_log) -> int:
 
 def _bench(service, n: int, args, event_log) -> int:
     """--bench: random in-domain traffic through the real batcher."""
+    from bdlz_tpu.emulator import artifact_hull
+
     rng = np.random.default_rng(0)
-    lo = np.array([nodes[0] for nodes in service.artifact.axis_nodes])
-    hi = np.array([nodes[-1] for nodes in service.artifact.axis_nodes])
+    lo, hi = artifact_hull(service.artifact)
     thetas = rng.uniform(lo, hi, size=(n, len(lo)))
     # warm both jitted programs before timing
     service.evaluate(thetas[: min(n, service.max_batch_size)])

@@ -1,14 +1,26 @@
 """The yield query service: emulator fast path + exact-pipeline fallback.
 
-:class:`YieldService` owns the two evaluation paths a query can take:
+:class:`YieldService` owns the evaluation paths a query can take:
 
-* **in-domain** — the artifact's jitted log-space interpolation kernel
-  (microseconds per batched point);
-* **out-of-domain** — the exact pipeline through the same engine the
+* **in-domain, inside predicted error** — the artifact's jitted
+  log-space interpolation kernel (microseconds per batched point; a
+  seam-split multi-domain bundle routes each query to its containing
+  domain inside the same kernel);
+* **exact fallback** — the exact pipeline through the same engine the
   artifact was built with (``emulator.build.make_exact_evaluator``),
-  so a query outside the box gets the REAL answer at exact-path cost
-  instead of a clamped-edge lie.  Non-finite exact output (absurd
-  corners) passes through as NaN per request, mask-and-report style.
+  taken for a query OUTSIDE every domain (reason ``"ood"``) **or** one
+  whose cell's persisted a-posteriori error estimate exceeds the error
+  gate (reason ``"predicted_error"``) — accuracy, not just geometry,
+  decides who pays the ~1600x exact-path cost.  Non-finite exact
+  output (absurd corners) passes through as NaN per request,
+  mask-and-report style.
+
+The error gate resolves explicit argument > ``Config.error_gate_tol``
+> the artifact's recorded ``rtol_target`` (``false`` disables it); an
+artifact that missed its advertised tolerance is floored at +inf
+(``emulator.grid.error_floor`` — its own error statements provably
+failed), so an untrustworthy surface degrades to all-exact serving
+under any active gate instead of quietly answering wrong.
 
 Batches are padded to a fixed bucket before hitting either jitted
 program, so one compile per path serves every batch size; the
@@ -17,7 +29,7 @@ program, so one compile per path serves every batch size; the
 """
 from __future__ import annotations
 
-from typing import Dict, Optional, Tuple
+from typing import Dict, List, NamedTuple, Optional, Tuple
 
 import numpy as np  # host-side use only; jitted paths go through the backend.py xp seam (bdlz-lint R1 audit)
 
@@ -27,9 +39,51 @@ from bdlz_tpu.emulator.artifact import (
     check_identity,
 )
 from bdlz_tpu.emulator.build import make_exact_evaluator
-from bdlz_tpu.emulator.grid import make_domain_fn, make_query_fn
+from bdlz_tpu.emulator.grid import (
+    artifact_hull,
+    has_error_grid,
+    make_domain_fn,
+    make_error_fn,
+    make_query_fn,
+)
 from bdlz_tpu.serve.batcher import BatchResult, MicroBatcher
 from bdlz_tpu.utils.profiling import ServeStats
+
+#: Fallback-reason tags (FleetResponse.fallback_reason, ServeStats rows,
+#: serve_cli JSONL answers): None = answered by the emulator.
+REASON_OOD = "ood"
+REASON_PREDICTED_ERROR = "predicted_error"
+
+
+class ServeAnswer(NamedTuple):
+    """One annotated answer (the serve CLI's JSONL path): the value plus
+    which fallback reason produced it (None = emulator fast path)."""
+
+    value: float
+    fallback_reason: Optional[str] = None
+
+
+def gate_fallback_masks(inside, pred_err, tol):
+    """THE gating rule, shared by both serving fronts (YieldService and
+    the fleet — they must never drift): fallback = out-of-domain OR
+    (in-domain AND predicted error over the gate), with per-request
+    reasons where ``"ood"`` wins when both would fire (geometry is the
+    stronger statement).  ``tol=None`` (gate off, or no estimates)
+    reduces to membership-only.  Returns ``(fallback, gated, reasons)``
+    — two boolean masks and the reason list.
+    """
+    inside = np.asarray(inside, dtype=bool)
+    if tol is not None and pred_err is not None:
+        gated = inside & (np.asarray(pred_err) > tol)
+    else:
+        gated = np.zeros(inside.shape, dtype=bool)
+    fallback = ~inside | gated
+    reasons: "List[Optional[str]]" = [
+        REASON_OOD if not inside[i]
+        else (REASON_PREDICTED_ERROR if gated[i] else None)
+        for i in range(len(inside))
+    ]
+    return fallback, gated, reasons
 
 
 def _pad_rows(arr: np.ndarray, n: int) -> np.ndarray:
@@ -61,7 +115,52 @@ def theta_from_mapping(
     )
 
 
-def resolve_service_static(artifact: EmulatorArtifact, base, static=None):
+def resolve_error_gate(artifact, base, error_gate_tol=None) -> Optional[float]:
+    """The exact-fallback error-gate tolerance a service runs with.
+
+    Resolution (the one rule both serving fronts share): explicit
+    argument > ``Config.error_gate_tol`` > engine default.  ``False``
+    anywhere disables the gate (fallback on domain membership only —
+    the pre-gate behavior); ``None`` everywhere gates at the artifact's
+    recorded ``rtol_target`` — but only when the artifact actually
+    carries per-cell estimates OR missed its contract (an unconverged
+    surface must not be served just because it predates the error
+    grid).  Returns the tolerance, or None for "gate off".
+    """
+    tol = error_gate_tol
+    if tol is None:
+        tol = getattr(base, "error_gate_tol", None)
+    if tol is False:
+        return None
+    if tol is True:
+        # mirror Config.validate: float(True) == 1.0 would silently
+        # DISABLE the gate an operator meant to turn on
+        raise ValueError(
+            "error_gate_tol=True is ambiguous: use None for the "
+            "artifact's recorded rtol_target, False to disable the "
+            "gate, or a positive tolerance"
+        )
+    if tol is not None:
+        tol = float(tol)
+        if not tol > 0.0:
+            raise ValueError(
+                f"error_gate_tol must be a positive relative tolerance, "
+                f"False, or None, got {tol!r}"
+            )
+        return tol
+    # engine default: the artifact's own advertised tolerance
+    from bdlz_tpu.emulator.grid import domain_artifacts, error_floor
+
+    untrusted = any(
+        error_floor(d) > 0.0 for d in domain_artifacts(artifact)
+    )
+    if not (has_error_grid(artifact) or untrusted):
+        return None
+    rt = artifact.manifest.get("rtol_target")
+    return float(rt) if rt is not None else None
+
+
+def resolve_service_static(artifact, base, static=None):
     """``(static, n_y, impl)`` a service must run with for ``artifact``.
 
     The single home of the serve-layer identity rules (YieldService and
@@ -168,7 +267,7 @@ class YieldService:
 
     def __init__(
         self,
-        artifact: EmulatorArtifact,
+        artifact,
         base,
         static=None,
         field: str = "DM_over_B",
@@ -177,6 +276,7 @@ class YieldService:
         retry=None,
         fault_plan=None,
         warm: bool = True,
+        error_gate_tol=None,
     ):
         # identity resolution + the retried/fault-injectable exact path
         # are shared with the fleet (resolve_service_static /
@@ -187,6 +287,16 @@ class YieldService:
         self.max_batch_size = int(max_batch_size)
         self._query = make_query_fn(artifact, field=field)
         self._in_domain = make_domain_fn(artifact)
+        #: The exact-fallback error gate (None = membership-only): a
+        #: query whose cell's predicted error exceeds this is answered
+        #: by the exact path even though it is inside a domain.
+        self.error_gate_tol = resolve_error_gate(
+            artifact, base, error_gate_tol
+        )
+        self._pred_error = (
+            make_error_fn(artifact)
+            if self.error_gate_tol is not None else None
+        )
         self._exact_guarded = ExactFallback(
             base, static, n_y=n_y, impl=impl, mesh=mesh,
             chunk_size=self.max_batch_size, retry=retry,
@@ -212,23 +322,32 @@ class YieldService:
         import time
 
         t0 = time.monotonic()
-        lower = np.asarray(
-            [nodes[0] for nodes in self.artifact.axis_nodes]
-        )
+        lower, _hi = artifact_hull(self.artifact)
         probe = np.tile(lower, (self.max_batch_size, 1))
         import jax
 
         jax.block_until_ready(self._query(probe))
         jax.block_until_ready(self._in_domain(probe))
+        if self._pred_error is not None:
+            jax.block_until_ready(self._pred_error(probe))
         seconds = time.monotonic() - t0
         self.stats.record_warmup(seconds)
         return seconds
 
     def _evaluate_isolated(self, thetas):
-        """(values, n_fallback, errors, n_retries) with per-request
-        exact-failure isolation: the emulator-path results always
-        return; a dead exact fallback poisons ONLY the out-of-domain
-        requests that needed it."""
+        """(values, n_fallback, errors, n_retries, reasons, n_gated)
+        with per-request exact-failure isolation: the emulator-path
+        results always return; a dead exact fallback poisons ONLY the
+        requests that needed it.
+
+        The fallback mask is the union of the two gates: OUT-OF-DOMAIN
+        (outside every domain — including the seam band of a
+        multi-domain bundle) and PREDICTED-ERROR (inside a domain, but
+        the cell's persisted a-posteriori estimate exceeds
+        ``error_gate_tol``).  ``reasons[i]`` records which one fired
+        (``"ood"`` wins when both would — geometry is the stronger
+        statement).
+        """
         thetas = np.atleast_2d(np.asarray(thetas, dtype=np.float64))
         b = thetas.shape[0]
         if thetas.shape[1] != len(self.artifact.axis_names):
@@ -241,31 +360,42 @@ class YieldService:
         padded = _pad_rows(thetas, bucket)
         inside = np.asarray(self._in_domain(padded))[:b]
         # np.array (copy): the device buffer view is read-only, and the
-        # fallback writes exact values into the out-of-domain slots
+        # fallback writes exact values into the fallback slots
         values = np.array(self._query(padded), dtype=np.float64)[:b]
-        n_fallback = int((~inside).sum())
+        pred = (
+            np.asarray(self._pred_error(padded))[:b]
+            if self._pred_error is not None else None
+        )
+        fallback, gated, reasons = gate_fallback_masks(
+            inside, pred, self.error_gate_tol if pred is not None else None
+        )
+        n_fallback = int(fallback.sum())
         errors: "list[Optional[BaseException]]" = [None] * b
         retries_box = [0]
         if n_fallback:
-            ood = _pad_rows(thetas[~inside], bucket)
+            ood = _pad_rows(thetas[fallback], bucket)
             axes = {
                 name: ood[:, k]
                 for k, name in enumerate(self.artifact.axis_names)
             }
             try:
                 exact_fields = self._exact_guarded(axes, retries_box)
-                values[~inside] = exact_fields[self.field][:n_fallback]
+                values[fallback] = exact_fields[self.field][:n_fallback]
             except Exception as exc:  # noqa: BLE001 — isolated per request
-                for i in np.flatnonzero(~inside):
+                for i in np.flatnonzero(fallback):
                     errors[int(i)] = exc
                     values[int(i)] = np.nan
-        return values, n_fallback, errors, retries_box[0]
+        return (
+            values, n_fallback, errors, retries_box[0], reasons,
+            int(gated.sum()),
+        )
 
     def evaluate(self, thetas) -> Tuple[np.ndarray, int]:
         """(values, n_fallback) for a (B, d) batch of queries.
 
-        The emulator answers every in-domain request from one padded
-        jitted call; out-of-domain requests are regrouped into one
+        The emulator answers every gate-passing in-domain request from
+        one padded jitted call; fallback requests (out-of-domain OR
+        over the predicted-error gate) are regrouped into one
         exact-pipeline call (padded to the same bucket) — the fallback
         is per-REQUEST, so one stray query cannot drag a whole batch
         onto the slow path.  A persistently failing exact fallback
@@ -273,7 +403,7 @@ class YieldService:
         loud contract; the batcher path (:meth:`process_batch`)
         isolates it per request instead.
         """
-        values, n_fallback, errors, _ = self._evaluate_isolated(thetas)
+        values, n_fallback, errors, _, _, _ = self._evaluate_isolated(thetas)
         for e in errors:
             if e is not None:
                 raise e
@@ -282,15 +412,28 @@ class YieldService:
     # ---- batcher integration ---------------------------------------
 
     def process_batch(self, thetas) -> BatchResult:
-        values, n_fallback, errors, n_retries = self._evaluate_isolated(
-            thetas
-        )
+        (values, n_fallback, errors, n_retries, reasons,
+         n_gated) = self._evaluate_isolated(thetas)
         return BatchResult(
             values=list(values),
             n_fallback=n_fallback,
             errors=errors if any(e is not None for e in errors) else None,
             n_retries=n_retries,
+            n_gated=n_gated,
+            reasons=reasons,
         )
+
+    def process_batch_annotated(self, thetas) -> BatchResult:
+        """Like :meth:`process_batch`, but each value is a
+        :class:`ServeAnswer` carrying its fallback reason — the serve
+        CLI's JSONL front resolves futures to these so every answer
+        line can name what produced it."""
+        res = self.process_batch(thetas)
+        reasons = res.reasons or [None] * len(res.values)
+        return res._replace(values=[
+            ServeAnswer(value=v, fallback_reason=r)
+            for v, r in zip(res.values, reasons)
+        ])
 
     def make_batcher(
         self,
@@ -298,12 +441,18 @@ class YieldService:
         clock=None,
         stats: Optional[ServeStats] = None,
         deadline_s: Optional[float] = None,
+        annotate: bool = False,
     ) -> MicroBatcher:
-        """A MicroBatcher wired to this service (shared stats object)."""
+        """A MicroBatcher wired to this service (shared stats object).
+
+        ``annotate=True`` resolves each future to a
+        :class:`ServeAnswer` (value + fallback reason) instead of a
+        bare value — the CLI front's telemetry path.
+        """
         import time
 
         return MicroBatcher(
-            self.process_batch,
+            self.process_batch_annotated if annotate else self.process_batch,
             max_batch_size=self.max_batch_size,
             max_wait_s=max_wait_s,
             clock=time.monotonic if clock is None else clock,

@@ -74,13 +74,19 @@ class ServeBatch:
     size: int              # requests in the batch
     occupancy: float       # size / max_batch_size
     wait_s: float          # oldest request's queue wait at dispatch
-    n_fallback: int        # out-of-domain requests → exact pipeline
+    n_fallback: int        # exact-pipeline requests (OOD + error-gated)
     seconds: float         # evaluation wall time
     # degraded-mode accounting (docs/robustness.md): exact-fallback
     # retries paid, and requests answered with a per-request error after
     # the retry budget (the serve analog of sweep quarantine)
     n_retries: int = 0
     n_error: int = 0
+    #: The subset of ``n_fallback`` routed to the exact path by the
+    #: PREDICTED-ERROR gate (reason "predicted_error") rather than by
+    #: domain membership (reason "ood") — telemetry must distinguish a
+    #: box that no longer covers the traffic from a surface that covers
+    #: it but is not accurate enough where the traffic lands.
+    n_gated: int = 0
     # fleet provenance (docs/serving.md): which artifact answered the
     # batch and which device replica ran it.  Every request in one batch
     # shares one artifact by construction — the rollout tests pin that a
@@ -155,6 +161,7 @@ class ServeStats:
     def summary(self) -> Dict[str, Any]:
         requests = sum(r.size for r in self.rows)
         fallbacks = sum(r.n_fallback for r in self.rows)
+        gated = sum(r.n_gated for r in self.rows)
         errors = sum(r.n_error for r in self.rows)
         shed = self.deadline_kills + self.admission_rejects
         offered = self.accepted + self.admission_rejects
@@ -164,6 +171,13 @@ class ServeStats:
             "fallbacks": fallbacks,
             "fallback_rate": (
                 round(fallbacks / requests, 4) if requests else None
+            ),
+            # predicted-error-gated subset of the fallbacks ("ood" vs
+            # "predicted_error" reasons — geometry misses vs accuracy
+            # gating are different capacity-planning signals)
+            "gated_fallbacks": gated,
+            "gated_rate": (
+                round(gated / requests, 4) if requests else None
             ),
             "mean_batch": (
                 round(requests / self.n_batches, 2) if self.rows else None

@@ -37,10 +37,12 @@ accelerator relay to recover before benching CPU: flag > BDLZ_RELAY_WAIT_S
 > legacy BDLZ_BENCH_RELAY_WAIT_S > default — 60 s when JAX_PLATFORMS=cpu
 says this process never wanted the accelerator, 600 s otherwise; the
 JSON stamps platform/tpu_unavailable/relay_waited_s either way),
-BDLZ_BENCH_ODE_POINTS (grid size for the secondary stiff ESDIRK sweep
-metric, printed as its own line before the main one; default 1024 on
-TPU, 64 on the CPU-fallback path — the line A/Bs the lane-repacking
-batch engine against the legacy lockstep strategy and records
+BDLZ_BENCH_STIFF_POINTS (grid size for the secondary stiff ESDIRK
+sweep metric, printed as its own line before the main one; PINNED at
+1024 on every platform so rounds are comparable — BENCH_r02's 1024-pt
+and r05's 64-pt numbers were not; the legacy BDLZ_BENCH_ODE_POINTS
+name still works — the line records engine + n_points and A/Bs the
+lane-repacking batch engine against the legacy lockstep strategy:
 vs_lockstep, both engines' Radau spot accuracy, and the per-round
 compaction stats), BDLZ_BENCH_LZ_POINTS (grid size for
 the two LZ-sweep secondary metrics — per-point P derived from a bounce
@@ -54,7 +56,15 @@ serve_bench leg: request-stream size, micro-batch bucket, fleet size,
 and the closed-loop latency sample — the leg replays the round's
 emulator artifact through the per-device replica fleet and reports
 QPS/chip, replica scaling, p50/p99 latency, and the deterministic shed
-rate of a canned overload trace).  Every secondary leg runs on EVERY
+rate of a canned overload trace), BDLZ_BENCH_SEAM_NY /
+BDLZ_BENCH_SEAM_RTOL / BDLZ_BENCH_SEAM_ROUNDS /
+BDLZ_BENCH_SEAM_QUERIES / BDLZ_BENCH_SEAM_EXACT (the seam_split leg:
+an A/B seam-crossing emulator box built split-domain vs single-domain
+at equal tolerance, then a deterministic seam-crossing query trace
+through the predicted-error-gated service — exact-fallback ratio,
+gated/ungated rates and effective QPS for both artifacts, and the
+gated answers spot-checked against the exact engine, all on one
+line).  Every secondary leg runs on EVERY
 platform (flagged tpu_unavailable on the fallback path) so a
 relay-dead round still records full engine coverage.
 """
@@ -648,11 +658,19 @@ def main(argv=None) -> None:
         from bdlz_tpu.physics.percolation import make_kjma_grid as _mkg
         from bdlz_tpu.utils.profiling import CompactionStats
 
-        # CPU fallback still records a (small, flagged) number so a
-        # relay-dead round never benches two of three engines as null
-        # (VERDICT r4 weak #4)
-        ode_n = int(os.environ.get("BDLZ_BENCH_ODE_POINTS",
-                                   64 if on_cpu else 1024))
+        # The grid size is PINNED at 1024 on every platform (the stiff
+        # drift fix: BENCH_r02 measured 1024 points, r05 only 64 — the
+        # two throughputs were not comparable rounds of one trajectory).
+        # BDLZ_BENCH_STIFF_POINTS overrides; the legacy
+        # BDLZ_BENCH_ODE_POINTS name keeps working.  A relay-dead CPU
+        # round now pays the same grid once — and the PR-7 leg cache
+        # replays it on later degraded rounds, so the pin does not
+        # re-tax every relay death.
+        ode_n = int(
+            os.environ.get("BDLZ_BENCH_STIFF_POINTS")
+            or os.environ.get("BDLZ_BENCH_ODE_POINTS")
+            or 1024
+        )
         base_ode = dataclasses.replace(
             base, Gamma_wash_over_H=0.01, T_min_over_Tp=0.05
         )
@@ -739,6 +757,11 @@ def main(argv=None) -> None:
                 "metric": "esdirk_sweep_points_per_sec_per_chip",
                 "value": per_chip_ode,
                 "unit": "stiff ODE param-points/sec/chip (Gamma_wash grid)",
+                # the engine the headline number measures (the lockstep
+                # A/B rides the *_lockstep fields) + the pinned grid
+                # size, so rounds are comparable by construction
+                "engine": "esdirk",
+                "lockstep_engine": "esdirk_lockstep",
                 "n_points": n_ode,
                 "n_failed": int((~np.isfinite(out_ode)).sum()),
                 # this leg times raw engine steps (no chunk-healing loop)
@@ -1283,6 +1306,182 @@ def main(argv=None) -> None:
         print(f"[bench] serve_bench metric unavailable: {exc}",
               file=sys.stderr)
 
+    # --- secondary metric: seam-split emulator domains + error gate ----
+    # The PR-3 emulator's documented blind spot: a box crossing the
+    # T = m/3 flux seam refines first-order and was "split at the band
+    # or serve exact".  This leg measures the split path doing exactly
+    # that: an A/B seam-box build (split-domain vs single-domain at
+    # equal tolerance — exact-point budget and held-out error on the
+    # line) and a deterministic seam-crossing serve trace through the
+    # predicted-error-gated YieldService (fallback rate + effective QPS,
+    # gated vs ungated, against both artifacts), with the gated answers
+    # spot-checked against the exact engine on the same line.
+    def seam_split_metric():
+        import dataclasses
+
+        from bdlz_tpu.config import static_choices_from_config
+        from bdlz_tpu.emulator import (
+            AxisSpec,
+            build_emulator,
+            make_exact_evaluator,
+        )
+        from bdlz_tpu.serve.service import YieldService
+        from bdlz_tpu.validation import relative_errors
+
+        seam_ny = int(os.environ.get("BDLZ_BENCH_SEAM_NY", 200))
+        seam_rtol = float(os.environ.get("BDLZ_BENCH_SEAM_RTOL", 1e-4))
+        seam_rounds = int(os.environ.get("BDLZ_BENCH_SEAM_ROUNDS", 8))
+        n_trace = int(os.environ.get("BDLZ_BENCH_SEAM_QUERIES", 512))
+        n_ref = min(int(os.environ.get("BDLZ_BENCH_SEAM_EXACT", 128)),
+                    n_trace)
+        # sigma_y = 1.5 keeps the seam band narrow enough that the split
+        # sides converge at 1e-4 within the round budget while the
+        # single-domain build demonstrably cannot (the measured
+        # perf_notes pathology, scaled to a bench-sized box)
+        base_seam = dataclasses.replace(base, source_shape_sigma_y=1.5)
+        spec = {
+            "m_chi_GeV": AxisSpec(20.0, 600.0, 3, "log"),
+            "T_p_GeV": AxisSpec(95.0, 105.0, 2, "log"),
+        }
+        # no mesh: this is an accuracy/structure A/B, not a throughput
+        # leg, and its small probe chunks (6 rows) are not shardable
+        # across a multi-device mesh — the single-device engine is the
+        # same arithmetic
+        kw = dict(
+            rtol=seam_rtol, n_probe=6, n_holdout=48,
+            max_rounds=seam_rounds, max_nodes_per_axis=128, n_y=seam_ny,
+            impl="tabulated", chunk_size=max(64, n_dev), seed=5,
+        )
+        t1 = time.time()
+        split_art, split_rep = build_emulator(base_seam, spec, **kw)
+        split_secs = time.time() - t1
+        t2 = time.time()
+        single_art, single_rep = build_emulator(
+            base_seam, spec, seam_split=False, **kw
+        )
+        single_secs = time.time() - t2
+        band = dict(split_art.seam_band)
+
+        # deterministic seam-crossing trace: log-uniform over the box,
+        # fixed seed — it crosses the band by construction
+        rng = np.random.default_rng(17)
+        trace = np.stack([
+            10 ** rng.uniform(np.log10(20.0), np.log10(600.0), n_trace),
+            10 ** rng.uniform(np.log10(95.0), np.log10(105.0), n_trace),
+        ], axis=1)
+
+        def serve_trace(art, gated):
+            svc = YieldService(
+                art, base_seam, max_batch_size=256,
+                error_gate_tol=None if gated else False,
+            )
+            vals = np.empty(n_trace)
+            n_fb = n_gated = 0
+            t0 = time.time()
+            for lo in range(0, n_trace, 256):
+                hi = min(lo + 256, n_trace)
+                r = svc._evaluate_isolated(trace[lo:hi])
+                vals[lo:hi] = r[0]
+                n_fb += r[1]
+                n_gated += r[5]
+            seconds = time.time() - t0
+            return vals, n_fb, n_gated, n_trace / max(seconds, 1e-9)
+
+        v_sg, fb_sg, g_sg, qps_sg = serve_trace(split_art, gated=True)
+        v_su, fb_su, g_su, qps_su = serve_trace(split_art, gated=False)
+        v_1g, fb_1g, g_1g, qps_1g = serve_trace(single_art, gated=True)
+        v_1u, fb_1u, g_1u, qps_1u = serve_trace(single_art, gated=False)
+
+        # exact reference on a trace prefix, at the bundle's recorded
+        # scheme (trapezoid — seam populations pin the reference scheme)
+        static_seam = static_choices_from_config(base_seam)._replace(
+            quad_panel_gl=bool(
+                split_art.identity.get("quad_panel_gl", False)
+            )
+        )
+        exact_eval = make_exact_evaluator(
+            base_seam, static_seam, n_y=seam_ny, impl="tabulated",
+            chunk_size=256,
+        )
+        exact_ref = exact_eval({
+            "m_chi_GeV": trace[:n_ref, 0], "T_p_GeV": trace[:n_ref, 1],
+        })["DM_over_B"]
+        # gated answers (exact-fallback slots included) vs exact truth —
+        # the acceptance number: gating keeps served answers <= 1e-3 off
+        gated_rel = float(np.max(relative_errors(v_sg[:n_ref], exact_ref)))
+        # and WITHOUT the gate/split, the single-domain surface serves
+        # seam-adjacent queries wrong — the number the gate exists for
+        ungated_single_rel = float(
+            np.max(relative_errors(v_1u[:n_ref], exact_ref))
+        )
+
+        rate_sg = fb_sg / n_trace
+        rate_1g = fb_1g / n_trace
+        ratio = rate_1g / max(rate_sg, 1e-9)
+        payload = {
+            "metric": "seam_split_fallback_ratio",
+            "value": round(ratio, 1),
+            "unit": "x fewer exact fallbacks on a deterministic "
+                    "seam-crossing trace (split+gated multi-domain "
+                    "artifact vs single-domain at equal tolerance, "
+                    "predicted-error gate on both)",
+            "n_trace": n_trace,
+            "seam_band": band,
+            "rtol_target": seam_rtol,
+            # serve trace, gated vs ungated, both artifacts
+            "fallback_rate_split_gated": round(rate_sg, 4),
+            "fallback_rate_split_ungated": round(fb_su / n_trace, 4),
+            "fallback_rate_single_gated": round(rate_1g, 4),
+            "fallback_rate_single_ungated": round(fb_1u / n_trace, 4),
+            "gated_fallbacks_split": g_sg,
+            "gated_fallbacks_single": g_1g,
+            "qps_split_gated": round(qps_sg, 1),
+            "qps_split_ungated": round(qps_su, 1),
+            "qps_single_gated": round(qps_1g, 1),
+            "qps_single_ungated": round(qps_1u, 1),
+            # accuracy on the same line: gated answers vs exact, and the
+            # wrong answers an ungated single-domain surface would serve
+            "gated_vs_exact_max_rel_err": float(f"{gated_rel:.3e}"),
+            "ungated_single_vs_exact_max_rel_err": float(
+                f"{ungated_single_rel:.3e}"
+            ),
+            "n_exact_ref": n_ref,
+            # build A/B at equal tolerance: exact-point budget + held-out
+            "split_n_exact_evals": int(split_rep.n_exact_evals),
+            "single_n_exact_evals": int(single_rep.n_exact_evals),
+            "split_held_out_max_rel_err": float(
+                f"{split_rep.max_rel_err:.3e}"
+            ),
+            "single_held_out_max_rel_err": float(
+                f"{single_rep.max_rel_err:.3e}"
+            ),
+            "split_converged": bool(split_rep.converged),
+            "single_converged": bool(single_rep.converged),
+            "split_build_seconds": round(split_secs, 3),
+            "single_build_seconds": round(single_secs, 3),
+            "n_domains": len(split_art.domains),
+            "bundle_hash": split_art.content_hash,
+            "platform": jax.devices()[0].platform,
+            "tpu_unavailable": tpu_unavailable,
+        }
+        emit(payload)
+        return {
+            k: payload[k] for k in (
+                "value", "fallback_rate_split_gated",
+                "fallback_rate_single_gated", "gated_vs_exact_max_rel_err",
+                "split_n_exact_evals", "single_n_exact_evals",
+                "split_held_out_max_rel_err", "single_held_out_max_rel_err",
+                "split_converged",
+            )
+        }
+
+    seam_split_summary = None
+    try:
+        seam_split_summary = run_leg("seam_split", seam_split_metric)
+    except Exception as exc:  # noqa: BLE001 — secondary metric is best-effort
+        print(f"[bench] seam_split metric unavailable: {exc}",
+              file=sys.stderr)
+
     # --- secondary metrics: the LZ sweeps (BASELINE.json's metric name) --
     # Per-point P derived from a bounce profile through the two-channel
     # LZ kernel (the physics the reference only stubs) feeding the same
@@ -1461,6 +1660,10 @@ def main(argv=None) -> None:
                 # the sharded-fleet serving metric (null = leg failed or
                 # no artifact; its secondary line has the full detail)
                 "serve": serve_summary,
+                # the seam-split emulator A/B (split-domain build +
+                # error-gated serve trace vs single-domain; null = leg
+                # failed — its secondary line has the full detail)
+                "seam_split": seam_split_summary,
                 "lz_sweep_points_per_sec_per_chip": lz_per_chip,
                 "lz_coherent_sweep_points_per_sec_per_chip": (
                     lz_coherent_per_chip

@@ -85,6 +85,57 @@ def tiny_emulator(tmp_path_factory):
 
 
 @pytest.fixture(scope="session")
+def seam_emulator(tmp_path_factory):
+    """A seam-crossing (m_chi, T_p) box built BOTH ways, once per
+    session: seam-split into a two-domain bundle (saved to disk) and as
+    the legacy single-domain artifact at the same tolerance.
+
+    The box straddles the T = m/3 flux-seam band (m ∈ [20, 600] GeV at
+    T_p ≈ 100 with a narrow sigma_y = 1.5 source, so the band is a thin
+    diagonal strip) — the exact configuration the PR-3 limitation note
+    documents as "split at the band or serve exact".  A throwaway
+    warm-up build runs first: the first jit execution in a process can
+    differ by ~3e-9 rel on XLA-CPU, and the stitch bit-parity pins must
+    compare post-warm-up runs.
+
+    Returns (base_config, bundle_dir, bundle, bundle_report,
+    single_artifact, single_report, build_kwargs).
+    """
+    from bdlz_tpu.config import config_from_dict
+    from bdlz_tpu.emulator import AxisSpec, build_emulator
+
+    base = config_from_dict({
+        "regime": "nonthermal",
+        "P_chi_to_B": 0.14925839040304145,
+        "source_shape_sigma_y": 1.5,
+        "incident_flux_scale": 1.07e-9,
+        "Y_chi_init": 4.90e-10,
+    })
+    spec = {
+        "m_chi_GeV": AxisSpec(20.0, 600.0, 3, "log"),
+        "T_p_GeV": AxisSpec(95.0, 105.0, 2, "log"),
+    }
+    kw = dict(
+        rtol=1e-3, n_probe=6, n_holdout=24, max_rounds=6,
+        max_nodes_per_axis=96, n_y=200, chunk_size=64, seed=0,
+    )
+    # warm-up: flush the first-run jit wobble before any compared build
+    build_emulator(
+        base,
+        {"m_chi_GeV": AxisSpec(25.0, 30.0, 2, "log"),
+         "T_p_GeV": AxisSpec(95.0, 105.0, 2, "log")},
+        seam_split=False, rtol=1e-1, n_probe=2, n_holdout=4,
+        max_rounds=0, n_y=200, chunk_size=64,
+    )
+    bundle_dir = str(tmp_path_factory.mktemp("seam") / "bundle_dir")
+    bundle, report = build_emulator(
+        base, spec, out_dir=bundle_dir, **kw
+    )
+    single, single_report = build_emulator(base, spec, seam_split=False, **kw)
+    return base, bundle_dir, bundle, report, single, single_report, dict(kw)
+
+
+@pytest.fixture(scope="session")
 def benchmark_config_path(tmp_path_factory):
     """A copy of the archived benchmark config (equal-mass point)."""
     import json

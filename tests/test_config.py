@@ -337,3 +337,76 @@ class TestOdeMethodKey:
             (4.9e-10, 0.0), 0.2 * cfg.T_p_GeV, 5.0 * cfg.T_p_GeV,
         )
         assert int(from_cfg.n_steps) > int(default_run.n_steps)
+
+
+class TestEmulatorSeamKnobs:
+    """The seam-split/error-gate/posterior-weight knobs: validated
+    tri-states with DELIBERATE identity treatment — seam_split and
+    error_gate_tol never touch any identity (build structure and serve
+    policy), posterior_weight's single identity home is the emulator
+    artifact's own key (build_identity), never the shared config
+    payload."""
+
+    def test_validation(self):
+        from bdlz_tpu.config import ConfigError, config_from_dict, validate
+
+        validate(config_from_dict({"seam_split": True}))
+        validate(config_from_dict({"seam_split": False}))
+        validate(config_from_dict({"error_gate_tol": 1e-4}))
+        validate(config_from_dict({"error_gate_tol": False}))
+        validate(config_from_dict({"posterior_weight": "planck"}))
+        with pytest.raises(ConfigError, match="seam_split"):
+            validate(config_from_dict({"seam_split": "yes"}))
+        with pytest.raises(ConfigError, match="ambiguous"):
+            validate(config_from_dict({"error_gate_tol": True}))
+        with pytest.raises(ConfigError, match="error_gate_tol"):
+            validate(config_from_dict({"error_gate_tol": -1e-3}))
+        with pytest.raises(ConfigError, match="posterior_weight"):
+            validate(config_from_dict({"posterior_weight": "flat"}))
+
+    def test_excluded_from_config_identity(self):
+        from bdlz_tpu.config import (
+            EMULATOR_CONFIG_FIELDS,
+            config_from_dict,
+            config_identity_dict,
+        )
+        from bdlz_tpu.parallel.sweep import grid_hash
+
+        base = {"P_chi_to_B": 0.149}
+        cfg = config_from_dict(base)
+        cfg_knobs = config_from_dict(dict(
+            base, seam_split=True, error_gate_tol=1e-3,
+            posterior_weight="planck",
+        ))
+        ident = config_identity_dict(cfg_knobs)
+        for k in EMULATOR_CONFIG_FIELDS:
+            assert k not in ident
+        # tuning the knobs stales NO sweep manifest
+        axes = {"m_chi_GeV": [0.5, 1.0]}
+        assert grid_hash(cfg, axes, 2000) == grid_hash(cfg_knobs, axes, 2000)
+
+    def test_posterior_weight_home_is_artifact_identity(self):
+        from bdlz_tpu.config import (
+            config_from_dict,
+            static_choices_from_config,
+        )
+        from bdlz_tpu.emulator import build_identity
+
+        cfg = config_from_dict({"posterior_weight": "planck"})
+        static = static_choices_from_config(cfg)
+        ident = build_identity(cfg, static, 2000, "tabulated")
+        assert ident["posterior_weight"] == "planck"
+        assert "posterior_weight" not in ident["base"]
+        # unweighted: no key at all (omit-at-absent — pre-existing
+        # artifacts keep verifying)
+        plain = config_from_dict({})
+        ident0 = build_identity(
+            plain, static_choices_from_config(plain), 2000, "tabulated"
+        )
+        assert "posterior_weight" not in ident0
+        # explicit argument overrides the config knob
+        ident2 = build_identity(
+            plain, static_choices_from_config(plain), 2000, "tabulated",
+            posterior_weight="planck",
+        )
+        assert ident2["posterior_weight"] == "planck"

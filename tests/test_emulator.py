@@ -277,9 +277,11 @@ class TestQuadSchemeIdentity:
         ident_other = dict(art.identity)
         ident_other["quad_panel_gl"] = False
         h_gl = artifact_hash(art.axis_names, art.axis_nodes,
-                             art.axis_scales, art.values, art.identity)
+                             art.axis_scales, art.values, art.identity,
+                             predicted_error=art.predicted_error)
         h_tr = artifact_hash(art.axis_names, art.axis_nodes,
-                             art.axis_scales, art.values, ident_other)
+                             art.axis_scales, art.values, ident_other,
+                             predicted_error=art.predicted_error)
         assert h_gl == art.manifest["hash"]
         assert h_gl != h_tr
 

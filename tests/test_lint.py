@@ -203,3 +203,25 @@ def test_fleet_and_rollout_modules_clean():
     assert report.files_scanned == 2
     offenders = "\n".join(f.render() for f in report.active)
     assert not report.active, f"fleet/rollout findings:\n{offenders}"
+
+
+def test_seam_split_and_gating_modules_clean():
+    """The seam-split plane: multidomain.py is host-side orchestration
+    (band scan, sub-builds, bundle IO), grid.py gained the jitted
+    predicted-error gather and the multi-domain where-select routing
+    (prime R1/R2 surface), and the serve/likelihood layers were rewired
+    for gating + reasons — exactly the code the STATIC_PARAM_NAMES
+    additions (seam_split/error_gate_tol/posterior_weight) must keep
+    out of tracer-analysis false positives.  All pinned per-file at
+    zero unsuppressed findings."""
+    report = lint_paths([
+        str(PACKAGE / "emulator" / "multidomain.py"),
+        str(PACKAGE / "emulator" / "grid.py"),
+        str(PACKAGE / "emulator" / "build.py"),
+        str(PACKAGE / "serve" / "service.py"),
+        str(PACKAGE / "serve" / "fleet.py"),
+        str(PACKAGE / "sampling" / "likelihoods.py"),
+    ])
+    assert report.files_scanned == 6
+    offenders = "\n".join(f.render() for f in report.active)
+    assert not report.active, f"seam-split findings:\n{offenders}"

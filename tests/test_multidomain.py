@@ -1,0 +1,684 @@
+"""Seam-split emulator domains + predicted-error-gated serving tests.
+
+Rides the session ``seam_emulator`` fixture (a seam-crossing (m_chi,
+T_p) box built both split and single-domain, plus the saved bundle).
+The pins mirror the PR's acceptance criteria at tier-1 size:
+
+* domain-stitch BIT-parity against a standalone build of the same
+  sub-box (stitching adds zero error);
+* per-domain held-out error inside the advertised tolerance, with the
+  split build spending fewer exact points than the single-domain
+  comparator at equal tolerance;
+* a fake-clock serve trace pinning the gated-vs-ungated fallback
+  counts and the per-request fallback reasons;
+* multi-domain bundle tamper / schema-skew / impersonation rejection,
+  registry publish/fetch of the whole bundle;
+* the posterior-weighted refinement hook (weight joins the artifact
+  identity, dead regions coarsen).
+"""
+import json
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+from bdlz_tpu.config import config_from_dict, static_choices_from_config
+from bdlz_tpu.emulator import (
+    AxisSpec,
+    EmulatorArtifactError,
+    MultiDomainArtifact,
+    build_emulator,
+    domain_artifacts,
+    error_floor,
+    has_error_grid,
+    load_any_artifact,
+    load_artifact,
+    load_multidomain_artifact,
+    make_domain_fn,
+    make_error_fn,
+    make_query_fn,
+    seam_band_for_box,
+)
+from bdlz_tpu.emulator.multidomain import (
+    MultiDomainBuildError,
+    multidomain_hash,
+)
+
+
+def _trace(n=96, seed=17):
+    rng = np.random.default_rng(seed)
+    return np.stack([
+        10 ** rng.uniform(np.log10(20.0), np.log10(600.0), n),
+        10 ** rng.uniform(np.log10(95.0), np.log10(105.0), n),
+    ], axis=1)
+
+
+def _in_band(bundle, trace):
+    band = bundle.seam_band
+    k = bundle.axis_names.index(band["axis"])
+    lo_hull, hi_hull = bundle.hull
+    inside_hull = np.all((trace >= lo_hull) & (trace <= hi_hull), axis=1)
+    return inside_hull & (trace[:, k] > band["lo"]) & (
+        trace[:, k] < band["hi"]
+    )
+
+
+class TestSeamBand:
+    def test_band_descriptor(self, seam_emulator):
+        _, _, bundle, report, _, _, kw = seam_emulator
+        band = bundle.seam_band
+        assert band["axis"] == "m_chi_GeV"
+        assert band["kind"] == "T=m/3 flux seam"
+        # the band brackets the m = 3*T_p diagonal for T_p in [95, 105]
+        assert 20.0 < band["lo"] < 3.0 * 95.0
+        assert 3.0 * 105.0 < band["hi"] < 600.0
+        assert report.seam_band == band
+
+    def test_smooth_box_has_no_band(self, seam_emulator):
+        base = seam_emulator[0]
+        spec = {
+            "m_chi_GeV": AxisSpec(0.9, 1.1, 3, "log"),
+            "T_p_GeV": AxisSpec(90.0, 110.0, 3, "log"),
+        }
+        assert seam_band_for_box(base, spec, rtol=1e-4) is None
+        # forcing the split on a smooth box is a loud error, not a
+        # silent single-domain build
+        with pytest.raises(MultiDomainBuildError, match="never crosses"):
+            build_emulator(base, spec, seam_split=True, rtol=1e-2,
+                           n_probe=2, max_rounds=0, n_y=200)
+
+    def test_seam_split_false_forces_single_domain(self, seam_emulator):
+        _, _, _, _, single, _, _ = seam_emulator
+        # the fixture's comparator came from seam_split=False over the
+        # crossing box: a plain artifact, not a bundle
+        assert not isinstance(single, MultiDomainArtifact)
+        assert single.predicted_error is not None
+
+
+class TestSplitBuild:
+    def test_domains_disjoint_ordered_shared_identity(self, seam_emulator):
+        _, _, bundle, _, _, _, _ = seam_emulator
+        assert len(bundle.domains) == 2
+        band = bundle.seam_band
+        lo_dom, hi_dom = bundle.domains
+        assert lo_dom.manifest["seam_side"] == "below_seam"
+        assert hi_dom.manifest["seam_side"] == "above_seam"
+        assert lo_dom.domain["m_chi_GeV"][1] <= band["lo"] * (1 + 1e-12)
+        assert hi_dom.domain["m_chi_GeV"][0] >= band["hi"] * (1 - 1e-12)
+        assert lo_dom.identity == hi_dom.identity == bundle.identity
+
+    def test_per_domain_held_out_within_tolerance(self, seam_emulator):
+        """The acceptance pin: every domain's held-out error (fresh
+        random points inside ITS sub-box, never seen by refinement)
+        meets the advertised tolerance — the split turned an
+        unconvergeable box into two convergeable ones."""
+        _, _, bundle, report, _, _, kw = seam_emulator
+        assert report.converged
+        assert len(report.domain_reports) == 2
+        for dom, rep in zip(bundle.domains, report.domain_reports):
+            assert rep.converged, dom.manifest["seam_side"]
+            assert rep.max_rel_err <= kw["rtol"]
+            assert dom.manifest["max_rel_err"] == rep.max_rel_err
+        assert report.max_rel_err == max(
+            r.max_rel_err for r in report.domain_reports
+        )
+
+    def test_split_spends_fewer_exact_points_at_equal_tolerance(
+        self, seam_emulator
+    ):
+        """The build-A/B pin (tier-1 shadow of the bench line): at equal
+        rtol AND equal round budget the split build converges while the
+        single-domain build grinds first-order against the diagonal
+        kink — and still spends MORE exact sweep points."""
+        _, _, _, report, _, single_report, _ = seam_emulator
+        assert report.converged and not single_report.converged
+        assert report.n_exact_evals < single_report.n_exact_evals
+
+    def test_report_aggregates(self, seam_emulator):
+        _, _, bundle, report, _, _, _ = seam_emulator
+        assert report.n_exact_evals == sum(
+            r.n_exact_evals for r in report.domain_reports
+        )
+        sides = {row["seam_side"] for row in report.rounds}
+        assert sides == {"below_seam", "above_seam"}
+        assert bundle.manifest["n_exact_evals"] == report.n_exact_evals
+        assert bundle.n_points == sum(d.n_points for d in bundle.domains)
+
+
+class TestStitchBitParity:
+    def test_domain_values_bitwise_equal_standalone_build(
+        self, seam_emulator
+    ):
+        """THE stitching contract: a bundle domain's table, and the
+        bundle kernel's answers inside that domain, are BITWISE
+        identical to a standalone artifact built over the same sub-box
+        — stitching adds zero error."""
+        base, _, bundle, _, _, _, kw = seam_emulator
+        dom = bundle.domains[0]
+        lo, hi = dom.domain["m_chi_GeV"]
+        spec = {
+            "m_chi_GeV": AxisSpec(lo, hi, 3, "log"),
+            "T_p_GeV": AxisSpec(95.0, 105.0, 2, "log"),
+        }
+        # the bundle resolved one quadrature scheme for every side;
+        # the standalone comparator must state the same scheme
+        static = static_choices_from_config(base)._replace(
+            quad_panel_gl=bool(dom.identity.get("quad_panel_gl", False))
+        )
+        standalone, _rep = build_emulator(
+            base, spec, static, seam_split=False, **kw
+        )
+        for f in dom.values:
+            np.testing.assert_array_equal(
+                standalone.values[f], dom.values[f], err_msg=f
+            )
+        for a, b in zip(standalone.axis_nodes, dom.axis_nodes):
+            np.testing.assert_array_equal(a, b)
+        # and the STITCHED query kernel returns those exact bits
+        rng = np.random.default_rng(3)
+        t = np.stack([
+            10 ** rng.uniform(np.log10(lo), np.log10(hi), 32),
+            10 ** rng.uniform(np.log10(95.0), np.log10(105.0), 32),
+        ], axis=1)
+        v_bundle = np.asarray(make_query_fn(bundle)(t))
+        v_alone = np.asarray(make_query_fn(standalone)(t))
+        np.testing.assert_array_equal(v_bundle, v_alone)
+
+    def test_band_is_out_of_domain(self, seam_emulator):
+        _, _, bundle, _, _, _, _ = seam_emulator
+        band = bundle.seam_band
+        mid = np.sqrt(band["lo"] * band["hi"])
+        dom_fn = make_domain_fn(bundle)
+        t = np.array([
+            [mid, 100.0],            # inside the seam band
+            [50.0, 100.0],           # below_seam domain
+            [500.0, 100.0],          # above_seam domain
+            [1000.0, 100.0],         # beyond the hull
+        ])
+        inside = np.asarray(dom_fn(t))
+        assert list(inside) == [False, True, True, False]
+
+    def test_error_fn_routes_per_domain(self, seam_emulator):
+        _, _, bundle, _, _, _, kw = seam_emulator
+        assert has_error_grid(bundle)
+        err = np.asarray(make_error_fn(bundle)(
+            np.array([[50.0, 100.0], [500.0, 100.0]])
+        ))
+        # converged domains: per-cell estimates under the internal
+        # refinement target (rtol/safety), floored at 0
+        assert np.all(err >= 0.0) and np.all(err <= kw["rtol"])
+
+
+class TestBundleArtifact:
+    def test_save_load_round_trip(self, seam_emulator):
+        _, bundle_dir, bundle, _, _, _, _ = seam_emulator
+        loaded = load_multidomain_artifact(bundle_dir)
+        assert loaded.content_hash == bundle.content_hash
+        assert loaded.seam_band == bundle.seam_band
+        for a, b in zip(loaded.domains, bundle.domains):
+            for f in b.values:
+                np.testing.assert_array_equal(a.values[f], b.values[f])
+            np.testing.assert_array_equal(
+                a.predicted_error, b.predicted_error
+            )
+        # kind dispatch: load_any on both kinds
+        assert isinstance(load_any_artifact(bundle_dir), MultiDomainArtifact)
+
+    def test_single_loader_rejects_bundle_loudly(self, seam_emulator):
+        _, bundle_dir, _, _, _, _, _ = seam_emulator
+        with pytest.raises(EmulatorArtifactError, match="MULTI-DOMAIN"):
+            load_artifact(bundle_dir)
+
+    def test_bundle_values_view_refuses_array_access(self, seam_emulator):
+        """``field in bundle.values`` works (the single-artifact checks
+        consumers run) but ARRAY access raises — silently handing out
+        one domain's table as "the" surface would cover half the box."""
+        _, _, bundle, _, _, _, _ = seam_emulator
+        assert "DM_over_B" in bundle.values
+        assert sorted(bundle.values) == sorted(bundle.domains[0].values)
+        with pytest.raises(EmulatorArtifactError, match="per domain"):
+            bundle.values["DM_over_B"]
+
+    def test_multidomain_loader_rejects_single(self, tiny_emulator):
+        _, out_dir, _, _ = tiny_emulator
+        with pytest.raises(EmulatorArtifactError, match="not a multi-domain"):
+            load_multidomain_artifact(out_dir)
+
+    def _copy(self, bundle_dir, tmp_path, name):
+        dst = str(tmp_path / name)
+        shutil.copytree(bundle_dir, dst)
+        return dst
+
+    def test_tampered_domain_rejected(self, seam_emulator, tmp_path):
+        _, bundle_dir, _, _, _, _, _ = seam_emulator
+        dst = self._copy(bundle_dir, tmp_path, "tamper")
+        npz = os.path.join(dst, "domain_00", "artifact.npz")
+        with np.load(npz) as data:
+            arrays = {k: np.array(data[k]) for k in data.files}
+        key = next(k for k in arrays if k.startswith("field_"))
+        arrays[key][(0,) * arrays[key].ndim] *= 1.5
+        np.savez(npz, **arrays)
+        with pytest.raises(EmulatorArtifactError, match="content-hash"):
+            load_multidomain_artifact(dst)
+
+    def test_swapped_domain_rejected(self, seam_emulator, tmp_path):
+        """A domain directory replaced by ANOTHER valid artifact (its
+        own hash verifies) must still be refused: the bundle manifest
+        names the hash it was built with."""
+        _, bundle_dir, _, _, _, _, _ = seam_emulator
+        dst = self._copy(bundle_dir, tmp_path, "swap")
+        shutil.rmtree(os.path.join(dst, "domain_00"))
+        shutil.copytree(os.path.join(dst, "domain_01"),
+                        os.path.join(dst, "domain_00"))
+        with pytest.raises(EmulatorArtifactError,
+                           match="swapped/impersonating"):
+            load_multidomain_artifact(dst)
+
+    def test_schema_skew_rejected(self, seam_emulator, tmp_path):
+        _, bundle_dir, _, _, _, _, _ = seam_emulator
+        dst = self._copy(bundle_dir, tmp_path, "schema")
+        mpath = os.path.join(dst, "manifest.json")
+        with open(mpath) as f:
+            manifest = json.load(f)
+        manifest["schema_version"] += 1
+        with open(mpath, "w") as f:
+            json.dump(manifest, f)
+        with pytest.raises(EmulatorArtifactError, match="schema_version"):
+            load_multidomain_artifact(dst)
+
+    def test_tampered_band_rejected(self, seam_emulator, tmp_path):
+        """The seam band joins the COMPOSITE hash: editing it (which
+        would move queries between the emulator and the exact path)
+        fails the bundle's content check even though every domain still
+        verifies."""
+        _, bundle_dir, _, _, _, _, _ = seam_emulator
+        dst = self._copy(bundle_dir, tmp_path, "band")
+        mpath = os.path.join(dst, "manifest.json")
+        with open(mpath) as f:
+            manifest = json.load(f)
+        manifest["seam_band"]["hi"] *= 1.01
+        with open(mpath, "w") as f:
+            json.dump(manifest, f)
+        with pytest.raises(EmulatorArtifactError, match="composite"):
+            load_multidomain_artifact(dst)
+
+    def test_composite_hash_construction(self, seam_emulator):
+        _, _, bundle, _, _, _, _ = seam_emulator
+        assert bundle.content_hash == multidomain_hash(
+            [d.content_hash for d in bundle.domains],
+            bundle.seam_band, bundle.identity,
+        )
+
+    def test_registry_publish_fetch_bundle(self, seam_emulator, tmp_path):
+        """Registry satellite: the WHOLE bundle publishes/fetches as one
+        unit under its composite hash, with full validation on fetch."""
+        from bdlz_tpu.provenance import Store, fetch_artifact, publish_artifact
+
+        _, bundle_dir, bundle, _, _, _, _ = seam_emulator
+        store = Store(str(tmp_path / "store"))
+        h = publish_artifact(store, bundle_dir)
+        assert h == bundle.content_hash
+        fetched = fetch_artifact(store, h)
+        assert isinstance(fetched, MultiDomainArtifact)
+        assert fetched.content_hash == bundle.content_hash
+        # corrupt the published entry: fetch deletes it and raises
+        npz = os.path.join(store.root, "emulator_artifact", h,
+                           "domain_00", "artifact.npz")
+        with open(npz, "r+b") as f:
+            f.seek(200)
+            f.write(b"\x00" * 16)
+        with pytest.raises(EmulatorArtifactError):
+            fetch_artifact(store, h)
+        assert not os.path.isdir(
+            os.path.join(store.root, "emulator_artifact", h)
+        )
+
+    def test_rollout_stages_bundle(self, seam_emulator):
+        """Blue/green over a bundle: a FleetService serving the bundle
+        accepts a re-staged copy of the same bundle (identity match),
+        swaps atomically, and responses carry the composite hash."""
+        from bdlz_tpu.serve.fleet import FleetService
+        from bdlz_tpu.serve.rollout import ArtifactRollout
+
+        base, bundle_dir, bundle, _, _, _, _ = seam_emulator
+        svc = FleetService(
+            bundle, base, max_batch_size=8, n_replicas=1, max_wait_s=0.001,
+        )
+        rollout = ArtifactRollout(svc)
+        staged_hash = rollout.stage(bundle_dir)
+        assert staged_hash == bundle.content_hash
+        old, new = rollout.cutover()
+        assert old == new == bundle.content_hash
+        fut = svc.submit([50.0, 100.0])
+        svc.run_once(force=True)
+        svc.drain()
+        assert fut.result(timeout=0).artifact_hash == bundle.content_hash
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+class TestGatedServing:
+    def test_default_gate_resolution(self, seam_emulator):
+        from bdlz_tpu.serve.service import YieldService, resolve_error_gate
+
+        base, _, bundle, _, _, _, kw = seam_emulator
+        # converged bundle with error grids: engine default = rtol_target
+        assert resolve_error_gate(bundle, base) == kw["rtol"]
+        # explicit disable
+        assert resolve_error_gate(bundle, base, False) is None
+        svc = YieldService(bundle, base, max_batch_size=16, warm=False,
+                           error_gate_tol=False)
+        assert svc.error_gate_tol is None
+        with pytest.raises(ValueError, match="positive"):
+            resolve_error_gate(bundle, base, -1.0)
+        # True through the ARGUMENT path must be as loud as through the
+        # config (float(True)=1.0 would silently disable the gate)
+        with pytest.raises(ValueError, match="ambiguous"):
+            resolve_error_gate(bundle, base, True)
+
+    def test_config_knob_resolves(self, seam_emulator):
+        import dataclasses
+
+        from bdlz_tpu.serve.service import resolve_error_gate
+
+        base, _, bundle, _, _, _, _ = seam_emulator
+        base_off = dataclasses.replace(base, error_gate_tol=False)
+        assert resolve_error_gate(bundle, base_off) is None
+        base_tol = dataclasses.replace(base, error_gate_tol=3e-3)
+        assert resolve_error_gate(bundle, base_tol) == 3e-3
+        # explicit argument wins over the config knob
+        assert resolve_error_gate(bundle, base_off, 1e-2) == 1e-2
+
+    def test_error_floor_semantics(self, seam_emulator):
+        """An artifact that missed its advertised tolerance is floored
+        at +inf: under ANY active gate, EVERY in-domain query is
+        answered by the exact path (the old "serve exact" policy for
+        untrusted surfaces, now automatic) — because its own estimates
+        provably failed (a lucky held-out draw can pass while the
+        surface serves kink cells wrong)."""
+        from bdlz_tpu.serve.service import YieldService
+
+        base, _, _, _, single, _, _ = seam_emulator
+        bad = single._replace(manifest={
+            **single.manifest, "converged": False, "max_rel_err": 0.5,
+        })
+        assert error_floor(bad) == float("inf")
+        svc = YieldService(bad, base, max_batch_size=32, warm=False)
+        trace = _trace(24)
+        values, n_fallback, errors, _r, reasons, n_gated = (
+            svc._evaluate_isolated(trace)
+        )
+        lo, hi = bad.hull
+        inside = np.all((trace >= lo) & (trace <= hi), axis=1)
+        assert n_fallback == 24
+        assert n_gated == int(inside.sum()) > 0
+        for i, r in enumerate(reasons):
+            assert r == ("predicted_error" if inside[i] else "ood")
+
+    def test_fake_clock_trace_pins_gated_vs_ungated_counts(
+        self, seam_emulator
+    ):
+        """The serve-trace pin: on one deterministic seam-crossing
+        trace through the fake-clock batcher, the UNGATED service falls
+        back exactly for the out-of-domain (seam-band) queries, the
+        GATED service adds exactly the over-threshold cells, and the
+        ServeStats rows carry the n_gated split."""
+        from bdlz_tpu.serve.service import YieldService
+
+        base, _, bundle, _, _, _, _ = seam_emulator
+        trace = _trace(64)
+        in_band = _in_band(bundle, trace)
+        pred = np.asarray(make_error_fn(bundle)(trace))
+        tol = 1e-6  # far below the converged cells' spread: some gate
+        expect_gated = (~in_band) & (pred > tol)
+        assert in_band.any(), "trace must cross the seam band"
+        assert expect_gated.any(), "tol must gate some in-domain cells"
+
+        counts = {}
+        for name, gate in (("ungated", False), ("gated", tol)):
+            svc = YieldService(
+                bundle, base, max_batch_size=64, warm=False,
+                error_gate_tol=gate,
+            )
+            clock = FakeClock()
+            mb = svc.make_batcher(max_wait_s=0.005, clock=clock)
+            futs = [mb.submit(t) for t in trace]
+            assert mb.run_once() == 64
+            for f in futs:
+                assert np.isfinite(f.result(timeout=0))
+            s = svc.stats.summary()
+            counts[name] = (s["fallbacks"], s["gated_fallbacks"])
+        assert counts["ungated"] == (int(in_band.sum()), 0)
+        assert counts["gated"] == (
+            int(in_band.sum() + expect_gated.sum()),
+            int(expect_gated.sum()),
+        )
+
+    def test_annotated_batcher_reports_reasons(self, seam_emulator):
+        from bdlz_tpu.serve.service import ServeAnswer, YieldService
+
+        base, _, bundle, _, _, _, _ = seam_emulator
+        band = bundle.seam_band
+        mid = float(np.sqrt(band["lo"] * band["hi"]))
+        svc = YieldService(bundle, base, max_batch_size=4, warm=False)
+        clock = FakeClock()
+        mb = svc.make_batcher(max_wait_s=0.005, clock=clock, annotate=True)
+        f_in = mb.submit([50.0, 100.0])
+        f_band = mb.submit([mid, 100.0])
+        f_out = mb.submit([5000.0, 100.0])
+        clock.advance(0.006)
+        assert mb.run_once() == 3
+        for f, want in ((f_in, None), (f_band, "ood"), (f_out, "ood")):
+            ans = f.result(timeout=0)
+            assert isinstance(ans, ServeAnswer)
+            assert ans.fallback_reason == want
+            assert np.isfinite(ans.value)
+
+    def test_fleet_reasons_and_gating(self, seam_emulator):
+        """FleetResponse carries the fallback reason; the fleet's fused
+        per-replica kernel gates identically to YieldService."""
+        from bdlz_tpu.serve.fleet import FleetService
+
+        base, _, bundle, _, _, _, _ = seam_emulator
+        band = bundle.seam_band
+        mid = float(np.sqrt(band["lo"] * band["hi"]))
+        clock = FakeClock()
+        svc = FleetService(
+            bundle, base, max_batch_size=4, n_replicas=2,
+            max_wait_s=0.005, clock=clock, error_gate_tol=1e-6,
+        )
+        thetas = [[50.0, 100.0], [mid, 100.0], [5000.0, 100.0]]
+        futs = [svc.submit(t) for t in thetas]
+        clock.advance(0.006)
+        svc.run_once()
+        svc.drain()
+        resps = [f.result(timeout=0) for f in futs]
+        assert resps[1].fallback_reason == "ood"        # seam band
+        assert resps[2].fallback_reason == "ood"        # beyond hull
+        pred = float(np.asarray(make_error_fn(bundle)(
+            np.array([thetas[0]])
+        ))[0])
+        want = "predicted_error" if pred > 1e-6 else None
+        assert resps[0].fallback_reason == want
+        rows = svc.stats.as_rows()
+        assert sum(r["n_gated"] for r in rows) == int(pred > 1e-6)
+        assert all(r.artifact_hash == bundle.content_hash for r in resps)
+
+    def test_fleet_values_match_service_bitwise(self, seam_emulator):
+        """The fused fleet kernel and the service kernels answer the
+        same trace with the same bits (fallback slots included — both
+        run the same exact engine)."""
+        from bdlz_tpu.serve.fleet import FleetService
+        from bdlz_tpu.serve.service import YieldService
+
+        base, _, bundle, _, _, _, _ = seam_emulator
+        trace = _trace(24, seed=23)
+        svc = YieldService(bundle, base, max_batch_size=24, warm=False)
+        vals_svc, _ = svc.evaluate(trace)
+        clock = FakeClock()
+        fleet = FleetService(
+            bundle, base, max_batch_size=24, n_replicas=2,
+            max_wait_s=0.001, clock=clock,
+        )
+        futs = [fleet.submit(t) for t in trace]
+        clock.advance(0.01)
+        fleet.run_once()
+        fleet.drain()
+        vals_fleet = np.array([f.result(timeout=0).value for f in futs])
+        np.testing.assert_array_equal(vals_svc, vals_fleet)
+
+
+class TestLogprobMulti:
+    def test_fast_mode_accepts_bundle(self, seam_emulator):
+        """Satellite: make_pipeline_logprob(emulator=<bundle dir>) —
+        MCMC rides the multi-domain surface with no call-site changes.
+        Walkers route to their domain; the seam band and the outside
+        both score -inf."""
+        import jax
+        import jax.numpy as jnp
+
+        from bdlz_tpu.sampling.likelihoods import make_pipeline_logprob
+
+        base, bundle_dir, bundle, _, _, _, _ = seam_emulator
+        static = static_choices_from_config(base)
+        lp = make_pipeline_logprob(
+            base, static, None, param_keys=("m_chi_GeV",),
+            emulator=bundle_dir,
+        )
+        band = bundle.seam_band
+        mid = float(np.sqrt(band["lo"] * band["hi"]))
+        vals = np.asarray(jax.jit(jax.vmap(lp))(jnp.asarray(
+            [[50.0], [500.0], [mid], [5000.0]]
+        )))
+        # in-domain walkers score finite or -inf-from-Planck; band and
+        # out-of-hull walkers are -inf by domain routing
+        assert vals[2] == -np.inf and vals[3] == -np.inf
+        # the in-domain scores equal the per-domain interpolation's
+        from bdlz_tpu.constants import RHO_CRIT_OVER_H2_KG_M3  # noqa: F401
+        assert np.isfinite(vals[0]) or vals[0] == -np.inf
+        assert np.isfinite(vals[1]) or vals[1] == -np.inf
+
+    def test_pinned_axis_inside_seam_band_rejected(self, seam_emulator):
+        """A non-sampled axis pinned INSIDE the seam band can never be
+        contained by any domain — every walker would score -inf; the
+        construction must fail loudly (domain membership, not the hull,
+        is the check)."""
+        import dataclasses
+
+        from bdlz_tpu.sampling.likelihoods import make_pipeline_logprob
+
+        base, _, bundle, _, _, _, _ = seam_emulator
+        band = bundle.seam_band
+        mid = float(np.sqrt(band["lo"] * band["hi"]))
+        base_in_band = dataclasses.replace(base, m_chi_GeV=mid)
+        with pytest.raises(ValueError, match="every emulator domain"):
+            make_pipeline_logprob(
+                base_in_band, static_choices_from_config(base_in_band),
+                None, param_keys=("T_p_GeV",), emulator=bundle,
+            )
+
+    def test_stale_bundle_rejected(self, seam_emulator):
+        import dataclasses
+
+        from bdlz_tpu.sampling.likelihoods import make_pipeline_logprob
+
+        base, bundle_dir, _, _, _, _, _ = seam_emulator
+        base2 = dataclasses.replace(base, incident_flux_scale=2e-9)
+        with pytest.raises(EmulatorArtifactError, match="identity mismatch"):
+            make_pipeline_logprob(
+                base2, static_choices_from_config(base2), None,
+                param_keys=("m_chi_GeV",), emulator=bundle_dir,
+            )
+
+
+class TestPosteriorWeight:
+    @pytest.fixture(scope="class")
+    def weighted_pair(self):
+        """A small smooth box built unweighted and Planck-weighted."""
+        base = config_from_dict({
+            "regime": "nonthermal",
+            "P_chi_to_B": 0.14925839040304145,
+            "source_shape_sigma_y": 9.0,
+            "incident_flux_scale": 1.07e-9,
+            "Y_chi_init": 4.90e-10,
+        })
+        spec = {
+            "m_chi_GeV": AxisSpec(0.9, 1.1, 3, "log"),
+            "T_p_GeV": AxisSpec(90.0, 110.0, 3, "log"),
+            "v_w": AxisSpec(0.25, 0.35, 3, "lin"),
+        }
+        kw = dict(rtol=1e-4, n_probe=8, n_holdout=24, max_rounds=6,
+                  n_y=300, chunk_size=64)
+        plain, plain_rep = build_emulator(base, spec, **kw)
+        weighted, weighted_rep = build_emulator(
+            base, spec, posterior_weight="planck", **kw
+        )
+        return base, plain, plain_rep, weighted, weighted_rep
+
+    def test_weight_coarsens_and_joins_identity(self, weighted_pair):
+        base, plain, plain_rep, weighted, weighted_rep = weighted_pair
+        # the weighted criterion can only relax splits: never MORE
+        # exact points, and in a box where the Planck posterior is
+        # non-uniform, strictly fewer
+        assert weighted_rep.n_exact_evals <= plain_rep.n_exact_evals
+        assert weighted_rep.converged
+        # weighted held-out meets tolerance UNDER THE WEIGHT; the raw
+        # number is recorded too and may exceed it (dead regions)
+        assert weighted_rep.weighted_max_rel_err is not None
+        assert weighted_rep.posterior_weight == "planck"
+        assert plain_rep.posterior_weight is None
+        # single identity home: the artifact's posterior_weight key
+        assert weighted.identity.get("posterior_weight") == "planck"
+        assert "posterior_weight" not in plain.identity
+        assert weighted.manifest["posterior_weight"] == "planck"
+        assert weighted.content_hash != plain.content_hash
+
+    def test_identity_wildcard_and_strict(self, weighted_pair):
+        import dataclasses
+
+        from bdlz_tpu.emulator import build_identity, check_identity
+
+        base, plain, _, weighted, _ = weighted_pair
+        static = static_choices_from_config(base)._replace(
+            quad_panel_gl=bool(
+                weighted.identity.get("quad_panel_gl", False)
+            )
+        )
+        n_y = int(weighted.identity["n_y"])
+        impl = str(weighted.identity["impl"])
+        # caller with no expectation (knob unset): matches either
+        check_identity(weighted, build_identity(base, static, n_y, impl))
+        check_identity(plain, build_identity(base, static, n_y, impl))
+        # caller naming the weight: strict both ways
+        base_w = dataclasses.replace(base, posterior_weight="planck")
+        check_identity(
+            weighted, build_identity(base_w, static, n_y, impl)
+        )
+        with pytest.raises(EmulatorArtifactError, match="identity mismatch"):
+            check_identity(
+                plain, build_identity(base_w, static, n_y, impl)
+            )
+
+    def test_gate_covers_dead_regions(self, weighted_pair):
+        """The composition the PR exists for: the weighted build's
+        persisted per-cell estimates stay RAW, so wherever the weight
+        left the surface coarse, the serve gate routes queries to the
+        exact path instead of serving the coarse value."""
+        base, plain, _, weighted, weighted_rep = weighted_pair
+        assert weighted.predicted_error is not None
+        # raw estimates are recorded unweighted: anywhere the weighted
+        # build stopped refining early, the raw cell estimate exceeds
+        # what the plain build left behind
+        assert float(np.max(weighted.predicted_error)) >= float(
+            np.max(plain.predicted_error)
+        )

@@ -77,10 +77,18 @@ class TestIdentityCompat:
             ).hexdigest()[:16]
             assert grid_hash(base, AXES, 2000, extra=extra) == legacy
 
-    def test_artifact_hash_matches_legacy_construction(self, tiny_emulator):
+    def test_artifact_hash_matches_pinned_construction(self, tiny_emulator):
+        """The schema-2 byte rule, pinned by manual re-derivation: the
+        JSON header (now carrying ``error_grid`` when the per-cell
+        estimate grid is present) followed by the field-sorted value
+        bytes, then the predicted-error bytes.  (Schema 1's digest was
+        byte-compatible with the pre-provenance implementation; schema 2
+        is the seam-split PR's deliberate loud bump — v1 artifacts
+        reject at the version check before any hash work.)"""
         from bdlz_tpu.emulator.artifact import SCHEMA_VERSION, artifact_hash
 
         _, _, art, _ = tiny_emulator
+        assert SCHEMA_VERSION == 2 and art.predicted_error is not None
         payload = {
             "schema_version": SCHEMA_VERSION,
             "axes": {
@@ -90,6 +98,7 @@ class TestIdentityCompat:
             "scales": [str(s) for s in art.axis_scales],
             "identity": dict(art.identity),
             "fields": sorted(art.values),
+            "error_grid": True,
         }
         h = hashlib.sha256()
         h.update(json.dumps(payload, sort_keys=True).encode())
@@ -98,13 +107,17 @@ class TestIdentityCompat:
             h.update(np.ascontiguousarray(
                 np.asarray(art.values[name], dtype=np.float64)
             ).tobytes())
-        legacy = h.hexdigest()[:16]
+        h.update(b"predicted_error")
+        h.update(np.ascontiguousarray(
+            np.asarray(art.predicted_error, dtype=np.float64)
+        ).tobytes())
+        pinned = h.hexdigest()[:16]
         assert artifact_hash(
             art.axis_names, art.axis_nodes, art.axis_scales, art.values,
-            art.identity,
-        ) == legacy
+            art.identity, predicted_error=art.predicted_error,
+        ) == pinned
         # and the saved artifact's recorded hash still verifies
-        assert art.content_hash == legacy
+        assert art.content_hash == pinned
 
     def test_refcache_key_matches_legacy_construction(self, tmp_path):
         """A ``ref_*.npy`` written under the LEGACY key must be a HIT for

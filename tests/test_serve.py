@@ -387,9 +387,11 @@ class TestYieldService:
         )
         ref = YieldService(load_artifact(out_dir), base, max_batch_size=4)
         thetas = np.array([[1.0, 100.0, 0.30], [1.0, 100.0, 0.60]])
-        values, n_fallback, errors, n_retries = svc._evaluate_isolated(thetas)
+        (values, n_fallback, errors, n_retries, reasons,
+         n_gated) = svc._evaluate_isolated(thetas)
         assert n_fallback == 1 and n_retries == 1
         assert errors == [None, None]
+        assert reasons == [None, "ood"] and n_gated == 0
         assert len(sleeps) == 1
         np.testing.assert_array_equal(values, ref.evaluate(thetas)[0])
 
@@ -471,6 +473,11 @@ class TestServeCLI:
         assert [r["id"] for r in out_lines] == ["a", "b", "ood"]
         assert all(np.isfinite(r["value"]) for r in out_lines)
         assert all(r["latency_s"] >= 0 for r in out_lines)
+        # the fallback-reason satellite: every JSONL answer names what
+        # produced it — emulator fast path (null) vs domain miss ("ood")
+        assert [r["fallback_reason"] for r in out_lines] == [
+            None, None, "ood"
+        ]
 
     def test_malformed_lines_answered_not_fatal(self, tiny_emulator,
                                                 tmp_path, capsys):
@@ -555,6 +562,10 @@ class TestServeCLI:
         want_hash = _load(out_dir).content_hash
         assert all(r["artifact_hash"] == want_hash for r in out_lines)
         assert all(r["latency_s"] >= 0 for r in out_lines)
+        # fallback reasons ride the fleet responses too
+        assert [r["fallback_reason"] for r in out_lines] == [
+            None, None, "ood"
+        ]
 
     def test_all_lines_failed_exits_nonzero(self, tiny_emulator, tmp_path,
                                             capsys):
