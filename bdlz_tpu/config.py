@@ -160,6 +160,31 @@ class Config:
     # many waiting requests is rejected with the typed QueueFull.
     # None = unbounded (the pre-fleet behavior).
     queue_bound: Optional[int] = None
+    # ---- replica health plane / circuit breakers (bdlz_tpu/serve/
+    # health.py, docs/robustness.md "Replica health plane") — same
+    # orchestration-only exclusion rule as the fleet-shape knobs. ----
+    # Tri-state gate: None = engine decides (ON for FleetService, the
+    # production front; the bare ReplicaSet / YieldService have no
+    # replicas to break), False = PR-8 behavior (byte-identical, zero
+    # overhead — pinned), True = force on.
+    health_enabled: Optional[bool] = None
+    # Sliding-window length (per-replica batch outcomes) the breaker
+    # scores over; a breaker opens when bad outcomes reach
+    # breaker_threshold * breaker_window within the window.
+    breaker_window: int = 8
+    breaker_threshold: float = 0.5
+    # Seconds (service clock) an open breaker cools down before one
+    # half-open probe batch is routed to the replica.
+    breaker_cooldown_s: float = 1.0
+    # Optional per-batch latency SLO: a batch slower than this counts
+    # as a bad outcome for its replica (None = latency not scored).
+    breaker_latency_slo_s: Optional[float] = None
+    # Post-cutover error budget for rollout auto-rollback: the staged
+    # artifact is rolled back when more than this fraction of its
+    # observed requests are bad (per-request errors, predicted-error
+    # gated fallbacks, or requests in latency-SLO-breaching batches)
+    # inside the observation window (serve/rollout.py).
+    rollback_budget: float = 0.1
     # ---- provenance / result-cache knobs (bdlz_tpu/provenance/,
     # docs/provenance.md) — orchestration like the serve knobs: caching
     # changes WHERE a result comes from, never its bits (the sweep_cache
@@ -267,7 +292,16 @@ ROBUSTNESS_CONFIG_FIELDS = (
 #: is bit-identical at any replica count (pinned by the fleet parity
 #: tests), and keying them into identities would stale every artifact
 #: whenever an operator resizes the fleet.
-SERVE_CONFIG_FIELDS = ("n_replicas", "queue_bound")
+SERVE_CONFIG_FIELDS = (
+    "n_replicas", "queue_bound",
+    # the replica health plane / auto-rollback knobs share the rule:
+    # breakers and budgets change WHICH replica (or which artifact
+    # generation) answers, never what any kernel computes — the healed
+    # re-answer is bit-identical by construction (pinned in
+    # tests/test_health.py)
+    "health_enabled", "breaker_window", "breaker_threshold",
+    "breaker_cooldown_s", "breaker_latency_slo_s", "rollback_budget",
+)
 
 #: Provenance-cache knobs with the same exclusion rule: a cache hit
 #: returns the bytes a cold run would compute (the sweep_cache bench
@@ -387,7 +421,7 @@ def validate(cfg: Config, backend: Optional[str] = None) -> Config:
         raise ConfigError("ode_rtol and ode_atol must be positive")
     for k in ("ode_auto_h0", "ode_pi_controller", "ode_tabulated_av",
               "quad_panel_gl", "fault_injection", "retry_enabled",
-              "cache_enabled", "seam_split"):
+              "cache_enabled", "seam_split", "health_enabled"):
         v = getattr(cfg, k)
         if v is not None and not isinstance(v, bool):
             raise ConfigError(f"{k} must be true, false, or null, got {v!r}")
@@ -426,6 +460,27 @@ def validate(cfg: Config, backend: Optional[str] = None) -> Config:
         raise ConfigError("n_replicas must be >= 1 (or null = all devices)")
     if cfg.queue_bound is not None and cfg.queue_bound < 1:
         raise ConfigError("queue_bound must be >= 1 (or null = unbounded)")
+    if cfg.breaker_window < 1:
+        raise ConfigError("breaker_window must be >= 1")
+    if not (0.0 < cfg.breaker_threshold <= 1.0):
+        raise ConfigError(
+            f"breaker_threshold must be a fraction in (0, 1], got "
+            f"{cfg.breaker_threshold!r}"
+        )
+    if not cfg.breaker_cooldown_s > 0.0:
+        raise ConfigError("breaker_cooldown_s must be > 0")
+    if cfg.breaker_latency_slo_s is not None and (
+        not float(cfg.breaker_latency_slo_s) > 0.0
+    ):
+        raise ConfigError(
+            "breaker_latency_slo_s must be > 0 (or null = latency not "
+            "scored)"
+        )
+    if not (0.0 < cfg.rollback_budget <= 1.0):
+        raise ConfigError(
+            f"rollback_budget must be a fraction in (0, 1], got "
+            f"{cfg.rollback_budget!r}"
+        )
     if cfg.cache_root is not None and not isinstance(cfg.cache_root, str):
         raise ConfigError(
             f"cache_root must be a directory path or null, got "

@@ -35,6 +35,25 @@ Sites and what their keys mean:
 ``serve_exact``
     The serve stack's exact out-of-domain fallback; ``key`` = fallback
     call counter (kinds ``raise``/``transient``).
+``replica_dispatch``
+    The serving fleet's per-replica micro-batch dispatch
+    (``serve/fleet.py``); ``key`` = REPLICA index (None = every
+    replica).  Kinds: ``raise`` (persistent dispatch error — device
+    lost), ``transient`` (fails ``times`` dispatches, then recovers),
+    ``nan`` (the batch's outputs are NaN-poisoned, detected at gather —
+    a sick kernel serving garbage; budgeted by ``times`` like a
+    transient, no ``point`` needed at this site), and ``slow``
+    (``delay_s`` seconds added to the batch's evaluation time through
+    the service's injectable clock — a latency outlier the health
+    plane must catch).  These drive the replica health plane /
+    circuit breakers (docs/robustness.md).
+``registry_fetch``
+    The provenance registry's artifact fetch
+    (:func:`bdlz_tpu.provenance.fetch_artifact` — the replica
+    re-provision path); ``key`` = fetch call counter.  Kinds ``torn``
+    (entry's payload truncated before the load — the corrupt-entry
+    eviction path) and ``corrupt`` (one flipped byte — content-hash
+    verification must refuse the entry).
 ``clock``
     Slow collections: :meth:`FaultPlan.delay_s` reports seconds a call
     site should add through its *injectable* clock/sleep seam (kind
@@ -53,8 +72,12 @@ import json
 import os
 from typing import Any, Dict, List, NamedTuple, Optional
 
-VALID_SITES = ("step", "chunk_write", "probe", "serve_exact", "clock")
-VALID_KINDS = ("raise", "transient", "poison", "nan", "torn", "slow")
+VALID_SITES = (
+    "step", "chunk_write", "probe", "serve_exact", "clock",
+    "replica_dispatch", "registry_fetch",
+)
+VALID_KINDS = ("raise", "transient", "poison", "nan", "torn", "slow",
+               "corrupt")
 
 #: Env var a plan is resolved from when neither the caller nor the
 #: config carries one (JSON text, or a path to a JSON file).
@@ -95,8 +118,19 @@ def _spec_from_obj(obj: Dict[str, Any]) -> FaultSpec:
         raise FaultPlanError(
             f"fault kind {kind!r} is not one of {VALID_KINDS}"
         )
-    if kind in ("poison", "nan") and obj.get("point") is None:
-        raise FaultPlanError(f"kind {kind!r} needs a 'point' (global index)")
+    # "nan" at the replica site is keyed by replica index (the whole
+    # batch is poisoned), not by a global grid point
+    if kind == "poison" and obj.get("point") is None:
+        raise FaultPlanError("kind 'poison' needs a 'point' (global index)")
+    if (
+        kind == "nan"
+        and obj.get("point") is None
+        and site != "replica_dispatch"
+    ):
+        raise FaultPlanError(
+            "kind 'nan' needs a 'point' (global index) outside "
+            "site 'replica_dispatch'"
+        )
     if kind == "transient" and obj.get("times") is None:
         raise FaultPlanError("kind 'transient' needs 'times' (fail budget)")
     known = {"site", "kind", "key", "point", "times", "delay_s", "chunk",
@@ -208,6 +242,46 @@ class FaultPlan:
                     raise FaultError(
                         f"injected poison point {p} in {site}[{lo}:{hi}]"
                     )
+
+    def nan_batch(self, site: str, key: int) -> bool:
+        """True when a key-addressed ``nan`` spec fires at (site, key) —
+        the replica-dispatch form: the whole batch's outputs are
+        NaN-poisoned (a sick kernel serving garbage), detected by the
+        health plane at gather.  Budgeted by ``times`` like a transient
+        (``None`` = every matching dispatch); point-keyed ``nan`` specs
+        (the sweep form) never match here."""
+        for i, spec in enumerate(self.specs):
+            if spec.kind != "nan" or spec.point is not None:
+                continue
+            if not self._matches(spec, site, key):
+                continue
+            if spec.times is not None and self._fired[i] >= int(spec.times):
+                continue  # budget spent: recovered
+            self._fired[i] += 1
+            return True
+        return False
+
+    def corrupt_bytes(self, site: str, key: int, path: str) -> bool:
+        """Flip one byte mid-``path`` if a ``corrupt`` spec matches —
+        content-hash verification downstream must refuse the entry.
+
+        Fires once per spec, like :meth:`corrupt_file`.  Returns True
+        when the file was corrupted.
+        """
+        for i, spec in enumerate(self.specs):
+            if spec.kind != "corrupt" or not self._matches(spec, site, key):
+                continue
+            if self._fired[i]:
+                continue
+            self._fired[i] += 1
+            size = os.path.getsize(path)
+            with open(path, "r+b") as f:
+                f.seek(size // 2)
+                byte = f.read(1)
+                f.seek(size // 2)
+                f.write(bytes([byte[0] ^ 0xFF]) if byte else b"\xff")
+            return True
+        return False
 
     def nan_points(self, site: str, lo: int, hi: int) -> List[int]:
         """Global indices in [lo, hi) whose outputs should be NaN-poisoned."""

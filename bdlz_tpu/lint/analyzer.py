@@ -113,6 +113,16 @@ STATIC_PARAM_NAMES = {
     "seam_split",
     "error_gate_tol",
     "posterior_weight",
+    # replica health plane / auto-rollback knobs (serve/health.py,
+    # serve/fleet.py, serve/rollout.py): breaker policies, the plane
+    # object, and the rollback budget are host-side orchestration —
+    # breakers pick WHICH replica answers, never what a kernel
+    # computes.  Same specific-names-only rule as above.
+    "health",
+    "health_enabled",
+    "breaker_window",
+    "breaker_threshold",
+    "rollback_budget",
     "n_y",
     "nz",
     "n_mu",

@@ -74,7 +74,33 @@ def publish_artifact(store: Store, artifact) -> str:
     return content_hash
 
 
-def fetch_artifact(store: Store, content_hash: str):
+#: Process-wide fetch call counter — the key injected ``registry_fetch``
+#: faults fire on (deterministic in a single process; reset in tests via
+#: :func:`reset_fetch_counter`).
+_fetch_calls = 0
+
+
+def reset_fetch_counter() -> None:
+    global _fetch_calls
+    _fetch_calls = 0
+
+
+def _inject_fetch_fault(fault_plan, key: int, path: str) -> None:
+    """Apply an armed ``registry_fetch`` fault to the entry BEFORE the
+    load: ``torn`` truncates its payload (the corrupt-entry eviction
+    path must detect-and-delete), ``corrupt`` flips one byte (the
+    content-hash verification must refuse it).  The damaged file is the
+    entry's ``artifact.npz`` when present, its ``manifest.json``
+    otherwise (a multi-domain bundle's top level)."""
+    for name in ("artifact.npz", "manifest.json"):
+        target = os.path.join(path, name)
+        if os.path.isfile(target):
+            fault_plan.corrupt_file("registry_fetch", key, target)
+            fault_plan.corrupt_bytes("registry_fetch", key, target)
+            return
+
+
+def fetch_artifact(store: Store, content_hash: str, fault_plan=None):
     """Load + fully validate the published artifact ``content_hash``
     (kind-dispatched: a single artifact or a multi-domain bundle).
 
@@ -82,11 +108,18 @@ def fetch_artifact(store: Store, content_hash: str):
     when the entry is absent, fails any load-time validation, or its
     verified hash is not the requested one (an impersonating or
     renamed entry); a corrupt entry is deleted first, so the next
-    publish starts clean."""
+    publish starts clean.  ``fault_plan`` (site ``registry_fetch``,
+    keyed by the per-process fetch call counter) exercises exactly
+    those refusal paths deterministically — see bdlz_tpu/faults.py."""
     from bdlz_tpu.emulator.artifact import EmulatorArtifactError
     from bdlz_tpu.emulator.multidomain import load_any_artifact
 
+    global _fetch_calls
+    fetch_key = _fetch_calls
+    _fetch_calls += 1
     path = os.path.join(store.root, ARTIFACT_KIND, str(content_hash))
+    if fault_plan is not None and os.path.isdir(path):
+        _inject_fetch_fault(fault_plan, fetch_key, path)
     if not os.path.isdir(path):
         store.stats.misses += 1
         raise EmulatorArtifactError(

@@ -6,17 +6,29 @@
 * sharded fleet (``fleet.py``) — per-device query replicas with
   round-robin / least-loaded micro-batch routing, bounded-queue
   admission control and deadline-aware load shedding;
+* replica health plane (``health.py``) — per-replica sliding-window
+  scores + closed→open→half-open circuit breakers, bit-identical batch
+  re-answer, registry re-provision, and a loud ``degraded=true`` exact
+  mode when every breaker is open;
 * zero-downtime artifact rollout (``rollout.py``) — stage artifact N+1
   beside N, warm its kernels, cut over atomically with multihost
-  agreement; responses always carry the artifact hash that answered.
+  agreement, and auto-roll-back when the post-cutover error budget is
+  blown; responses always carry the artifact hash that answered.
+
+The full typed-error surface exports here — ``QueueFull`` (admission),
+``DeadlineExceeded`` (shedding), ``ServiceUnavailable`` (closed
+service / dead degraded path), ``RolloutError`` (refused rollout
+steps) — and the serve CLI names them verbatim in its structured error
+records.
 
 Entry point: ``python -m bdlz_tpu.serve`` (``serve_cli.py``).  Semantics
-reference: docs/serving.md."""
+reference: docs/serving.md + docs/robustness.md."""
 from bdlz_tpu.serve.batcher import (  # noqa: F401
     BatchResult,
     DeadlineExceeded,
     MicroBatcher,
     QueueFull,
+    ServiceUnavailable,
     drain_results,
 )
 from bdlz_tpu.serve.fleet import (  # noqa: F401
@@ -24,8 +36,14 @@ from bdlz_tpu.serve.fleet import (  # noqa: F401
     FleetService,
     ReplicaSet,
 )
+from bdlz_tpu.serve.health import (  # noqa: F401
+    BreakerPolicy,
+    HealthPlane,
+    resolve_health_policy,
+)
 from bdlz_tpu.serve.rollout import ArtifactRollout, RolloutError  # noqa: F401
 from bdlz_tpu.serve.service import (  # noqa: F401
+    REASON_DEGRADED,
     REASON_OOD,
     REASON_PREDICTED_ERROR,
     ExactFallback,

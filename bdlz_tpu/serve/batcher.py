@@ -59,6 +59,21 @@ class QueueFull(RuntimeError):
     """
 
 
+class ServiceUnavailable(RuntimeError):
+    """The service cannot answer this request at all.
+
+    Delivered through futures when (a) a service is
+    :meth:`~bdlz_tpu.serve.fleet.FleetService.close`\\ d with the
+    request still pending/in flight — shutdown must FAIL futures, never
+    leave a caller blocked on ``result()`` forever — or (b) every
+    replica's circuit breaker is open AND the degraded exact-serving
+    path itself failed (the loud end of the degradation ladder,
+    docs/robustness.md).  Typed so callers/load-balancers can tell
+    "this instance is down, resubmit elsewhere" from an evaluation
+    failure.
+    """
+
+
 class BatchResult(NamedTuple):
     """What a process_batch callback returns: per-request values plus
     how many of them took the exact-pipeline fallback.

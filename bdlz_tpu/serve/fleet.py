@@ -22,6 +22,21 @@ module makes the batching/routing layer the product:
   latency.  Every response is a :class:`FleetResponse` carrying the
   hash of the artifact that answered it — the rollout layer's
   never-mix-surfaces guarantee is checkable per request.
+* **replica health plane** (:mod:`bdlz_tpu.serve.health`, default ON
+  for the fleet; ``health_enabled=false`` restores the pre-health
+  behavior byte-identically): per-replica sliding-window scores over
+  batch outcomes — dispatch failures, NaN outputs detected at gather
+  (the tables are finite/positive by construction, so a non-finite
+  interpolant is a sick kernel, not physics), latency-SLO breaches —
+  feed a closed→open→half-open circuit breaker per replica.  Open
+  replicas leave the routing pool; a failed/NaN batch is RE-ANSWERED
+  on a healthy replica (bit-identical — every replica runs the same
+  fused kernel on the same table bytes, pinned); a persistently sick
+  replica is re-provisioned from the provenance registry by content
+  hash; and when EVERY breaker is open the service answers through the
+  exact pipeline with ``degraded=True`` stamped on each response — or
+  a typed :class:`~bdlz_tpu.serve.batcher.ServiceUnavailable` when
+  even that path is dead — never a silent wrong answer.
 
 Design for testability (same contract as the batcher): every policy
 decision is a pure function of (queue state, now) on an injectable
@@ -60,8 +75,14 @@ from bdlz_tpu.emulator.grid import (
     predicted_error_one,
     select_domains,
 )
-from bdlz_tpu.serve.batcher import DeadlineExceeded, QueueFull
+from bdlz_tpu.serve.batcher import (
+    DeadlineExceeded,
+    QueueFull,
+    ServiceUnavailable,
+)
+from bdlz_tpu.serve.health import HealthPlane, resolve_health_policy
 from bdlz_tpu.serve.service import (
+    REASON_DEGRADED,
     ExactFallback,
     _pad_rows,
     gate_fallback_masks,
@@ -80,12 +101,15 @@ class FleetResponse(NamedTuple):
     emulator fast path answered).  The hash is stamped at DISPATCH
     time — during a rollout, in-flight batches resolve with the artifact
     they were actually answered by, never the one that became active
-    afterwards."""
+    afterwards.  ``degraded=True`` (replica ``-1``) marks an answer the
+    exact pipeline produced because EVERY replica breaker was open —
+    correct, loud, and slow, never silent."""
 
     value: float
     artifact_hash: str
     replica: int
     fallback_reason: Optional[str] = None
+    degraded: bool = False
 
 
 class _Replica:
@@ -183,6 +207,10 @@ class _Handle(NamedTuple):
     inside: Any          # (bucket,) bool device array
     pred_err: Any        # (bucket,) device array — per-cell estimate
     n: int               # live rows (bucket - n = padding)
+    #: An armed ``replica_dispatch``/``nan`` fault fired at dispatch:
+    #: gather NaN-poisons the values (a sick kernel serving garbage —
+    #: what the health plane must catch, bdlz_tpu/faults.py).
+    nan_injected: bool = False
 
     def done(self) -> bool:
         """True when the device work finished (no blocking).  Falls back
@@ -206,6 +234,8 @@ class _Handle(NamedTuple):
             pred_err = np.asarray(self.pred_err)[: self.n]
         finally:
             self.replica.in_flight -= 1
+        if self.nan_injected:
+            values[:] = np.nan
         return values, inside, pred_err
 
 
@@ -238,6 +268,7 @@ class ReplicaSet:
         warm: bool = True,
         stats: Optional[ServeStats] = None,
         error_gate: bool = True,
+        fault_plan=None,
     ):
         import jax
 
@@ -265,6 +296,9 @@ class ReplicaSet:
         #: gate-disabled fleet: the kernels return constant-0 estimates
         #: and pay no error gathers on the hot path).
         self.error_gate = bool(error_gate)
+        #: Injected replica faults (site ``replica_dispatch``, keyed by
+        #: replica index); None = the zero-overhead default.
+        self._faults = fault_plan
         self.replicas: List[_Replica] = [
             _Replica(artifact, devices[i % len(devices)], field, i,
                      error_gate=self.error_gate)
@@ -310,18 +344,38 @@ class ReplicaSet:
 
     # ---- routing ----------------------------------------------------
 
-    def pick(self) -> _Replica:
+    def pick(self, allowed: Optional[Sequence[int]] = None) -> _Replica:
         """The replica the NEXT micro-batch routes to (pure in the
-        current in-flight counts / rotation cursor)."""
+        current in-flight counts / rotation cursor).  ``allowed``
+        restricts the pool to those replica indices — the health
+        plane's circuit-breaker exclusion; ``round_robin`` keeps its
+        rotation order over the survivors."""
         if self.routing == "round_robin":
-            r = self.replicas[self._rr % len(self.replicas)]
-            self._rr += 1
-            return r
-        return min(self.replicas, key=lambda r: (r.in_flight, r.index))
+            for _ in range(len(self.replicas)):
+                r = self.replicas[self._rr % len(self.replicas)]
+                self._rr += 1
+                if allowed is None or r.index in allowed:
+                    return r
+            raise ValueError("no routable replica (allowed pool is empty)")
+        pool = (
+            self.replicas if allowed is None
+            else [self.replicas[i] for i in allowed]
+        )
+        if not pool:
+            raise ValueError("no routable replica (allowed pool is empty)")
+        return min(pool, key=lambda r: (r.in_flight, r.index))
 
-    def dispatch(self, thetas) -> _Handle:
+    def dispatch(
+        self,
+        thetas,
+        allowed: Optional[Sequence[int]] = None,
+        target: Optional[int] = None,
+    ) -> _Handle:
         """Route one micro-batch (≤ max_batch_size rows, padded to the
-        bucket) to a replica; returns the async handle."""
+        bucket) to a replica; returns the async handle.  ``target``
+        bypasses the routing policy (the health plane's half-open
+        probe and bit-identical re-answer paths); ``allowed`` restricts
+        the policy's pool (open breakers excluded)."""
         thetas = np.atleast_2d(np.asarray(thetas, dtype=np.float64))
         b = thetas.shape[0]
         if b > self.max_batch_size:
@@ -336,17 +390,53 @@ class ReplicaSet:
                 f"got shape {thetas.shape}"
             )
         padded = _pad_rows(thetas, self.max_batch_size)
-        replica = self.pick()
+        replica = (
+            self.replicas[int(target)] if target is not None
+            else self.pick(allowed)
+        )
+        if self._faults is not None:
+            self._faults.fire("replica_dispatch", replica.index)
         # count the slot only once the launch succeeded: a synchronous
         # dispatch failure must not permanently bias least_loaded
         # routing away from this replica (the matching decrement lives
         # in _Handle.gather's finally)
         values, inside, pred_err = replica.dispatch(padded)
         replica.in_flight += 1
+        nan_injected = (
+            self._faults is not None
+            and self._faults.nan_batch("replica_dispatch", replica.index)
+        )
         return _Handle(
             replica=replica, values=values, inside=inside,
-            pred_err=pred_err, n=b,
+            pred_err=pred_err, n=b, nan_injected=nan_injected,
         )
+
+    def reprovision(self, index: int, artifact=None) -> None:
+        """Rebuild replica ``index`` from ``artifact`` (same content
+        hash — a re-provision must never change the served surface) on
+        its own device: fresh ``device_put`` tables, a fresh jitted
+        kernel, warmed here so the next batch (the health plane's
+        half-open probe) never pays the compile.  ``artifact=None``
+        rebuilds from the set's own artifact object (fresh device
+        buffers only)."""
+        import jax
+
+        art = self.artifact if artifact is None else artifact
+        if art.content_hash != self.artifact_hash:
+            raise ValueError(
+                f"re-provision artifact verifies as "
+                f"{art.content_hash!r}, this set serves "
+                f"{self.artifact_hash!r}: a re-provision must not "
+                "change the surface (that is a rollout)"
+            )
+        old = self.replicas[index]
+        replica = _Replica(
+            art, old.device, self.field, index, error_gate=self.error_gate,
+        )
+        lower, _hi = artifact_hull(self.artifact)
+        probe = np.tile(lower, (self.max_batch_size, 1))
+        jax.block_until_ready(replica.dispatch(probe))
+        self.replicas[index] = replica
 
 
 class _Pending(NamedTuple):
@@ -363,6 +453,13 @@ class _InFlight(NamedTuple):
     wait_s: float
     dispatched_at: float
     batch_index: int
+    #: The ReplicaSet the batch was dispatched on — a health-plane
+    #: re-answer must run on the SAME surface even if a rollout swapped
+    #: the active set while the batch was in flight.
+    rset: "Optional[ReplicaSet]" = None
+    #: Replica index this batch is the half-open probe of (None = not
+    #: a probe).
+    probe_of: Optional[int] = None
 
 
 class FleetService:
@@ -417,8 +514,11 @@ class FleetService:
         stats: Optional[ServeStats] = None,
         warm: bool = True,
         error_gate_tol=None,
+        health=None,
+        store=None,
     ):
         from bdlz_tpu.emulator.artifact import build_identity
+        from bdlz_tpu.provenance import resolve_store
 
         static, n_y, impl = resolve_service_static(artifact, base, static)
         #: The exact-fallback error gate (shared resolution with
@@ -465,7 +565,34 @@ class FleetService:
             max_batch_size=self.max_batch_size, routing=routing,
             warm=warm, stats=self.stats,
             error_gate=self.error_gate_tol is not None,
+            fault_plan=self._faults,
         )
+        #: The replica health plane (serve/health.py; tri-state
+        #: ``health`` argument > ``Config.health_enabled``; None =
+        #: engine decides = ON for the fleet front).  ``None`` here =
+        #: plane disabled: every hook below guards on it, so the
+        #: disabled service is byte-identical to the pre-health one
+        #: (pinned in tests/test_health.py).
+        policy = resolve_health_policy(health, base)
+        self.health = (
+            HealthPlane(self.replica_set.n_replicas, policy,
+                        stats=self.stats)
+            if policy is not None else None
+        )
+        #: Optional provenance store (docs/provenance.md): when
+        #: resolvable, a persistently sick replica is RE-PROVISIONED —
+        #: its tables/kernel rebuilt from the registry's published copy
+        #: of the active artifact, fetched by content hash with the
+        #: full validation chain.
+        self.store = resolve_store(store, base=base, label="fleet")
+        #: Post-cutover error budget the rollout observation window
+        #: gates auto-rollback on (config ``rollback_budget``).
+        self.rollback_budget = float(getattr(base, "rollback_budget", 0.1))
+        #: Rollout observation hook (serve/rollout.py arms it at
+        #: cutover; called after every resolved batch with the clock's
+        #: now).  None = zero overhead.
+        self._observer: Optional[Callable[[float], None]] = None
+        self._closed = False
         self._queue: Deque[_Pending] = deque()
         self._inflight: Deque[_InFlight] = deque()
         self._lock = threading.Lock()
@@ -506,6 +633,16 @@ class FleetService:
                 "staged replica set is not warmed; warm() it before the "
                 "cutover so no request pays the compile"
             )
+        if (
+            self.health is not None
+            and replica_set.n_replicas != self.replica_set.n_replicas
+        ):
+            raise ValueError(
+                f"staged replica set has {replica_set.n_replicas} "
+                f"replicas, the health plane tracks "
+                f"{self.replica_set.n_replicas}: a rollout must keep "
+                "the fleet shape (resize via a new service)"
+            )
         with self._lock:
             old, self.replica_set = self.replica_set, replica_set
         return old
@@ -515,7 +652,9 @@ class FleetService:
     def submit(self, theta) -> Future:
         """Enqueue one d-dimensional query; resolves to a
         :class:`FleetResponse`.  Raises :class:`QueueFull` synchronously
-        when admission control is at its bound."""
+        when admission control is at its bound, and
+        :class:`ServiceUnavailable` after :meth:`close` — a dead
+        service must refuse loudly, never park a future forever."""
         theta = np.asarray(theta, dtype=np.float64).reshape(-1)
         d = len(self.artifact.axis_names)
         if theta.shape != (d,):
@@ -526,6 +665,10 @@ class FleetService:
             )
         fut: Future = Future()
         with self._lock:
+            if self._closed:
+                raise ServiceUnavailable(
+                    "service is closed; resubmit to a live fleet"
+                )
             if (
                 self.queue_bound is not None
                 and len(self._queue) >= self.queue_bound
@@ -603,21 +746,86 @@ class FleetService:
             return n_expired
         wait_s = max(now - p.enqueued_at for p in batch)
         thetas = np.stack([p.theta for p in batch])
-        try:
-            handle = replica_set.dispatch(thetas)
-        except Exception as exc:  # noqa: BLE001 — delivered per-request
-            for p in batch:
-                p.future.set_exception(exc)
-            return len(batch) + n_expired
+        probe_of = None
+        if self.health is None:
+            try:
+                handle = replica_set.dispatch(thetas)
+            except Exception as exc:  # noqa: BLE001 — delivered per-request
+                for p in batch:
+                    p.future.set_exception(exc)
+                return len(batch) + n_expired
+        else:
+            handle, probe_of = self._dispatch_healed(
+                replica_set, thetas, now
+            )
+            if handle is None:
+                # every breaker open (or every dispatch attempt failed):
+                # the loud degraded exact-serving mode
+                self._answer_degraded(
+                    batch, thetas, replica_set, now, float(wait_s)
+                )
+                return len(batch) + n_expired
         with self._lock:
-            self._inflight.append(_InFlight(
-                batch=batch, thetas=thetas, handle=handle,
-                artifact_hash=replica_set.artifact_hash,
-                wait_s=float(wait_s), dispatched_at=self._clock(),
-                batch_index=self._batch_index,
-            ))
-            self._batch_index += 1
+            # close() may have raced this dispatch (batch popped before
+            # it took the lock): appending now would strand the futures
+            # forever — nobody polls a closed service.  Fail them with
+            # the same typed error close() delivers instead.
+            closed = self._closed
+            if not closed:
+                self._inflight.append(_InFlight(
+                    batch=batch, thetas=thetas, handle=handle,
+                    artifact_hash=replica_set.artifact_hash,
+                    wait_s=float(wait_s), dispatched_at=self._clock(),
+                    batch_index=self._batch_index,
+                    rset=replica_set, probe_of=probe_of,
+                ))
+                self._batch_index += 1
+        if closed:
+            try:
+                handle.gather()  # release buffers + the in-flight slot
+            except Exception:  # noqa: BLE001 — the batch is failed anyway
+                pass
+            for p in batch:
+                p.future.set_exception(ServiceUnavailable(
+                    "service closed with the request in flight; "
+                    "resubmit to a live fleet"
+                ))
         return len(batch) + n_expired
+
+    def _dispatch_healed(self, replica_set, thetas, now):
+        """Dispatch with the health plane in the loop: open breakers
+        are excluded from the routing pool, a probe-due replica gets
+        THIS batch as its half-open probe, and a synchronous dispatch
+        failure is scored and retried on the remaining healthy replicas
+        instead of failing the batch.  Returns ``(handle, probe_of)``;
+        ``(None, None)`` = no replica could take the batch (degraded
+        mode)."""
+        allowed, probe = self.health.routable(now)
+        tried: set = set()
+        while True:
+            if probe is not None and probe not in tried:
+                target = probe
+                self.health.probe_started(target, now)
+            else:
+                avail = [i for i in allowed if i not in tried]
+                if not avail:
+                    return None, None
+                target = replica_set.pick(avail).index
+            try:
+                handle = replica_set.dispatch(thetas, target=target)
+            except Exception:  # noqa: BLE001 — scored, batch re-routed
+                from bdlz_tpu.serve.health import CAUSE_DISPATCH_ERROR
+
+                self.health.record_outcome(
+                    target, ok=False, now=now, cause=CAUSE_DISPATCH_ERROR,
+                    probe=(target == probe),
+                )
+                self._maybe_reprovision(target, now)
+                tried.add(target)
+                if target == probe:
+                    probe = None
+                continue
+            return handle, (target if target == probe else None)
 
     # ---- resolve ----------------------------------------------------
 
@@ -625,14 +833,64 @@ class FleetService:
         """Resolve the OLDEST in-flight batch if it is done (or
         unconditionally when ``block=True``).  Returns requests
         resolved.  In-order resolution keeps per-replica FIFO semantics
-        and makes the rollout drain a simple queue walk."""
+        and makes the rollout drain a simple queue walk.
+
+        With the health plane on, a batch whose gather surfaced a
+        deferred device error — or whose replica emitted NaNs (the
+        tables are finite/positive by construction, so a non-finite
+        emulator value is a sick kernel) — is scored against its
+        replica's breaker and RE-ANSWERED on a healthy replica of the
+        same set, bit-identically (same fused kernel, same table
+        bytes); only when no healthy replica remains does the batch
+        degrade to the exact pipeline.
+        """
         with self._lock:
             if not self._inflight:
                 return 0
             if not block and not self._inflight[0].handle.done():
                 return 0
             item = self._inflight.popleft()
-        values, inside, pred_err = item.handle.gather()  # blocks if running
+        replica_index = item.handle.replica.index
+        heal_cause = None
+        values = inside = pred_err = None
+        if self.health is None:
+            values, inside, pred_err = item.handle.gather()  # blocks
+        else:
+            from bdlz_tpu.serve.health import CAUSE_GATHER_ERROR, CAUSE_NAN
+
+            try:
+                values, inside, pred_err = item.handle.gather()
+            except Exception:  # noqa: BLE001 — scored, batch re-answered
+                heal_cause = CAUSE_GATHER_ERROR
+            if heal_cause is None and not self._replica_values_ok(
+                values, inside, pred_err
+            ):
+                heal_cause = CAUSE_NAN
+        now = self._clock()
+        # replica work ended HERE: everything below (gate + exact
+        # fallback) runs on the HOST, so its time must never be charged
+        # to the replica's latency-SLO breaker — an OOD/gated burst
+        # would otherwise open every breaker on a healthy fleet
+        gathered_at = now
+        if heal_cause is not None:
+            self.health.record_outcome(
+                replica_index, ok=False, now=now, cause=heal_cause,
+                # only the actual half-open probe batch resolves the
+                # probe — an older batch landing during the probe
+                # window must not decide it
+                probe=item.probe_of == replica_index,
+            )
+            self._maybe_reprovision(replica_index, now)
+            healed = self._reanswer(item, now)
+            if healed is None:
+                self._answer_degraded(
+                    item.batch, item.thetas,
+                    item.rset if item.rset is not None else self.replica_set,
+                    now, item.wait_s, batch_index=item.batch_index,
+                )
+                return len(item.batch)
+            values, inside, pred_err, replica_index = healed
+            self.health.note_healed_batch()
         b = len(item.batch)
         fallback, gated, reasons = gate_fallback_masks(
             inside, pred_err, self.error_gate_tol
@@ -654,19 +912,37 @@ class FleetService:
                     errors[int(i)] = exc
                     values[int(i)] = np.nan
         now = self._clock()
+        seconds = float(now - item.dispatched_at)
+        replica_seconds = float(gathered_at - item.dispatched_at)
+        if self._faults is not None:
+            # injected slow-replica faults surface as evaluation time
+            # THROUGH the clock seam (never a real sleep): the latency
+            # outlier the breaker's SLO scoring must catch
+            delay = self._faults.delay_s("replica_dispatch", replica_index)
+            seconds += delay
+            replica_seconds += delay
         self.stats.record_batch(
             batch_index=item.batch_index,
             size=b,
             occupancy=b / self.max_batch_size,
             wait_s=item.wait_s,
             n_fallback=n_fallback,
-            seconds=float(now - item.dispatched_at),
+            seconds=seconds,
             n_retries=retries_box[0],
             n_error=sum(e is not None for e in errors),
             n_gated=int(gated.sum()),
             artifact_hash=item.artifact_hash,
-            replica=item.handle.replica.index,
+            replica=replica_index,
         )
+        if self.health is not None and heal_cause is None:
+            # success bookkeeping (latency-SLO scored inside, on the
+            # REPLICA's own seconds — host-side exact-fallback time
+            # excluded): a clean half-open PROBE batch re-closes its
+            # breaker here
+            self.health.record_outcome(
+                replica_index, ok=True, now=now, seconds=replica_seconds,
+                probe=item.probe_of == replica_index,
+            )
         for p, v, e, reason in zip(item.batch, values, errors, reasons):
             self.stats.record_latency(now - p.enqueued_at)
             # per-request error isolation: a poisoned request gets its
@@ -677,10 +953,148 @@ class FleetService:
                 p.future.set_result(FleetResponse(
                     value=float(v),
                     artifact_hash=item.artifact_hash,
-                    replica=item.handle.replica.index,
+                    replica=replica_index,
                     fallback_reason=reason,
                 ))
+        if self._observer is not None:
+            self._observer(now)
         return b
+
+    def _replica_values_ok(self, values, inside, pred_err) -> bool:
+        """False when the replica kernel emitted a non-finite value for
+        a request the emulator path would answer (fallback rows get
+        overwritten by the exact path and are exempt)."""
+        fallback, _, _ = gate_fallback_masks(
+            inside, pred_err, self.error_gate_tol
+        )
+        return bool(np.isfinite(values[~fallback]).all())
+
+    def _reanswer(self, item: _InFlight, now: float):
+        """Re-run a failed/NaN batch on a healthy replica of ITS OWN
+        replica set (bit-identical: every replica runs the same fused
+        kernel on the same table bytes — pinned).  Returns ``(values,
+        inside, pred_err, replica_index)`` or None when no healthy
+        replica could answer."""
+        from bdlz_tpu.serve.health import CAUSE_DISPATCH_ERROR, CAUSE_NAN
+
+        rset = item.rset if item.rset is not None else self.replica_set
+        tried = {item.handle.replica.index}
+        while True:
+            allowed, _probe = self.health.routable(now)
+            avail = [
+                i for i in allowed
+                if i not in tried and i < rset.n_replicas
+            ]
+            if not avail:
+                return None
+            idx = rset.pick(avail).index
+            try:
+                handle = rset.dispatch(item.thetas, target=idx)
+                values, inside, pred_err = handle.gather()
+            except Exception:  # noqa: BLE001 — scored, next replica tried
+                self.health.record_outcome(
+                    idx, ok=False, now=now, cause=CAUSE_DISPATCH_ERROR,
+                )
+                self._maybe_reprovision(idx, now)
+                tried.add(idx)
+                continue
+            if not self._replica_values_ok(values, inside, pred_err):
+                self.health.record_outcome(
+                    idx, ok=False, now=now, cause=CAUSE_NAN,
+                )
+                self._maybe_reprovision(idx, now)
+                tried.add(idx)
+                continue
+            return values, inside, pred_err, idx
+
+    def _answer_degraded(
+        self, batch, thetas, replica_set, now, wait_s, batch_index=None,
+    ) -> None:
+        """Every breaker is open: answer the batch through the exact
+        pipeline, loudly (``degraded=True``, reason ``"degraded"``,
+        replica ``-1`` on the stats row).  When even the exact path is
+        dead the requests get a typed :class:`ServiceUnavailable` — the
+        service never hangs and never silently serves garbage."""
+        b = len(batch)
+        padded = _pad_rows(
+            np.atleast_2d(np.asarray(thetas, dtype=np.float64)),
+            self.max_batch_size,
+        )
+        axes = {
+            name: padded[:, k]
+            for k, name in enumerate(self.artifact.axis_names)
+        }
+        retries_box = [0]
+        err: Optional[BaseException] = None
+        values = np.full(b, np.nan)
+        try:
+            exact_fields = self._fallback(axes, retries_box)
+            values = np.asarray(
+                exact_fields[self.field][:b], dtype=np.float64
+            )
+        except Exception as exc:  # noqa: BLE001 — typed per-request below
+            err = exc
+        self.health.note_degraded_batch()
+        if batch_index is None:
+            with self._lock:
+                batch_index = self._batch_index
+                self._batch_index += 1
+        done = self._clock()
+        self.stats.record_batch(
+            batch_index=batch_index,
+            size=b,
+            occupancy=b / self.max_batch_size,
+            wait_s=float(wait_s),
+            n_fallback=b,
+            seconds=float(done - now),
+            n_retries=retries_box[0],
+            n_error=b if err is not None else 0,
+            n_gated=0,
+            artifact_hash=replica_set.artifact_hash,
+            replica=-1,
+        )
+        for p, v in zip(batch, values):
+            self.stats.record_latency(done - p.enqueued_at)
+            if err is not None:
+                unavailable = ServiceUnavailable(
+                    f"all {replica_set.n_replicas} replicas are "
+                    f"circuit-open and the degraded exact path failed: "
+                    f"{type(err).__name__}: {err}"
+                )
+                unavailable.__cause__ = err
+                p.future.set_exception(unavailable)
+            else:
+                p.future.set_result(FleetResponse(
+                    value=float(v),
+                    artifact_hash=replica_set.artifact_hash,
+                    replica=-1,
+                    fallback_reason=REASON_DEGRADED,
+                    degraded=True,
+                ))
+        if self._observer is not None:
+            self._observer(done)
+
+    def _maybe_reprovision(self, index: int, now: float) -> None:
+        """Re-provision a persistently sick replica from the provenance
+        registry by content hash (fresh tables + kernel on the same
+        device).  Needs a resolvable store AND a breaker that has
+        burned its probe cycles (``needs_reprovision``); a failed fetch
+        (missing/corrupt entry) is counted and the breaker simply stays
+        open — the next probe cycle retries."""
+        if self.store is None or not self.health.needs_reprovision(index):
+            return
+        from bdlz_tpu.provenance import fetch_artifact
+
+        try:
+            artifact = fetch_artifact(
+                self.store, self.replica_set.artifact_hash,
+                fault_plan=self._faults,
+            )
+            self.replica_set.reprovision(index, artifact)
+        except Exception:  # noqa: BLE001 — counted, breaker stays open
+            self.health.note_reprovision(index, ok=False, now=now)
+            return
+        self.health.note_reprovision(index, ok=True, now=now)
 
     def drain(self) -> int:
         """Dispatch everything queued and resolve every in-flight batch
@@ -699,6 +1113,47 @@ class FleetService:
         while self.in_flight():
             resolved += self.poll(block=True)
         return resolved
+
+    # ---- shutdown ---------------------------------------------------
+
+    def close(self) -> int:
+        """Shut the service down: every pending AND in-flight request
+        is failed with a typed :class:`ServiceUnavailable` — a closed
+        service must never leave a caller blocked on ``result()``
+        forever (the interpreter-exit hang the serve CLI's shutdown
+        path guards against).  Later :meth:`submit` calls raise
+        ``ServiceUnavailable`` synchronously.  Idempotent; returns the
+        number of futures failed.  Callers that want every answer
+        delivered call :meth:`drain` first — close is the *abandon*
+        path, drain is the *finish* path.
+        """
+        with self._lock:
+            self._closed = True
+            pending = list(self._queue)
+            self._queue.clear()
+            inflight = list(self._inflight)
+            self._inflight.clear()
+        n = 0
+        for item in inflight:
+            try:
+                # release the device buffers + the replica's in-flight
+                # slot; the values are deliberately discarded
+                item.handle.gather()
+            except Exception:  # noqa: BLE001 — the batch is failed anyway
+                pass
+            for p in item.batch:
+                p.future.set_exception(ServiceUnavailable(
+                    "service closed with the request in flight; "
+                    "resubmit to a live fleet"
+                ))
+                n += 1
+        for p in pending:
+            p.future.set_exception(ServiceUnavailable(
+                "service closed before the request was dispatched; "
+                "resubmit to a live fleet"
+            ))
+            n += 1
+        return n
 
     # ---- conveniences ----------------------------------------------
 

@@ -31,10 +31,23 @@ can go wrong is loud:
 The old replica set is returned from :meth:`ArtifactRollout.cutover`
 (and kept as ``.previous``) so an operator can roll back by staging it
 again — its kernels are still warm.
+
+**Post-cutover observation + error-budget auto-rollback** (step 4,
+``cutover(observe_s=...)``): for ``observe_s`` clock-seconds after the
+swap the rollout watches the new artifact's per-batch ``ServeStats``
+rows — per-request errors, predicted-error-gated fallbacks, and
+(optionally) latency-SLO-breaching batches all charge the budget.  When
+more than ``rollback_budget`` of the observed requests are bad, the
+retained previous replica set (still warm) is swapped back
+AUTOMATICALLY, atomically, with the reason recorded on
+``stats.extras["rollbacks"]`` — a bad build costs one observation
+window, not an operator page.  The whole loop runs on the service's
+injectable clock (the observer fires after every resolved batch), so
+tier-1 pins the rollback with a fake clock and the per-batch hash rows.
 """
 from __future__ import annotations
 
-from typing import Optional, Tuple
+from typing import Any, Dict, Optional, Tuple
 
 import numpy as np  # host-side use only; jitted paths go through the backend.py xp seam (bdlz-lint R1 audit)
 
@@ -75,6 +88,12 @@ class ArtifactRollout:
         self._staged: Optional[ReplicaSet] = None
         #: The replica set retired by the last cutover (rollback seam).
         self.previous: Optional[ReplicaSet] = None
+        #: The active post-cutover observation window (None = not
+        #: observing): new/old hashes, budget, clock bounds, counters.
+        self.observation: Optional[Dict[str, Any]] = None
+        #: The replica set evicted by the last AUTO-rollback (the bad
+        #: build, kept for forensics; its device tables free with it).
+        self.rolled_back: Optional[ReplicaSet] = None
 
     # ---- introspection ----------------------------------------------
 
@@ -133,6 +152,10 @@ class ArtifactRollout:
             warm=False,
             stats=self.service.stats,
             error_gate=getattr(active, "error_gate", True),
+            # the staged set inherits the service's armed fault plan, so
+            # injected replica faults (and the health plane watching
+            # them) survive a cutover
+            fault_plan=getattr(active, "_faults", None),
         )
         if warm:
             staged.warm()
@@ -150,22 +173,173 @@ class ArtifactRollout:
         it); the active artifact keeps serving untouched."""
         self._staged = None
 
-    def cutover(self) -> Tuple[str, str]:
+    def cutover(
+        self,
+        observe_s: Optional[float] = None,
+        budget: Optional[float] = None,
+        latency_slo_s: Optional[float] = None,
+    ) -> Tuple[str, str]:
         """Atomically make the staged artifact the active surface.
 
         Refuses (typed :class:`RolloutError`, service untouched) when
         nothing is staged, the stage is cold, or the fleet disagrees on
         WHICH build is being activated.  Returns ``(old_hash,
         new_hash)``.
+
+        ``observe_s`` arms the post-cutover observation window: for
+        that many clock-seconds the new artifact's batches are watched
+        and, if more than ``budget`` (default: the service's
+        ``rollback_budget`` config knob) of its requests are bad —
+        per-request errors, predicted-error-gated fallbacks, batches
+        served degraded because every breaker opened, or fallback-free
+        batches slower than ``latency_slo_s`` — the
+        previous replica set is swapped back automatically
+        (:meth:`auto_rollback`).  ``None`` (the default) keeps the
+        manual-only behavior.
         """
         staged = self._staged
         if staged is None:
             raise RolloutError("nothing staged; call stage() first")
+        # kwarg twins of validated config knobs get the same range
+        # checks (budget=0 would roll back on the first gated request,
+        # budget<0 on a fully CLEAN batch; observe_s<=0 records the
+        # window as already passed)
+        if observe_s is not None and not float(observe_s) > 0.0:
+            raise ValueError(f"observe_s must be > 0, got {observe_s!r}")
+        if budget is not None and not (0.0 < float(budget) <= 1.0):
+            raise ValueError(
+                f"budget must be a fraction in (0, 1], got {budget!r}"
+            )
+        if latency_slo_s is not None and not float(latency_slo_s) > 0.0:
+            raise ValueError(
+                f"latency_slo_s must be > 0, got {latency_slo_s!r}"
+            )
         _agree_cutover(staged.artifact_hash, staged.warmed)
         old = self.service.swap_replica_set(staged)
         self._staged = None
         self.previous = old
+        if observe_s is not None:
+            self._arm_observation(
+                staged, old, float(observe_s), budget, latency_slo_s
+            )
         return old.artifact_hash, staged.artifact_hash
+
+    # ---- post-cutover observation / auto-rollback -------------------
+
+    def _arm_observation(
+        self, new_set, old_set, observe_s, budget, latency_slo_s,
+    ) -> None:
+        svc = self.service
+        self.observation = {
+            "new_hash": new_set.artifact_hash,
+            "old_hash": old_set.artifact_hash,
+            "started_at": float(svc._clock()),
+            "window_s": float(observe_s),
+            "budget": (
+                svc.rollback_budget if budget is None else float(budget)
+            ),
+            "latency_slo_s": (
+                None if latency_slo_s is None else float(latency_slo_s)
+            ),
+            "start_row": len(svc.stats.rows),
+            # incremental scan cursor + running tallies: the observer
+            # fires after EVERY resolved batch, so re-scanning from
+            # start_row each time would be O(batches^2) on the serving
+            # hot path
+            "next_row": len(svc.stats.rows),
+            "requests": 0,
+            "bad": 0,
+        }
+        svc._observer = self._observe
+
+    def _observe(self, now: float) -> None:
+        """The service calls this after every resolved batch (the
+        observer hook): tally the new artifact's post-cutover rows and
+        roll back the moment the budget is blown; disarm once the
+        window elapses clean."""
+        obs = self.observation
+        if obs is None:  # defensive: a stale hook after disarm
+            self.service._observer = None
+            return
+        rows = self.service.stats.rows
+        slo = obs["latency_slo_s"]
+        for row in rows[obs["next_row"]:]:
+            if row.artifact_hash != obs["new_hash"]:
+                continue
+            obs["requests"] += row.size
+            # per-row charge is clamped at the row's request count: a
+            # degraded or SLO-breaching batch makes EVERY request in it
+            # bad (a superset of its errors/gated — never
+            # double-charged), so the bad fraction stays a true
+            # fraction <= 1
+            if row.replica == -1:
+                # degraded exact serving: every breaker on the new
+                # artifact's set was open, so the artifact itself
+                # answered NOTHING — the whole batch charges the
+                # budget, however well the exact pipeline coped
+                obs["bad"] += row.size
+            elif slo is not None and row.seconds > slo and row.n_fallback == 0:
+                # latency charges only rows the replica kernel answered
+                # alone: a fallback-carrying row's seconds include
+                # host-side exact-pipeline time (not the artifact's
+                # fault — its gated share is already charged above)
+                obs["bad"] += row.size
+            else:
+                obs["bad"] += min(row.n_error + row.n_gated, row.size)
+        obs["next_row"] = len(rows)
+        requests, bad = obs["requests"], obs["bad"]
+        if now - obs["started_at"] >= obs["window_s"]:
+            # the window elapsed: the rollout sticks.  Checked BEFORE
+            # the budget so a batch resolving long after the window
+            # officially ended can never revert a rollout that already
+            # stuck (any in-window budget blow fired on ITS OWN
+            # resolution — the observer runs after every batch).
+            self.observation = None
+            self.service._observer = None
+            self.service.stats.extras.setdefault(
+                "rollout_observations", []
+            ).append({
+                "artifact_hash": obs["new_hash"],
+                "passed": True,
+                "requests": requests,
+                "bad": bad,
+            })
+            return
+        if requests and bad / requests > obs["budget"]:
+            self.auto_rollback(
+                f"error budget exceeded: {bad}/{requests} bad requests "
+                f"> budget {obs['budget']:.3g} within "
+                f"{obs['window_s']:.3g}s observation window",
+                now=now,
+            )
+
+    def auto_rollback(self, reason: str, now: Optional[float] = None) -> str:
+        """Swap the retained previous replica set back in (it is still
+        warm — zero compile cost), record WHY on
+        ``stats.extras["rollbacks"]``, and disarm the observation.
+        Batches in flight on the bad set drain with its hash (the usual
+        drain guarantee).  Returns the hash serving again."""
+        prev = self.previous
+        if prev is None:
+            raise RolloutError(
+                "no previous replica set retained; cannot roll back"
+            )
+        obs, self.observation = self.observation, None
+        self.service._observer = None
+        bad_set = self.service.swap_replica_set(prev)
+        self.rolled_back = bad_set
+        self.previous = None
+        self.service.stats.extras.setdefault("rollbacks", []).append({
+            "from": bad_set.artifact_hash,
+            "to": prev.artifact_hash,
+            "reason": reason,
+            "at": float(
+                now if now is not None else self.service._clock()
+            ),
+            "requests": None if obs is None else obs["requests"],
+            "bad": None if obs is None else obs["bad"],
+        })
+        return prev.artifact_hash
 
 
 def _looks_like_content_hash(s: str) -> bool:

@@ -44,6 +44,31 @@ from typing import Optional
 import numpy as np  # host-side use only; jitted paths go through the backend.py xp seam (bdlz-lint R1 audit)
 
 
+def _error_record(rid, exc, **extra) -> dict:
+    """One structured JSONL error record.  ``error_type`` is the class
+    name; for the typed serve surface (``bdlz_tpu.serve`` exports:
+    ``QueueFull``, ``DeadlineExceeded``, ``ServiceUnavailable``,
+    ``RolloutError``) that name is a STABLE contract — stream consumers
+    branch on it, never by parsing the message — flagged by
+    ``typed_error: true``."""
+    from bdlz_tpu.serve import (
+        DeadlineExceeded,
+        QueueFull,
+        RolloutError,
+        ServiceUnavailable,
+    )
+
+    typed = (QueueFull, DeadlineExceeded, ServiceUnavailable, RolloutError)
+    name = type(exc).__name__
+    return {
+        "id": rid,
+        "error": f"{name}: {exc}",
+        "error_type": name,
+        "typed_error": isinstance(exc, typed),
+        **extra,
+    }
+
+
 def main(argv: Optional[list] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m bdlz_tpu.serve",
@@ -81,6 +106,12 @@ def main(argv: Optional[list] = None) -> int:
                     choices=("least_loaded", "round_robin"),
                     help="fleet micro-batch routing policy "
                          "(--replicas only)")
+    ap.add_argument("--health", default="auto",
+                    choices=("auto", "on", "off"),
+                    help="replica health plane / circuit breakers "
+                         "(--replicas only; docs/robustness.md): auto "
+                         "= the config tri-state (fleet default ON), "
+                         "off = the pre-health byte-identical behavior")
     ap.add_argument("--events", default=None,
                     help="JSON-lines event log path (default stderr)")
     args = ap.parse_args(argv)
@@ -113,6 +144,7 @@ def main(argv: Optional[list] = None) -> int:
             deadline_s=(
                 None if args.deadline_ms is None else args.deadline_ms / 1e3
             ),
+            health={"auto": None, "on": True, "off": False}[args.health],
         )
         service = None
     else:
@@ -165,9 +197,7 @@ def main(argv: Optional[list] = None) -> int:
                 obj = json.loads(line)
             except Exception as exc:  # noqa: BLE001 — report per request
                 # unparseable line: no client id to echo back
-                print(
-                    json.dumps({"id": None, "line": ln, "error": str(exc)})
-                )
+                print(json.dumps(_error_record(None, exc, line=ln)))
                 continue
             rid = obj.get("id", ln) if isinstance(obj, dict) else ln
             front = fleet if fleet is not None else service
@@ -180,16 +210,13 @@ def main(argv: Optional[list] = None) -> int:
                     )
                 )
             except Exception as exc:  # noqa: BLE001 — report per request
-                print(
-                    json.dumps({"id": rid, "line": ln, "error": str(exc)})
-                )
+                print(json.dumps(_error_record(rid, exc, line=ln)))
                 continue
             if theta.shape != (len(artifact.axis_names),):
-                print(json.dumps({
-                    "id": rid,
-                    "error": f"theta has {theta.size} coordinates, this "
-                             f"artifact takes {len(artifact.axis_names)}",
-                }))
+                print(json.dumps(_error_record(rid, ValueError(
+                    f"theta has {theta.size} coordinates, this "
+                    f"artifact takes {len(artifact.axis_names)}"
+                ), line=ln)))
                 continue
             requests.append((rid, theta))
     finally:
@@ -197,7 +224,14 @@ def main(argv: Optional[list] = None) -> int:
             fh.close()
 
     if fleet is not None:
-        n_ok = _serve_requests_fleet(fleet, requests)
+        try:
+            n_ok = _serve_requests_fleet(fleet, requests)
+        finally:
+            # the shutdown path: drain() above answered everything on
+            # the happy path, so this fails only what an escaped error
+            # left behind — with a typed ServiceUnavailable, never a
+            # future hanging into interpreter exit
+            fleet.close()
         event_log.emit("serve_done", **fleet.stats.summary())
         return 1 if (n_lines and n_ok == 0) else 0
 
@@ -229,11 +263,10 @@ def main(argv: Optional[list] = None) -> int:
             except Exception as exc:  # noqa: BLE001 — report per request
                 # per-request failures (DeadlineExceeded, a dead exact
                 # fallback) answer THIS line; the rest keep serving
-                print(json.dumps({
-                    "id": rid,
-                    "error": f"{type(exc).__name__}: {exc}",
-                    "latency_s": round(time.monotonic() - t0, 6),
-                }))
+                print(json.dumps(_error_record(
+                    rid, exc,
+                    latency_s=round(time.monotonic() - t0, 6),
+                )))
                 continue
             n_ok += 1
             print(json.dumps({
@@ -287,21 +320,13 @@ def _serve_requests_fleet(fleet, requests) -> int:
     fleet.drain()
     for index, (rid, fut, err) in enumerate(submitted):
         if err is not None:
-            print(json.dumps({
-                "id": rid,
-                "error": f"{type(err).__name__}: {err}",
-                "latency_s": 0.0,
-            }))
+            print(json.dumps(_error_record(rid, err, latency_s=0.0)))
             continue
         latency = round(resolved_at.get(index, 0.0), 6)
         try:
             resp = fut.result(timeout=0)
         except Exception as exc:  # noqa: BLE001 — report per request
-            print(json.dumps({
-                "id": rid,
-                "error": f"{type(exc).__name__}: {exc}",
-                "latency_s": latency,
-            }))
+            print(json.dumps(_error_record(rid, exc, latency_s=latency)))
             continue
         n_ok += 1
         print(json.dumps({
@@ -310,6 +335,9 @@ def _serve_requests_fleet(fleet, requests) -> int:
             "artifact_hash": resp.artifact_hash,
             "replica": resp.replica,
             "fallback_reason": resp.fallback_reason,
+            # loud degraded-mode marker (every breaker open, answered
+            # by the exact pipeline — docs/robustness.md)
+            "degraded": resp.degraded,
             "latency_s": latency,
         }))
     return n_ok

@@ -53,6 +53,10 @@ from bdlz_tpu.utils.profiling import ServeStats
 #: serve_cli JSONL answers): None = answered by the emulator.
 REASON_OOD = "ood"
 REASON_PREDICTED_ERROR = "predicted_error"
+#: Every replica's circuit breaker is open: the fleet serves the batch
+#: through the exact pipeline, LOUDLY marked (FleetResponse.degraded) —
+#: never a silent wrong answer (docs/robustness.md).
+REASON_DEGRADED = "degraded"
 
 
 class ServeAnswer(NamedTuple):

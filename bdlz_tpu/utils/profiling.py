@@ -128,6 +128,12 @@ class ServeStats:
     #: Seconds spent pre-compiling query kernels (artifact load + rollout
     #: warm-up) — the compile spike the warm start keeps out of p99.
     warmup_seconds: float = 0.0
+    #: Opt-in summary extensions (the replica health plane, rollout
+    #: auto-rollback records).  Keys land verbatim at the END of
+    #: :meth:`summary`; EMPTY by default so the summary schema is
+    #: byte-identical to the pre-health service whenever nothing armed
+    #: them (the zero-overhead pin in tests/test_health.py).
+    extras: Dict[str, Any] = field(default_factory=dict)
 
     def record_batch(self, **kw: Any) -> None:
         self.rows.append(ServeBatch(**kw))
@@ -209,6 +215,9 @@ class ServeStats:
             "p50_latency_s": self._percentile(50.0),
             "p99_latency_s": self._percentile(99.0),
             "warmup_seconds": round(self.warmup_seconds, 4),
+            # health plane / auto-rollback extensions — absent entirely
+            # when nothing armed them (schema pin)
+            **self.extras,
         }
 
     def as_rows(self) -> List[Dict[str, Any]]:

@@ -1306,6 +1306,150 @@ def main(argv=None) -> None:
         print(f"[bench] serve_bench metric unavailable: {exc}",
               file=sys.stderr)
 
+    # --- secondary metric: chaos_serve (self-healing fleet) ------------
+    # The serving counterpart of the chaos sweep line: the SAME request
+    # stream is pushed through a 2-replica fleet clean and under a
+    # canned single-replica fault trace (replica 1: transient dispatch
+    # errors, then one NaN batch — site replica_dispatch), entirely on
+    # a FAKE clock so the whole breaker choreography — trip, cooldown,
+    # failed half-open probes, heal, re-close — is a deterministic
+    # function of the trace.  The line records availability (answered
+    # fraction), p99 under the failure, the breaker recovery time in
+    # fake-clock seconds, and whether every answer came back
+    # BIT-identical to the clean run (the healed re-answer runs the
+    # same fused kernel on the same table bytes).
+    def chaos_serve_metric(artifact):
+        import dataclasses
+
+        from bdlz_tpu.serve.fleet import FleetService
+
+        n_req = int(os.environ.get("BDLZ_BENCH_CHAOS_SERVE_QUERIES", 768))
+        cs_batch = int(os.environ.get("BDLZ_BENCH_CHAOS_SERVE_BATCH", 32))
+        cs_batch = max(1, min(cs_batch, n_req))
+        n_rep = 2  # canned SINGLE-replica failure needs a >=2 fleet
+        rng = np.random.default_rng(17)
+        lo = np.array([nodes[0] for nodes in artifact.axis_nodes])
+        hi = np.array([nodes[-1] for nodes in artifact.axis_nodes])
+        thetas = rng.uniform(lo, hi, size=(n_req, len(lo)))
+        plan_obj = {"faults": [
+            {"site": "replica_dispatch", "kind": "transient", "key": 1,
+             "times": 2},
+            {"site": "replica_dispatch", "kind": "nan", "key": 1,
+             "times": 1},
+        ]}
+
+        class _Tick:
+            t = 0.0
+
+            def __call__(self):
+                return self.t
+
+        def run(plan_json):
+            tick = _Tick()
+            cfg = dataclasses.replace(
+                base,
+                fault_plan=plan_json,
+                fault_injection=None if plan_json else False,
+                # one bad batch trips a breaker; the short fake-clock
+                # cooldown schedules the half-open probes INSIDE the
+                # trace (0.01 s per batch tick)
+                breaker_window=1, breaker_cooldown_s=0.05,
+                # gate off: the A/B compares pure replica-kernel
+                # answers (the exact path compiles per service
+                # instance; its first-jit-run wobble would void the
+                # bitwise pin)
+                error_gate_tol=False,
+            )
+            svc = FleetService(
+                artifact, cfg, max_batch_size=cs_batch, n_replicas=n_rep,
+                routing="round_robin", max_wait_s=1e-3, clock=tick,
+            )
+            futs = []
+            for i in range(n_req):
+                futs.append(svc.submit(thetas[i]))
+                if (i + 1) % cs_batch == 0:
+                    tick.t += 0.01
+                    svc.run_once()
+                    svc.poll(block=True)
+            svc.drain()
+            vals = np.full(n_req, np.nan)
+            n_ok = 0
+            for i, f in enumerate(futs):
+                try:
+                    vals[i] = f.result(timeout=0).value
+                    n_ok += 1
+                except Exception:  # noqa: BLE001 — availability counts these
+                    pass
+            return vals, n_ok, svc
+
+        t_cs = time.time()
+        clean_vals, _clean_ok, _svc_clean = run(None)
+        chaos_vals, chaos_ok, svc = run(json.dumps(plan_obj))
+        cs_seconds = time.time() - t_cs
+        stats = svc.stats.summary()
+        health = stats.get("health") or {}
+        availability = chaos_ok / n_req
+        bitwise = bool(np.array_equal(clean_vals, chaos_vals))
+        reclosed = bool(health.get("states")) and all(
+            s == "closed" for s in health.get("states", [])
+        )
+        try:
+            host_cores = len(os.sched_getaffinity(0))
+        except AttributeError:  # non-linux fallback
+            host_cores = os.cpu_count()
+        payload = {
+            "metric": "chaos_serve_availability",
+            "value": round(availability, 4),
+            "unit": "answered fraction under a canned single-replica "
+                    "replica_dispatch fault trace (2-replica fleet, "
+                    "breaker trip/probe/heal cycle on a fake clock, "
+                    "batch %d)" % cs_batch,
+            "n_requests": n_req,
+            "n_replicas": n_rep,
+            "host_cores": host_cores,
+            # p99 under the single-replica failure (fake-clock seconds
+            # — deterministic, comparable round over round)
+            "p99_latency_s": stats["p99_latency_s"],
+            "p50_latency_s": stats["p50_latency_s"],
+            # breaker choreography evidence: trip count, heal count,
+            # the open→re-close recovery span, final states
+            "breaker_opens": health.get("opens"),
+            "breaker_reclosed": reclosed,
+            "recovery_s": health.get("last_recovery_s"),
+            "healed_batches": health.get("healed_batches"),
+            "degraded_batches": health.get("degraded_batches"),
+            "bitwise_equal_unaffected": bitwise,
+            "wall_seconds": round(cs_seconds, 4),
+            "fault_plan": plan_obj["faults"],
+            "artifact_hash": artifact.content_hash,
+            "platform": jax.devices()[0].platform,
+            "tpu_unavailable": tpu_unavailable,
+        }
+        emit(payload)
+        return {
+            k: payload[k] for k in (
+                "value", "p99_latency_s", "recovery_s", "breaker_opens",
+                "breaker_reclosed", "healed_batches",
+                "bitwise_equal_unaffected",
+            )
+        }
+
+    chaos_serve_summary = None
+    try:
+        _cs_hit = leg_lookup("chaos_serve")
+        if _cs_hit is not None:
+            chaos_serve_summary = _cs_hit.get("summary")
+        elif emu_artifact is None:
+            print("[bench] chaos_serve skipped: no emulator artifact this "
+                  "round", file=sys.stderr)
+        else:
+            chaos_serve_summary = run_leg(
+                "chaos_serve", lambda: chaos_serve_metric(emu_artifact)
+            )
+    except Exception as exc:  # noqa: BLE001 — secondary metric is best-effort
+        print(f"[bench] chaos_serve metric unavailable: {exc}",
+              file=sys.stderr)
+
     # --- secondary metric: seam-split emulator domains + error gate ----
     # The PR-3 emulator's documented blind spot: a box crossing the
     # T = m/3 flux seam refines first-order and was "split at the band
@@ -1660,6 +1804,10 @@ def main(argv=None) -> None:
                 # the sharded-fleet serving metric (null = leg failed or
                 # no artifact; its secondary line has the full detail)
                 "serve": serve_summary,
+                # the self-healing fleet under a canned replica-fault
+                # trace (availability / recovery / bitwise pin; null =
+                # leg failed — its secondary line has the full detail)
+                "chaos_serve": chaos_serve_summary,
                 # the seam-split emulator A/B (split-domain build +
                 # error-gated serve trace vs single-domain; null = leg
                 # failed — its secondary line has the full detail)

@@ -1,0 +1,29 @@
+#!/usr/bin/env bash
+# The repo's one PR gate: the ROADMAP tier-1 test command + scripts/lint.sh,
+# in that order, exiting nonzero when EITHER fails.  Every PR runs this same
+# entry point so "tier-1 green" means the same thing on every machine; the
+# pytest invocation below is byte-for-byte the ROADMAP.md "Tier-1 verify"
+# command (update both together).
+set -u
+cd "$(dirname "$0")/.."
+
+set -o pipefail
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
+    -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
+test_rc=${PIPESTATUS[0]}
+echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
+
+bash scripts/lint.sh
+lint_rc=$?
+
+if [ "$test_rc" -ne 0 ]; then
+    echo "[tier1] tests FAILED (rc=$test_rc)" >&2
+    exit "$test_rc"
+fi
+if [ "$lint_rc" -ne 0 ]; then
+    echo "[tier1] lint FAILED (rc=$lint_rc)" >&2
+    exit "$lint_rc"
+fi
+echo "[tier1] tests + lint green"

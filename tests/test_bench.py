@@ -87,6 +87,8 @@ def test_bench_leg_cache_replays_cpu_round(tmp_path):
         BDLZ_BENCH_EMU_EXACT_POINTS="32", BDLZ_BENCH_CHAOS_POINTS="16",
         BDLZ_BENCH_SERVE_QUERIES="1024", BDLZ_BENCH_SERVE_BATCH="256",
         BDLZ_BENCH_SERVE_LAT_QUERIES="256",
+        BDLZ_BENCH_CHAOS_SERVE_QUERIES="384",
+        BDLZ_BENCH_CHAOS_SERVE_BATCH="16",
         # tiny seam leg: the split/build/serve machinery still runs,
         # but no acceptance numbers are asserted on THIS test (replay
         # equality is)
@@ -147,6 +149,11 @@ def test_bench_cpu_smoke():
         BDLZ_BENCH_SERVE_QUERIES="2048",
         BDLZ_BENCH_SERVE_BATCH="256",
         BDLZ_BENCH_SERVE_LAT_QUERIES="512",
+        # small chaos_serve leg: 24 fake-clock batches — enough trace
+        # for the full breaker trip → failed probes → heal → re-close
+        # choreography the acceptance asserts below pin
+        BDLZ_BENCH_CHAOS_SERVE_QUERIES="384",
+        BDLZ_BENCH_CHAOS_SERVE_BATCH="16",
         # the seam_split leg at its ACCEPTANCE settings (rtol 1e-4,
         # full round budget): the >=10x fallback ratio and the <=1e-3
         # gated-agreement are asserted below on this exact line
@@ -201,7 +208,8 @@ def test_bench_cpu_smoke():
             "chaos_sweep_points_per_sec_per_chip",
             "sweep_cache_warm_vs_cold",
             "seam_split_fallback_ratio",
-            "serve_bench_queries_per_sec_per_chip"} <= names
+            "serve_bench_queries_per_sec_per_chip",
+            "chaos_serve_availability"} <= names
     # robustness schema: every sweep metric line carries the failure
     # counters (nulls where the leg has no healing path), main line
     # included
@@ -209,7 +217,8 @@ def test_bench_cpu_smoke():
     for s in secondary:
         if s["metric"] in ("emulator_query_points_per_sec",
                            "serve_bench_queries_per_sec_per_chip",
-                           "seam_split_fallback_ratio"):
+                           "seam_split_fallback_ratio",
+                           "chaos_serve_availability"):
             continue  # query/serving metrics, not sweep lines
         assert {"n_failed", "n_quarantined", "n_retries"} <= set(s), s["metric"]
     # the chaos line: healed sweep under the canned fault plan — the
@@ -260,7 +269,8 @@ def test_bench_cpu_smoke():
     for s in secondary:
         if s["metric"] in ("emulator_query_points_per_sec",
                            "serve_bench_queries_per_sec_per_chip",
-                           "seam_split_fallback_ratio"):
+                           "seam_split_fallback_ratio",
+                           "chaos_serve_availability"):
             continue
         assert {"cache_hits", "cache_misses"} <= set(s), s["metric"]
     # a plain (relay-up / forced-cpu) round never reuses cached legs
@@ -361,6 +371,40 @@ def test_bench_cpu_smoke():
         "bit_identical_across_replicas": srv[
             "bit_identical_across_replicas"
         ],
+    }
+    # the chaos_serve line (docs/robustness.md "Replica health plane"):
+    # the canned single-replica fault trace on a 2-replica fleet — the
+    # acceptance criteria checked on the line itself: availability
+    # >= 0.99 over the trace, every answer bit-identical to the clean
+    # run (healed batches re-run the same fused kernel), and the
+    # breaker re-closed after its half-open probe, with the recovery
+    # span recorded in fake-clock seconds
+    cs = next(s for s in secondary
+              if s["metric"] == "chaos_serve_availability")
+    assert {"value", "n_requests", "n_replicas", "host_cores",
+            "p50_latency_s", "p99_latency_s", "breaker_opens",
+            "breaker_reclosed", "recovery_s", "healed_batches",
+            "degraded_batches", "bitwise_equal_unaffected",
+            "wall_seconds", "fault_plan", "artifact_hash", "platform",
+            "tpu_unavailable"} <= set(cs)
+    assert cs["value"] >= 0.99
+    assert cs["bitwise_equal_unaffected"] is True
+    assert cs["breaker_reclosed"] is True
+    assert cs["breaker_opens"] >= 1
+    assert cs["recovery_s"] > 0
+    assert cs["healed_batches"] >= 1
+    assert cs["degraded_batches"] == 0     # one healthy replica remained
+    assert cs["n_replicas"] == 2
+    assert cs["p99_latency_s"] is not None
+    assert {"site", "kind"} <= set(cs["fault_plan"][0])
+    assert d["chaos_serve"] == {
+        "value": cs["value"],
+        "p99_latency_s": cs["p99_latency_s"],
+        "recovery_s": cs["recovery_s"],
+        "breaker_opens": cs["breaker_opens"],
+        "breaker_reclosed": cs["breaker_reclosed"],
+        "healed_batches": cs["healed_batches"],
+        "bitwise_equal_unaffected": cs["bitwise_equal_unaffected"],
     }
     # the seam_split line (the PR's acceptance criteria, checked on the
     # line itself): on a deterministic seam-crossing trace the

@@ -339,6 +339,62 @@ class TestOdeMethodKey:
         assert int(from_cfg.n_steps) > int(default_run.n_steps)
 
 
+class TestHealthPlaneKnobs:
+    """The replica health plane / auto-rollback knobs (serve/health.py):
+    validated bounds + the SERVE_CONFIG_FIELDS exclusion — breakers
+    pick WHICH replica answers, never what a kernel computes, so tuning
+    them stales nothing."""
+
+    def test_validation(self):
+        from bdlz_tpu.config import ConfigError, config_from_dict, validate
+
+        validate(config_from_dict({
+            "health_enabled": True, "breaker_window": 3,
+            "breaker_threshold": 0.25, "breaker_cooldown_s": 2.0,
+            "breaker_latency_slo_s": 0.5, "rollback_budget": 0.01,
+        }))
+        validate(config_from_dict({"health_enabled": False}))
+        with pytest.raises(ConfigError, match="health_enabled"):
+            validate(config_from_dict({"health_enabled": "on"}))
+        with pytest.raises(ConfigError, match="breaker_window"):
+            validate(config_from_dict({"breaker_window": 0}))
+        with pytest.raises(ConfigError, match="breaker_threshold"):
+            validate(config_from_dict({"breaker_threshold": 0.0}))
+        with pytest.raises(ConfigError, match="breaker_threshold"):
+            validate(config_from_dict({"breaker_threshold": 1.5}))
+        with pytest.raises(ConfigError, match="breaker_cooldown_s"):
+            validate(config_from_dict({"breaker_cooldown_s": 0.0}))
+        with pytest.raises(ConfigError, match="breaker_latency_slo_s"):
+            validate(config_from_dict({"breaker_latency_slo_s": -0.1}))
+        with pytest.raises(ConfigError, match="rollback_budget"):
+            validate(config_from_dict({"rollback_budget": 0.0}))
+        with pytest.raises(ConfigError, match="rollback_budget"):
+            validate(config_from_dict({"rollback_budget": 2.0}))
+
+    def test_excluded_from_every_identity(self):
+        from bdlz_tpu.config import (
+            SERVE_CONFIG_FIELDS,
+            config_from_dict,
+            config_identity_dict,
+        )
+        from bdlz_tpu.parallel.sweep import grid_hash
+
+        for k in ("health_enabled", "breaker_window", "breaker_threshold",
+                  "breaker_cooldown_s", "breaker_latency_slo_s",
+                  "rollback_budget"):
+            assert k in SERVE_CONFIG_FIELDS
+        base = {"P_chi_to_B": 0.149}
+        cfg = config_from_dict(base)
+        tuned = config_from_dict(dict(
+            base, health_enabled=True, breaker_window=2,
+            breaker_threshold=0.9, breaker_cooldown_s=9.0,
+            breaker_latency_slo_s=0.3, rollback_budget=0.5,
+        ))
+        assert config_identity_dict(tuned) == config_identity_dict(cfg)
+        axes = {"m_chi_GeV": [0.5, 1.0]}
+        assert grid_hash(cfg, axes, 2000) == grid_hash(tuned, axes, 2000)
+
+
 class TestEmulatorSeamKnobs:
     """The seam-split/error-gate/posterior-weight knobs: validated
     tri-states with DELIBERATE identity treatment — seam_split and

@@ -383,6 +383,61 @@ class TestRollout:
         assert ro.staged_hash is None
         assert svc.artifact_hash == h0
 
+    def test_abort_while_stage_warmed_then_restage_cutover(self):
+        """Satellite edge: aborting a WARMED stage (compiled kernels)
+        drops it cleanly — not ready, active untouched, idempotent —
+        and a fresh stage afterwards cuts over normally."""
+        svc = _fleet()
+        ro = ArtifactRollout(svc)
+        h0 = svc.artifact_hash
+        ro.stage(_make_artifact(scale=2.0))     # warm=True default
+        assert ro.ready()                       # warmed and staged
+        ro.abort()
+        assert ro.staged_hash is None and not ro.ready()
+        assert svc.artifact_hash == h0
+        ro.abort()                              # idempotent on empty
+        art3 = _make_artifact(scale=3.0)
+        ro.stage(art3)
+        old, new = ro.cutover()
+        assert (old, new) == (h0, art3.content_hash)
+        assert svc.artifact_hash == art3.content_hash
+
+    def test_stage_by_hash_missing_entry_refuses(self, tmp_path):
+        """Satellite edge: staging a content hash the registry never
+        published refuses loudly, with nothing half-staged."""
+        from bdlz_tpu.provenance import Store
+
+        svc = _fleet()
+        ro = ArtifactRollout(svc, store=Store(str(tmp_path / "store")))
+        with pytest.raises(EmulatorArtifactError, match="no published"):
+            ro.stage("0123456789abcdef")
+        assert ro.staged_hash is None
+        assert svc.artifact_hash  # still serving the original
+
+    def test_swap_replica_set_drains_in_flight_slots(self):
+        """Satellite edge: a batch in flight on the OLD set when the
+        swap lands resolves with the old hash/values AND releases the
+        old replicas' in-flight slots — the retired set drains to
+        idle, nothing leaks."""
+        art_n, art_n1 = _make_artifact(), _make_artifact(scale=2.0)
+        clock = FakeClock()
+        svc = _fleet(artifact=art_n, clock=clock)
+        ro = ArtifactRollout(svc)
+        pre = [svc.submit(t) for t in _thetas(4, seed=7)]
+        svc.run_once()                          # full batch: in flight on N
+        old_set = svc.replica_set
+        assert sum(r.in_flight for r in old_set.replicas) == 1
+        ro.stage(art_n1)
+        ro.cutover()
+        assert svc.replica_set is not old_set
+        assert svc.poll(block=True) == 4
+        responses = [f.result(timeout=0) for f in pre]
+        assert {r.artifact_hash for r in responses} == {
+            art_n.content_hash
+        }
+        assert sum(r.in_flight for r in old_set.replicas) == 0
+        assert svc.in_flight() == 0
+
     def test_broadcast_text_roundtrip(self):
         """The rollout's hash-agreement wire helper (single-process =
         identity; width overflow is loud, not truncated)."""

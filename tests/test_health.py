@@ -1,0 +1,881 @@
+"""Replica health plane / circuit breaker / auto-rollback tests
+(bdlz_tpu/serve/health.py + the fleet/rollout integration).
+
+Same testability contract as the fleet suite: every breaker decision —
+trip, cooldown, half-open probe, re-close — and the rollout observation
+window run on a FAKE clock with explicit run_once/poll calls; zero
+sleeps, zero background threads.  Injected replica faults come from the
+extended FaultPlan (site ``replica_dispatch``, keyed by replica index;
+site ``registry_fetch`` for the re-provision path).
+
+The two contracts everything here defends:
+
+* healing is INVISIBLE in the values — a healed/re-answered batch is
+  bit-identical to the clean run (every replica runs the same fused
+  kernel on the same table bytes);
+* disabling the plane (``health_enabled=false``) is byte-identical to
+  the pre-health service: same values, same ServeStats schema (the
+  zero-overhead pin).
+"""
+import dataclasses
+import json
+
+import numpy as np
+import pytest
+
+from bdlz_tpu.config import config_from_dict, static_choices_from_config
+from bdlz_tpu.emulator.artifact import EmulatorArtifact, build_identity
+from bdlz_tpu.serve import ArtifactRollout, FleetService, ServiceUnavailable
+from bdlz_tpu.serve.health import (
+    STATE_CLOSED,
+    STATE_OPEN,
+    BreakerPolicy,
+    HealthPlane,
+    resolve_health_policy,
+)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+BASE = config_from_dict({
+    "regime": "nonthermal",
+    "P_chi_to_B": 0.14925839040304145,
+    "source_shape_sigma_y": 9.0,
+    "incident_flux_scale": 1.07e-9,
+    "Y_chi_init": 4.90e-10,
+})
+STATIC = static_choices_from_config(BASE)._replace(quad_panel_gl=False)
+AXES = ("m_chi_GeV", "T_p_GeV", "v_w")
+NODES = (
+    np.linspace(0.9, 1.1, 4),
+    np.geomspace(90.0, 110.0, 5),
+    np.linspace(0.25, 0.35, 3),
+)
+LO = np.array([n[0] for n in NODES])
+HI = np.array([n[-1] for n in NODES])
+
+#: The pre-health ServeStats schema (PR-8) the zero-overhead pin
+#: freezes: with the plane disabled, summary() and as_rows() must carry
+#: EXACTLY these keys.
+PRE_HEALTH_SUMMARY_KEYS = (
+    "batches", "requests", "fallbacks", "fallback_rate",
+    "gated_fallbacks", "gated_rate", "mean_batch", "mean_occupancy",
+    "max_wait_s", "seconds", "retries", "deadline_kills", "errors",
+    "quarantine_rate", "accepted", "admission_rejects", "shed_rate",
+    "p50_latency_s", "p99_latency_s", "warmup_seconds",
+)
+PRE_HEALTH_ROW_KEYS = (
+    "batch_index", "size", "occupancy", "wait_s", "n_fallback",
+    "seconds", "n_retries", "n_error", "n_gated", "artifact_hash",
+    "replica",
+)
+
+
+def _make_artifact(scale=1.0, base=BASE):
+    rng = np.random.default_rng(42)
+    vals = np.exp(rng.normal(size=(4, 5, 3))) * scale
+    return EmulatorArtifact(
+        axis_names=AXES,
+        axis_nodes=NODES,
+        axis_scales=("log", "log", "lin"),
+        values={"DM_over_B": vals},
+        identity=build_identity(base, STATIC, 400, "tabulated"),
+        manifest={},
+    )
+
+
+def _thetas(n, seed=0):
+    return np.random.default_rng(seed).uniform(LO, HI, size=(n, 3))
+
+
+def _plan(*specs):
+    return json.dumps({"faults": list(specs)})
+
+
+def _fleet(fault_plan=None, clock=None, artifact=None, base=BASE, **kw):
+    """A 2-replica round-robin fleet with one-strike breakers and a
+    short fake-clock cooldown — the canonical trip/probe test shape
+    (round_robin so replica 1 is hit on every second batch)."""
+    cfg = dataclasses.replace(
+        base,
+        fault_plan=fault_plan,
+        fault_injection=None if fault_plan else False,
+        breaker_window=1,
+        breaker_cooldown_s=0.05,
+    )
+    kw.setdefault("max_batch_size", 4)
+    kw.setdefault("max_wait_s", 0.001)
+    kw.setdefault("n_replicas", 2)
+    kw.setdefault("routing", "round_robin")
+    return FleetService(
+        artifact if artifact is not None else _make_artifact(),
+        cfg, static=STATIC, clock=clock or FakeClock(), **kw,
+    )
+
+
+def _serve(svc, clock, thetas, batch=4, tick=0.01):
+    """Closed-loop pump: submit, tick the fake clock per batch,
+    dispatch, resolve.  Returns the per-request values (NaN where the
+    future raised) and the raised exceptions."""
+    futs = []
+    for i, t in enumerate(thetas):
+        futs.append(svc.submit(t))
+        if (i + 1) % batch == 0:
+            clock.advance(tick)
+            svc.run_once()
+            svc.poll(block=True)
+    svc.drain()
+    vals = np.full(len(thetas), np.nan)
+    errs = []
+    for i, f in enumerate(futs):
+        try:
+            vals[i] = f.result(timeout=0).value
+        except Exception as exc:  # noqa: BLE001 — asserted by callers
+            errs.append(exc)
+    return vals, errs
+
+
+class TestBreakerUnit:
+    def test_policy_resolution_tri_state(self):
+        assert resolve_health_policy(False, BASE) is None
+        assert resolve_health_policy(
+            None, dataclasses.replace(BASE, health_enabled=False)
+        ) is None
+        # explicit True overrides a config False
+        assert resolve_health_policy(
+            True, dataclasses.replace(BASE, health_enabled=False)
+        ) is not None
+        pol = resolve_health_policy(None, dataclasses.replace(
+            BASE, breaker_window=3, breaker_threshold=0.25,
+            breaker_cooldown_s=2.0, breaker_latency_slo_s=0.75,
+        ))
+        assert pol == BreakerPolicy(3, 0.25, 2.0, 0.75)
+
+    def test_score_denominator_is_window_length(self):
+        """One hiccup in a wide window must NOT trip the breaker: the
+        score divides by the window LENGTH, so threshold*window actual
+        failures are required."""
+        plane = HealthPlane(1, BreakerPolicy(window=4, threshold=0.5))
+        plane.record_outcome(0, ok=False, now=0.0)
+        assert plane.breakers[0].state == STATE_CLOSED
+        plane.record_outcome(0, ok=True, now=0.0)
+        plane.record_outcome(0, ok=False, now=0.0)
+        assert plane.breakers[0].state == STATE_OPEN  # 2/4 >= 0.5
+
+    def test_probe_scheduling_on_clock(self):
+        plane = HealthPlane(2, BreakerPolicy(window=1, cooldown_s=1.0))
+        plane.record_outcome(1, ok=False, now=5.0)
+        assert plane.breakers[1].state == STATE_OPEN
+        assert plane.routable(5.5) == ([0], None)   # cooling down
+        assert plane.routable(6.0) == ([0], 1)      # probe due
+        plane.probe_started(1, 6.0)
+        assert plane.routable(6.0) == ([0], None)   # one probe at a time
+        plane.record_outcome(1, ok=True, now=6.5, probe=True)  # probe OK
+        assert plane.breakers[1].state == STATE_CLOSED
+        assert plane.recoveries_s == [pytest.approx(1.5)]
+
+    def test_non_probe_outcome_never_resolves_half_open(self):
+        """Only THE probe batch decides a half-open breaker: an older
+        batch (dispatched while the breaker was still closed) resolving
+        during the probe window must neither re-open on failure nor
+        close on success — its outcome only lands in the window."""
+        from bdlz_tpu.serve.health import STATE_HALF_OPEN
+
+        plane = HealthPlane(2, BreakerPolicy(window=2, cooldown_s=1.0))
+        plane.record_outcome(1, ok=False, now=0.0)
+        assert plane.breakers[1].state == STATE_OPEN
+        opens_before = plane.opens
+        plane.probe_started(1, 1.0)
+        plane.record_outcome(1, ok=False, now=1.1, probe=False)  # old batch
+        assert plane.breakers[1].state == STATE_HALF_OPEN
+        assert plane.breakers[1].probe_inflight
+        assert plane.opens == opens_before      # no spurious re-open
+        plane.record_outcome(1, ok=True, now=1.2, probe=False)   # old batch
+        assert plane.breakers[1].state == STATE_HALF_OPEN        # not closed
+        plane.record_outcome(1, ok=True, now=1.3, probe=True)    # THE probe
+        assert plane.breakers[1].state == STATE_CLOSED
+
+    def test_latency_slo_downgrades_ok(self):
+        plane = HealthPlane(
+            1, BreakerPolicy(window=1, latency_slo_s=0.5)
+        )
+        plane.record_outcome(0, ok=True, now=0.0, seconds=0.75)
+        assert plane.breakers[0].state == STATE_OPEN
+        assert plane.events[-1]["cause"] == "slow"
+
+
+class TestFaultSites:
+    """The extended FaultPlan surface (bdlz_tpu/faults.py)."""
+
+    def test_replica_nan_needs_no_point_but_step_nan_does(self):
+        from bdlz_tpu.faults import FaultPlan, FaultPlanError
+
+        FaultPlan.from_obj({"faults": [
+            {"site": "replica_dispatch", "kind": "nan", "key": 0},
+        ]})
+        with pytest.raises(FaultPlanError, match="needs a 'point'"):
+            FaultPlan.from_obj({"faults": [
+                {"site": "step", "kind": "nan", "key": 0},
+            ]})
+
+    def test_nan_batch_times_budget(self):
+        from bdlz_tpu.faults import FaultPlan
+
+        p = FaultPlan.from_obj({"faults": [
+            {"site": "replica_dispatch", "kind": "nan", "key": 1,
+             "times": 2},
+        ]})
+        assert not p.nan_batch("replica_dispatch", 0)  # wrong replica
+        assert p.nan_batch("replica_dispatch", 1)
+        assert p.nan_batch("replica_dispatch", 1)
+        assert not p.nan_batch("replica_dispatch", 1)  # budget spent
+
+    def test_corrupt_bytes_flips_once(self, tmp_path):
+        from bdlz_tpu.faults import FaultPlan
+
+        p = FaultPlan.from_obj({"faults": [
+            {"site": "registry_fetch", "kind": "corrupt", "key": 0},
+        ]})
+        f = tmp_path / "payload.bin"
+        original = bytes(range(64))
+        f.write_bytes(original)
+        assert p.corrupt_bytes("registry_fetch", 0, str(f))
+        assert f.read_bytes() != original
+        assert len(f.read_bytes()) == 64           # flipped, not torn
+        damaged = f.read_bytes()
+        assert not p.corrupt_bytes("registry_fetch", 0, str(f))
+        assert f.read_bytes() == damaged           # fires once
+
+    def test_new_sites_validated(self):
+        from bdlz_tpu.faults import FaultPlan, FaultPlanError
+
+        with pytest.raises(FaultPlanError, match="site"):
+            FaultPlan.from_obj({"faults": [
+                {"site": "replica", "kind": "raise"},
+            ]})
+        plan = FaultPlan.from_obj({"faults": [
+            {"site": "registry_fetch", "kind": "torn", "key": 3},
+        ]})
+        assert plan.describe() == [
+            {"site": "registry_fetch", "kind": "torn", "key": 3},
+        ]
+
+
+class TestBreakerTripsAndHeals:
+    def test_dispatch_fault_heals_bit_identical_and_opens_breaker(self):
+        """A replica raising at dispatch costs nothing visible: the
+        batch is re-routed to a healthy replica and every value is
+        bit-identical to the clean run; the sick replica's breaker
+        opens and traffic stops routing to it."""
+        thetas = _thetas(24)
+        clean_clock = FakeClock()
+        clean, _ = _serve(_fleet(clock=clean_clock), clean_clock, thetas)
+        clock = FakeClock()
+        svc = _fleet(
+            _plan({"site": "replica_dispatch", "kind": "raise", "key": 1}),
+            clock=clock,
+        )
+        vals, errs = _serve(svc, clock, thetas)
+        assert not errs
+        assert np.array_equal(vals, clean)  # bitwise, not allclose
+        health = svc.stats.extras["health"]
+        assert health["states"][1] == STATE_OPEN
+        assert health["opens"] >= 1
+        # after the trip every batch ran on replica 0 (or -1 never:
+        # replica 0 stays healthy, no degraded batches)
+        assert health["degraded_batches"] == 0
+        rows = svc.stats.as_rows()
+        assert all(r["replica"] == 0 for r in rows[2:])
+
+    def test_nan_batch_detected_at_gather_and_reanswered(self):
+        """A NaN-emitting replica is caught at gather (finite tables
+        cannot produce NaN) and the batch is re-answered on a healthy
+        replica, bit-identical."""
+        thetas = _thetas(8)
+        clean_clock = FakeClock()
+        clean, _ = _serve(_fleet(clock=clean_clock), clean_clock, thetas)
+        clock = FakeClock()
+        svc = _fleet(
+            _plan({"site": "replica_dispatch", "kind": "nan", "key": 1,
+                   "times": 1}),
+            clock=clock,
+        )
+        vals, errs = _serve(svc, clock, thetas)
+        assert not errs
+        assert np.array_equal(vals, clean)
+        health = svc.stats.extras["health"]
+        assert health["healed_batches"] == 1
+        assert health["states"][1] == STATE_OPEN
+        # the healed batch's stats row names the replica that ANSWERED
+        assert svc.stats.as_rows()[1]["replica"] == 0
+
+    def test_transient_fault_full_recovery_cycle(self):
+        """transient(times=2) + one NaN probe: trip → cooldown → failed
+        probe → cooldown → NaN probe (healed) → cooldown → clean probe
+        → breaker RE-CLOSES, recovery time recorded, traffic resumes on
+        both replicas — all on the fake clock."""
+        thetas = _thetas(160)
+        clean_clock = FakeClock()
+        clean, _ = _serve(_fleet(clock=clean_clock), clean_clock, thetas)
+        clock = FakeClock()
+        svc = _fleet(
+            _plan(
+                {"site": "replica_dispatch", "kind": "transient",
+                 "key": 1, "times": 2},
+                {"site": "replica_dispatch", "kind": "nan", "key": 1,
+                 "times": 1},
+            ),
+            clock=clock,
+        )
+        vals, errs = _serve(svc, clock, thetas)
+        assert not errs
+        assert np.array_equal(vals, clean)
+        health = svc.stats.extras["health"]
+        assert health["states"] == [STATE_CLOSED, STATE_CLOSED]
+        assert health["opens"] == 3          # trip + 2 failed probes
+        assert health["closes"] == 1
+        assert health["recoveries"] == 1
+        assert health["last_recovery_s"] == pytest.approx(0.16, abs=0.03)
+        assert health["healed_batches"] == 1  # the NaN probe batch
+        # replica 1 serves again after the re-close
+        tail = [r["replica"] for r in svc.stats.as_rows()[-6:]]
+        assert 1 in tail
+
+    def test_probe_not_scheduled_before_cooldown(self):
+        clock = FakeClock()
+        svc = _fleet(
+            _plan({"site": "replica_dispatch", "kind": "raise", "key": 1}),
+            clock=clock,
+        )
+        thetas = _thetas(16)
+        _serve(svc, clock, thetas, tick=0.005)  # 4 ticks < cooldown 0.05
+        # breaker opened on the first replica-1 batch and stayed open
+        # with NO probe attempted (no half_open transition yet)
+        transitions = [
+            e for e in svc.health.events if e["to"] == "half_open"
+        ]
+        assert svc.health.breakers[1].state == STATE_OPEN
+        assert not transitions
+
+    def test_slow_replica_latency_slo_trips_breaker(self):
+        """An injected slow-replica fault surfaces as batch seconds
+        through the clock seam; with a latency SLO configured the
+        breaker treats it as a bad outcome."""
+        clock = FakeClock()
+        cfg = dataclasses.replace(
+            BASE,
+            fault_plan=_plan({"site": "replica_dispatch", "kind": "slow",
+                              "key": 1, "delay_s": 2.0}),
+            breaker_window=1, breaker_cooldown_s=99.0,
+            breaker_latency_slo_s=0.5,
+        )
+        svc = FleetService(
+            _make_artifact(), cfg, static=STATIC, clock=clock,
+            max_batch_size=4, n_replicas=2, routing="round_robin",
+            max_wait_s=0.001,
+        )
+        thetas = _thetas(16)
+        vals, errs = _serve(svc, clock, thetas)
+        assert not errs and np.isfinite(vals).all()
+        assert svc.health.breakers[1].state == STATE_OPEN
+        assert svc.health.events[0]["cause"] == "slow"
+        # the slow batch's stats row carries the injected seconds
+        slow_rows = [r for r in svc.stats.as_rows() if r["seconds"] > 1.0]
+        assert slow_rows and all(r["replica"] == 1 for r in slow_rows)
+
+    def test_host_fallback_time_not_charged_to_breaker_slo(
+        self, tiny_emulator
+    ):
+        """OOD/gated requests pay the exact pipeline on the HOST; that
+        time must never count against the replica's latency SLO — a
+        slow exact path would otherwise open every breaker on a
+        perfectly healthy fleet and push it into (even slower)
+        degraded mode."""
+        from bdlz_tpu.emulator import load_artifact
+
+        base, out_dir, _, _ = tiny_emulator
+        art = load_artifact(out_dir)
+        clock = FakeClock()
+        cfg = dataclasses.replace(
+            base, breaker_window=1, breaker_cooldown_s=99.0,
+            breaker_latency_slo_s=0.5,
+        )
+        svc = FleetService(
+            art, cfg, max_batch_size=2, n_replicas=2, clock=clock,
+            max_wait_s=0.001,
+        )
+        inner = svc._fallback
+
+        def slow_exact(axes, retries_box):
+            clock.advance(10.0)  # 20x over the SLO, all host-side
+            return inner(axes, retries_box)
+
+        svc._fallback = slow_exact
+        # one OOD request per batch, two batches -> BOTH replicas pay
+        # the slow host fallback once
+        thetas = np.array([
+            [1.0, 100.0, 0.60],   # v_w outside the tiny box
+            [0.95, 95.0, 0.28],
+            [1.0, 100.0, 0.65],   # OOD again
+            [1.0, 100.0, 0.30],
+        ])
+        vals, errs = _serve(svc, clock, thetas, batch=2)
+        assert not errs and np.isfinite(vals).all()
+        assert all(b.state == STATE_CLOSED for b in svc.health.breakers)
+        assert not [e for e in svc.health.events if e["cause"] == "slow"]
+        # the stats rows still report the TRUE batch seconds (the
+        # fallback time stays visible — it just never scores a breaker)
+        assert any(r["seconds"] > 0.5 for r in svc.stats.as_rows())
+
+
+class TestDegradedMode:
+    def test_all_open_serves_degraded_exact(self, tiny_emulator):
+        """Every breaker open → the batch is answered by the EXACT
+        pipeline, loudly: degraded=True, reason "degraded", replica -1
+        on the stats row — correct answers, never silent garbage."""
+        from bdlz_tpu.emulator import load_artifact
+        from bdlz_tpu.serve import YieldService
+
+        base, out_dir, _, _ = tiny_emulator
+        art = load_artifact(out_dir)
+        clock = FakeClock()
+        cfg = dataclasses.replace(
+            base,
+            fault_plan=_plan({"site": "replica_dispatch", "kind": "raise"}),
+            breaker_window=1, breaker_cooldown_s=99.0,
+        )
+        svc = FleetService(
+            art, cfg, max_batch_size=4, n_replicas=2, clock=clock,
+            max_wait_s=0.001,
+        )
+        thetas = np.array([
+            [1.0, 100.0, 0.30],
+            [0.95, 95.0, 0.28],
+        ])
+        futs = [svc.submit(t) for t in thetas]
+        clock.advance(0.01)
+        svc.run_once()
+        got = [f.result(timeout=0) for f in futs]
+        assert all(r.degraded for r in got)
+        assert all(r.fallback_reason == "degraded" for r in got)
+        assert all(r.replica == -1 for r in got)
+        # degraded answers come from the EXACT pipeline: they agree
+        # with the emulator reference to the artifact's tolerance (the
+        # build's rtol is 1e-4), not bit-for-bit
+        ref = YieldService(art, base, max_batch_size=4, warm=False)
+        want, _ = ref.evaluate(thetas)
+        np.testing.assert_allclose(
+            [r.value for r in got], want, rtol=1e-3
+        )
+        health = svc.stats.extras["health"]
+        assert health["degraded_batches"] == 1
+        assert svc.stats.as_rows()[-1]["replica"] == -1
+
+    def test_all_open_dead_exact_raises_service_unavailable(self):
+        """The end of the degradation ladder: all replicas open AND the
+        exact path dead → typed ServiceUnavailable per request, never a
+        hang, never a silent wrong answer."""
+        clock = FakeClock()
+        svc = _fleet(
+            _plan(
+                {"site": "replica_dispatch", "kind": "raise"},
+                {"site": "serve_exact", "kind": "raise"},
+            ),
+            clock=clock,
+        )
+        futs = [svc.submit(t) for t in _thetas(4)]
+        clock.advance(0.01)
+        svc.run_once()
+        for f in futs:
+            with pytest.raises(ServiceUnavailable, match="circuit-open"):
+                f.result(timeout=0)
+        assert svc.stats.summary()["errors"] == 4
+
+
+class TestReprovision:
+    def _store_with_artifact(self, tmp_path, artifact):
+        from bdlz_tpu.provenance import Store, publish_artifact, registry
+
+        registry.reset_fetch_counter()
+        store = Store(str(tmp_path / "store"))
+        publish_artifact(store, artifact)
+        return store
+
+    def test_persistent_sickness_reprovisions_from_registry(self, tmp_path):
+        """After the probe budget burns (2 consecutive opens), the sick
+        replica is rebuilt from the registry's published copy by
+        content hash; the next probe then re-closes the breaker."""
+        art = _make_artifact()
+        store = self._store_with_artifact(tmp_path, art)
+        clock = FakeClock()
+        svc = _fleet(
+            _plan({"site": "replica_dispatch", "kind": "transient",
+                   "key": 1, "times": 3}),
+            clock=clock, artifact=art, store=store,
+        )
+        thetas = _thetas(160)
+        clean_clock = FakeClock()
+        clean, _ = _serve(
+            _fleet(clock=clean_clock, artifact=_make_artifact()),
+            clean_clock, thetas,
+        )
+        vals, errs = _serve(svc, clock, thetas)
+        assert not errs
+        assert np.array_equal(vals, clean)  # reprovision kept the bits
+        health = svc.stats.extras["health"]
+        assert health["reprovisions"] == 1
+        assert health["reprovision_failures"] == 0
+        assert health["states"] == [STATE_CLOSED, STATE_CLOSED]
+
+    def test_registry_fetch_fault_counts_failure_breaker_survives(
+        self, tmp_path,
+    ):
+        """A torn/corrupt registry entry fails the re-provision (and the
+        corrupt-entry eviction deletes it); the breaker simply stays on
+        its probe cycle and still recovers once the fault clears."""
+        art = _make_artifact()
+        store = self._store_with_artifact(tmp_path, art)
+        clock = FakeClock()
+        svc = _fleet(
+            _plan(
+                {"site": "replica_dispatch", "kind": "transient",
+                 "key": 1, "times": 3},
+                {"site": "registry_fetch", "kind": "corrupt", "key": 0},
+            ),
+            clock=clock, artifact=art, store=store,
+        )
+        vals, errs = _serve(svc, clock, _thetas(160))
+        assert not errs and np.isfinite(vals).all()
+        health = svc.stats.extras["health"]
+        assert health["reprovision_failures"] == 1
+        assert health["reprovisions"] == 0
+        # recovery did not need the reprovision: the transient cleared
+        assert health["states"] == [STATE_CLOSED, STATE_CLOSED]
+
+    def test_fetch_missing_and_garbage_hash(self, tmp_path):
+        """Satellite: registry fetch of an absent hash refuses loudly;
+        a garbage entry is evicted on fetch."""
+        from bdlz_tpu.emulator.artifact import EmulatorArtifactError
+        from bdlz_tpu.provenance import Store, fetch_artifact
+
+        store = Store(str(tmp_path / "store"))
+        with pytest.raises(EmulatorArtifactError, match="no published"):
+            fetch_artifact(store, "0" * 16)
+        # a garbage entry: a directory of junk under a hash-like name
+        entry = (
+            tmp_path / "store" / "emulator_artifact" / "deadbeefdeadbeef"
+        )
+        entry.mkdir(parents=True)
+        (entry / "manifest.json").write_text("{not json")
+        with pytest.raises(EmulatorArtifactError):
+            fetch_artifact(store, "deadbeefdeadbeef")
+        assert not entry.exists()  # corrupt entry evicted
+
+
+class TestAutoRollback:
+    def test_blown_error_budget_rolls_back_within_window(self):
+        """The acceptance pin: a staged artifact that blows its error
+        budget post-cutover is rolled back automatically inside the
+        observation window — the old artifact hash serves again, the
+        per-batch hash rows show the N→N+1→N arc, and the reason is
+        recorded on stats."""
+        art_n = _make_artifact()
+        art_n1 = _make_artifact(scale=1.5)
+        h_n, h_n1 = art_n.content_hash, art_n1.content_hash
+        clock = FakeClock()
+        # slow faults on EVERY replica: post-cutover batches breach the
+        # observation's latency SLO and charge the budget (pre-cutover
+        # rows are outside the window — the observer only scores rows
+        # carrying the NEW artifact's hash)
+        cfg = dataclasses.replace(
+            BASE,
+            fault_plan=_plan({"site": "replica_dispatch", "kind": "slow",
+                              "delay_s": 2.0}),
+            rollback_budget=0.1,
+        )
+        svc = FleetService(
+            art_n, cfg, static=STATIC, max_batch_size=4, n_replicas=2,
+            clock=clock, max_wait_s=0.001, health=False,
+        )
+        ro = ArtifactRollout(svc)
+        thetas = _thetas(64, seed=3)
+
+        def pump(i):
+            for k in range(4):
+                svc.submit(thetas[(4 * i + k) % 64])
+            clock.advance(0.01)
+            svc.run_once()
+            svc.poll(block=True)
+
+        for i in range(3):
+            pump(i)
+        ro.stage(art_n1)
+        ro.cutover(observe_s=1.0, latency_slo_s=0.5)
+        assert svc.artifact_hash == h_n1
+        pump(3)  # first post-cutover batch blows the budget
+        assert svc.artifact_hash == h_n          # rolled back
+        assert ro.rolled_back is not None
+        assert ro.rolled_back.artifact_hash == h_n1
+        assert ro.observation is None            # disarmed
+        for i in range(4, 6):
+            pump(i)
+        rows = [r["artifact_hash"] for r in svc.stats.as_rows()]
+        flip_in = rows.index(h_n1)
+        assert set(rows[:flip_in]) == {h_n}
+        assert rows[flip_in:].count(h_n1) == 1   # exactly one bad batch
+        assert set(rows[flip_in + 1:]) == {h_n}  # N serving again
+        rb = svc.stats.extras["rollbacks"]
+        assert len(rb) == 1
+        assert rb[0]["from"] == h_n1 and rb[0]["to"] == h_n
+        assert "error budget exceeded" in rb[0]["reason"]
+        # the budget charge is a true per-request fraction: an
+        # SLO-breaching batch charges its size ONCE (never errors on
+        # top), so bad can never exceed requests
+        assert rb[0]["bad"] <= rb[0]["requests"] == 4
+        # the record rides the stats summary for dashboards
+        assert svc.stats.summary()["rollbacks"] == rb
+
+    def test_gated_fallback_budget_also_charges(self, tiny_emulator):
+        """The budget counts predicted-error-gated fallbacks too: a
+        rollout whose surface gates most traffic to the exact path is
+        a failed rollout even when every answer is correct."""
+        from bdlz_tpu.emulator import load_artifact
+
+        base, out_dir, _, _ = tiny_emulator
+        art = load_artifact(out_dir)
+        clock = FakeClock()
+        svc = FleetService(
+            art, base, max_batch_size=2, n_replicas=2, clock=clock,
+            max_wait_s=0.001, health=False,
+        )
+        ro = ArtifactRollout(svc)
+        # stage a copy whose persisted error estimates are enormous:
+        # identical identity/values, but EVERY in-domain query trips
+        # the predicted-error gate post-cutover
+        bad = art._replace(
+            predicted_error=np.full(
+                tuple(len(n) - 1 for n in art.axis_nodes), 1.0
+            ),
+            # drop the stored hash so content_hash recomputes over the
+            # tampered error grid (a different build, same identity)
+            manifest={
+                **{k: v for k, v in art.manifest.items() if k != "hash"},
+                "rtol_target": 1e-4, "converged": True,
+            },
+        )
+        assert bad.content_hash != art.content_hash
+        ro.stage(bad)
+        ro.cutover(observe_s=1.0, budget=0.5)
+        for t in ([1.0, 100.0, 0.30], [0.95, 95.0, 0.28]):
+            svc.submit(np.asarray(t))
+        clock.advance(0.01)
+        svc.run_once()
+        svc.poll(block=True)
+        # both requests were gated → 2/2 bad > 0.5 → rolled back
+        assert svc.artifact_hash == art.content_hash
+        assert "error budget exceeded" in (
+            svc.stats.extras["rollbacks"][0]["reason"]
+        )
+
+    def test_clean_window_passes_and_disarms(self):
+        art_n, art_n1 = _make_artifact(), _make_artifact(scale=1.5)
+        clock = FakeClock()
+        svc = FleetService(
+            art_n, BASE, static=STATIC, max_batch_size=4, n_replicas=2,
+            clock=clock, max_wait_s=0.001, health=False,
+        )
+        ro = ArtifactRollout(svc)
+        ro.stage(art_n1)
+        ro.cutover(observe_s=0.05)
+        thetas = _thetas(32)
+        _serve(svc, clock, thetas)  # clean traffic past the window
+        assert svc.artifact_hash == art_n1.content_hash  # stuck
+        assert ro.observation is None and svc._observer is None
+        obs = svc.stats.extras["rollout_observations"]
+        assert obs[0]["passed"] is True and obs[0]["bad"] == 0
+        assert "rollbacks" not in svc.stats.extras
+
+    def test_degraded_batches_charge_budget(self, tiny_emulator):
+        """A catastrophically bad rollout — every replica raising, all
+        breakers open, batches answered degraded through the exact
+        path — must blow the budget and roll back: degraded rows carry
+        n_error=0/n_gated=0 (the exact pipeline copes), so they charge
+        by replica == -1."""
+        from bdlz_tpu.emulator import load_artifact
+
+        base, out_dir, _, _ = tiny_emulator
+        art = load_artifact(out_dir)
+        clock = FakeClock()
+        cfg = dataclasses.replace(
+            base,
+            fault_plan=_plan({"site": "replica_dispatch", "kind": "raise"}),
+            breaker_window=1, breaker_cooldown_s=99.0,
+        )
+        svc = FleetService(
+            art, cfg, max_batch_size=2, n_replicas=2, clock=clock,
+            max_wait_s=0.001,
+        )
+        ro = ArtifactRollout(svc)
+        # same axes, doubled values: a different build of the same box
+        bad = art._replace(
+            values={k: v * 2.0 for k, v in art.values.items()},
+            manifest={k: v for k, v in art.manifest.items() if k != "hash"},
+        )
+        assert bad.content_hash != art.content_hash
+        ro.stage(bad)
+        ro.cutover(observe_s=5.0, budget=0.5)
+        assert svc.artifact_hash == bad.content_hash
+        futs = [svc.submit(np.asarray(t))
+                for t in ([1.0, 100.0, 0.30], [0.95, 95.0, 0.28])]
+        clock.advance(0.01)
+        svc.run_once()
+        got = [f.result(timeout=0) for f in futs]
+        # every replica raised at dispatch -> the batch went out
+        # degraded on the NEW hash, which charges 2/2 > 0.5: rollback
+        assert all(r.degraded for r in got)
+        assert svc.artifact_hash == art.content_hash
+        rb = svc.stats.extras["rollbacks"]
+        assert len(rb) == 1 and "error budget exceeded" in rb[0]["reason"]
+        assert rb[0]["from"] == bad.content_hash
+
+    def test_budget_blow_after_window_elapsed_sticks(self, tiny_emulator):
+        """A bad batch resolving long AFTER the observation window
+        elapsed must disarm the observation (the rollout already
+        stuck) — never revert it retroactively."""
+        from bdlz_tpu.emulator import load_artifact
+
+        base, out_dir, _, _ = tiny_emulator
+        art = load_artifact(out_dir)
+        clock = FakeClock()
+        svc = FleetService(
+            art, base, max_batch_size=2, n_replicas=2, clock=clock,
+            max_wait_s=0.001, health=False,
+        )
+        ro = ArtifactRollout(svc)
+        bad = art._replace(
+            predicted_error=np.full(
+                tuple(len(n) - 1 for n in art.axis_nodes), 1.0
+            ),
+            manifest={
+                **{k: v for k, v in art.manifest.items() if k != "hash"},
+                "rtol_target": 1e-4, "converged": True,
+            },
+        )
+        ro.stage(bad)
+        ro.cutover(observe_s=1.0, budget=0.5)
+        clock.advance(10.0)  # the window ends with no traffic at all
+        for t in ([1.0, 100.0, 0.30], [0.95, 95.0, 0.28]):
+            svc.submit(np.asarray(t))
+        clock.advance(0.01)
+        svc.run_once()
+        svc.poll(block=True)
+        # 2/2 requests gated — but hours past the window: it sticks
+        assert svc.artifact_hash == bad.content_hash
+        assert ro.observation is None and svc._observer is None
+        obs = svc.stats.extras["rollout_observations"]
+        assert obs[0]["passed"] is True
+        assert "rollbacks" not in svc.stats.extras
+
+    def test_cutover_kwargs_range_checked(self):
+        """budget/observe_s/latency_slo_s kwargs get the same range
+        checks as their validated config twins (budget=0 would roll
+        back on the first gated request, budget<0 on a fully CLEAN
+        batch) and a refused cutover leaves stage + service untouched."""
+        art_n, art_n1 = _make_artifact(), _make_artifact(scale=1.5)
+        svc = FleetService(
+            art_n, BASE, static=STATIC, max_batch_size=4, n_replicas=2,
+            clock=FakeClock(), max_wait_s=0.001, health=False,
+        )
+        ro = ArtifactRollout(svc)
+        ro.stage(art_n1)
+        for kw in (
+            {"observe_s": 0.0},
+            {"observe_s": -1.0},
+            {"observe_s": 1.0, "budget": 0.0},
+            {"observe_s": 1.0, "budget": -0.5},
+            {"observe_s": 1.0, "budget": 1.5},
+            {"observe_s": 1.0, "latency_slo_s": 0.0},
+        ):
+            with pytest.raises(ValueError):
+                ro.cutover(**kw)
+            assert svc.artifact_hash == art_n.content_hash  # untouched
+        ro.cutover(observe_s=1.0, budget=0.5)  # stage survived refusals
+        assert svc.artifact_hash == art_n1.content_hash
+
+    def test_auto_rollback_without_previous_refuses(self):
+        from bdlz_tpu.serve import RolloutError
+
+        svc = _fleet()
+        ro = ArtifactRollout(svc)
+        with pytest.raises(RolloutError, match="no previous"):
+            ro.auto_rollback("manual")
+
+
+class TestCloseAndShutdown:
+    def test_close_fails_pending_and_inflight_futures(self):
+        """Satellite pin (fake clock): close() fails every pending AND
+        in-flight future with the typed ServiceUnavailable instead of
+        leaving them hanging into interpreter exit."""
+        clock = FakeClock()
+        svc = _fleet(clock=clock)
+        inflight = [svc.submit(t) for t in _thetas(4)]
+        clock.advance(0.01)
+        svc.run_once()                        # dispatched, unresolved
+        pending = [svc.submit(t) for t in _thetas(2, seed=1)]
+        assert svc.in_flight() == 1 and svc.pending() == 2
+        n = svc.close()
+        assert n == 6
+        for f in inflight + pending:
+            with pytest.raises(ServiceUnavailable):
+                f.result(timeout=0)
+        # post-close: typed synchronous refusal, idempotent close
+        with pytest.raises(ServiceUnavailable, match="closed"):
+            svc.submit(_thetas(1)[0])
+        assert svc.close() == 0
+        # the replicas' in-flight slots were released with the gather
+        assert all(r.in_flight == 0 for r in svc.replica_set.replicas)
+
+
+class TestZeroOverheadPin:
+    def test_disabled_schema_and_values_byte_identical(self):
+        """The acceptance pin: with health_enabled off, behavior and
+        the ServeStats schema are byte-identical to the pre-health
+        (PR-8) service — no plane, no extras, the frozen key sets."""
+        thetas = _thetas(24)
+        clock_off = FakeClock()
+        svc_off = _fleet(clock=clock_off, health=False)
+        vals_off, errs = _serve(svc_off, clock_off, thetas)
+        assert not errs
+        assert svc_off.health is None
+        s = svc_off.stats.summary()
+        assert tuple(s.keys()) == PRE_HEALTH_SUMMARY_KEYS
+        rows = svc_off.stats.as_rows()
+        assert all(tuple(r.keys()) == PRE_HEALTH_ROW_KEYS for r in rows)
+        json.dumps(s, allow_nan=False)
+        # same trace with the plane ON (no faults): same bits out
+        clock_on = FakeClock()
+        svc_on = _fleet(clock=clock_on)
+        vals_on, _ = _serve(svc_on, clock_on, thetas)
+        assert np.array_equal(vals_off, vals_on)
+        # the plane's summary rides ONLY the enabled service
+        assert "health" in svc_on.stats.summary()
+        assert "health" not in s
+
+    def test_config_knobs_excluded_from_identity(self):
+        from bdlz_tpu.config import config_identity_dict
+
+        tuned = dataclasses.replace(
+            BASE, health_enabled=True, breaker_window=3,
+            breaker_threshold=0.9, breaker_cooldown_s=7.0,
+            breaker_latency_slo_s=0.2, rollback_budget=0.01,
+        )
+        assert config_identity_dict(tuned) == config_identity_dict(BASE)

@@ -205,6 +205,19 @@ def test_fleet_and_rollout_modules_clean():
     assert not report.active, f"fleet/rollout findings:\n{offenders}"
 
 
+def test_health_plane_module_clean():
+    """The replica health plane (serve/health.py) is pure host-side
+    bookkeeping on the injectable clock — no jax import at all — and
+    the fleet/rollout healing hooks must stay that way: pinned per-file
+    at zero unsuppressed findings alongside the fleet modules above
+    (STATIC_PARAM_NAMES additions: health/health_enabled/
+    breaker_window/breaker_threshold/rollback_budget)."""
+    report = lint_paths([str(PACKAGE / "serve" / "health.py")])
+    assert report.files_scanned == 1
+    offenders = "\n".join(f.render() for f in report.active)
+    assert not report.active, f"health-plane findings:\n{offenders}"
+
+
 def test_seam_split_and_gating_modules_clean():
     """The seam-split plane: multidomain.py is host-side orchestration
     (band scan, sub-builds, bundle IO), grid.py gained the jitted
