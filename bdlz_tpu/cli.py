@@ -303,18 +303,23 @@ def main(argv: Optional[list] = None) -> None:
                          "thermal average of the LZ probability over incident "
                          "chi momenta at T_p (the paper's F(k) layer; "
                          "framework addition).")
-    ap.add_argument("--lz-method", default=None, dest="lz_method",
-                    choices=("coherent", "local", "dephased"),
-                    help="With --maybe-compute-P-from-profile: the LZ "
-                         "estimator (framework addition; same family as the "
-                         "sweep/MCMC CLIs). Default: coherent transfer "
-                         "matrix. Passing the flag (any value) opts into "
-                         "the in-repo kernel, skipping the reference's "
-                         "external-module hook.")
-    ap.add_argument("--lz-gamma-phi", type=float, default=0.0,
-                    dest="lz_gamma_phi",
-                    help="Diabatic-basis dephasing rate for --lz-method "
-                         "dephased (framework addition).")
+    # shared LZ flag helper (lz/options.py): one home for the
+    # --lz-method/--lz-gamma-phi surface across the three drivers — this
+    # CLI's documented divergences are its estimator menu (no sweep-only
+    # local-momentum) and the None default (the hook-eligibility
+    # sentinel; the profile flag stays the reference-shaped
+    # --maybe-compute-P-from-profile above)
+    from bdlz_tpu.lz.options import POINT_METHODS, add_lz_method_flags
+
+    add_lz_method_flags(
+        ap, default=None, choices=POINT_METHODS, include_profile=False,
+        method_help="With --maybe-compute-P-from-profile: the LZ "
+                    "estimator (framework addition; same family as the "
+                    "sweep/MCMC CLIs). Default: coherent transfer "
+                    "matrix. Passing the flag (any value) opts into "
+                    "the in-repo kernel, skipping the reference's "
+                    "external-module hook.",
+    )
     ap.add_argument("--quad", default=None, choices=("on", "off"),
                     help="Override the config's quad_panel_gl knob for this "
                          "point (framework addition): on = snapped-panel "
@@ -342,9 +347,9 @@ def main(argv: Optional[list] = None) -> None:
     if (args.lz_method is not None or args.lz_gamma_phi) and not args.profile_csv:
         ap.error("--lz-method/--lz-gamma-phi require "
                  "--maybe-compute-P-from-profile")
-    from bdlz_tpu.lz.kernel import gamma_phi_cli_error
+    from bdlz_tpu.lz.options import lz_flags_error
 
-    _gerr = gamma_phi_cli_error(args.lz_method or "coherent", args.lz_gamma_phi)
+    _gerr = lz_flags_error(args, default_method="coherent")
     if _gerr:
         ap.error(_gerr)
     if args.write_template:
@@ -364,6 +369,18 @@ def main(argv: Optional[list] = None) -> None:
         cfg = dataclasses.replace(cfg, quad_panel_gl=args.quad == "on")
     backend = args.backend or cfg.backend
     cfg = validate(cfg, backend=backend)
+    if cfg.lz_mode != "two_channel":
+        # the scenario plane (docs/scenarios.md) is a sweep/MCMC/serve
+        # axis; this CLI's P resolution is the reference's two-channel
+        # seam only — running anyway would silently derive P under the
+        # wrong physics (the same "a knob the mode would ignore is a
+        # caller error" rule the other drivers enforce)
+        ap.error(
+            f"lz_mode={cfg.lz_mode!r} in the config: the single-point "
+            "CLI evaluates the two-channel kernel only — use "
+            "sweep_cli/mcmc_cli for the chain/thermal scenarios, or "
+            "drop the scenario keys"
+        )
     if args.sanitize:
         from bdlz_tpu import sanitize
 

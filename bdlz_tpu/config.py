@@ -222,6 +222,28 @@ class Config:
     # depends on it, so the RESOLVED value joins the artifact identity
     # as its own key (emulator.artifact.build_identity).
     posterior_weight: Optional[str] = None
+    # ---- LZ scenario plane (bdlz_tpu/lz/chain.py, lz/thermal.py;
+    # docs/scenarios.md).  The physics scenario the per-point conversion
+    # probability is derived under when a bounce profile is supplied:
+    #   "two_channel" — the legacy chi/B two-level kernel (lz_method /
+    #                   lz_gamma_phi select the estimator, as before);
+    #   "chain"       — N-level banded LZ chain (arXiv:1212.2907,
+    #                   multi-species dark sectors; lz_n_levels sets N,
+    #                   N=2 reduces to the coherent two-channel kernel);
+    #   "thermal"     — finite-temperature oscillator-bath dephasing
+    #                   (arXiv:1410.0516): Gamma_phi is DERIVED as
+    #                   Gamma(T_p, eta, omega_c) instead of being a free
+    #                   knob; eta -> 0 or T -> 0 recovers the coherent
+    #                   kernel bitwise.
+    # Identity rule: the resolved scenario joins sweep/artifact
+    # identities as its own "lz_scenario" key (omit-at-default, single
+    # home — parallel.sweep.engine_identity_extra and
+    # emulator.artifact.build_identity), so these fields are EXCLUDED
+    # from the shared config payload (SCENARIO_CONFIG_FIELDS below).
+    lz_mode: str = "two_channel"
+    lz_n_levels: int = 2
+    lz_bath_eta: float = 0.0
+    lz_bath_omega_c: float = 0.0
 
 
 def default_config() -> Dict[str, Any]:
@@ -329,6 +351,23 @@ EMULATOR_CONFIG_FIELDS = ("seam_split", "error_gate_tol", "posterior_weight")
 #: Valid values of the ``posterior_weight`` knob (None = off).
 VALID_POSTERIOR_WEIGHTS = ("planck",)
 
+#: Valid LZ scenario modes (docs/scenarios.md).
+VALID_LZ_MODES = ("two_channel", "chain", "thermal")
+
+#: LZ scenario knobs, excluded from the shared config identity payload
+#: deliberately (pinned in tests/test_scenarios.py): like
+#: ``posterior_weight``, the resolved scenario has ONE identity home —
+#: the ``lz_scenario`` key that ``parallel.sweep.engine_identity_extra``
+#: folds into sweep manifest/chunk identities and
+#: ``emulator.artifact.build_identity`` stamps on artifacts
+#: (omit-at-default, so every pre-existing two-channel hash is
+#: untouched).  Folding them into the config payload too would stale
+#: MCMC checkpoints and refcache entries the scenario cannot affect
+#: (the per-point P already enters those through the grid bytes).
+SCENARIO_CONFIG_FIELDS = (
+    "lz_mode", "lz_n_levels", "lz_bath_eta", "lz_bath_omega_c",
+)
+
 
 def config_identity_dict(cfg: Config) -> Dict[str, Any]:
     """The config as a resume-identity payload.
@@ -351,6 +390,7 @@ def config_identity_dict(cfg: Config) -> Dict[str, Any]:
             or k in SERVE_CONFIG_FIELDS
             or k in CACHE_CONFIG_FIELDS
             or k in EMULATOR_CONFIG_FIELDS
+            or k in SCENARIO_CONFIG_FIELDS
         ):
             continue
         if k in RESULT_AFFECTING_EXTENSIONS or getattr(cfg, k) != defaults[k]:
@@ -486,6 +526,40 @@ def validate(cfg: Config, backend: Optional[str] = None) -> Config:
             f"cache_root must be a directory path or null, got "
             f"{cfg.cache_root!r}"
         )
+    # LZ scenario plane (docs/scenarios.md): the same "a knob the mode
+    # would silently ignore is a caller error" rule as gamma_phi.
+    if cfg.lz_mode not in VALID_LZ_MODES:
+        raise ConfigError(
+            f"lz_mode={cfg.lz_mode!r} is not one of {VALID_LZ_MODES}"
+        )
+    if not (isinstance(cfg.lz_n_levels, int) and cfg.lz_n_levels >= 2):
+        raise ConfigError(
+            f"lz_n_levels must be an integer >= 2, got {cfg.lz_n_levels!r}"
+        )
+    if cfg.lz_n_levels != 2 and cfg.lz_mode != "chain":
+        raise ConfigError(
+            f"lz_n_levels={cfg.lz_n_levels} has no effect with "
+            f"lz_mode={cfg.lz_mode!r} (it parameterizes the N-level chain)"
+        )
+    if cfg.lz_bath_eta < 0.0 or cfg.lz_bath_omega_c < 0.0:
+        raise ConfigError(
+            "lz_bath_eta and lz_bath_omega_c must be >= 0 (Ohmic bath "
+            "coupling and cutoff)"
+        )
+    if (cfg.lz_bath_eta or cfg.lz_bath_omega_c) and cfg.lz_mode != "thermal":
+        raise ConfigError(
+            f"lz_bath_eta/lz_bath_omega_c have no effect with "
+            f"lz_mode={cfg.lz_mode!r} (they parameterize the thermal bath)"
+        )
+    if cfg.lz_mode == "thermal" and cfg.lz_bath_eta > 0.0 and (
+        not cfg.lz_bath_omega_c > 0.0
+    ):
+        raise ConfigError(
+            "lz_mode='thermal' with lz_bath_eta > 0 needs a positive "
+            "lz_bath_omega_c cutoff (Gamma_phi = 2 eta T (1 - e^(-omega_c/T)) "
+            "is identically 0 without one — set lz_bath_eta: 0 for the "
+            "coherent limit instead)"
+        )
     return cfg
 
 
@@ -540,6 +614,16 @@ class StaticChoices(NamedTuple):
     # result identity (ROBUSTNESS_STATIC_FIELDS).
     retry_enabled: Optional[bool] = None
     fault_injection: Optional[bool] = None
+    # LZ scenario plane (see Config.lz_mode): trace-static — the mode
+    # selects which propagation kernel derives P, n_levels fixes array
+    # shapes.  Excluded from the positional static identity payload
+    # (SCENARIO_STATIC_FIELDS): the resolved scenario's single identity
+    # home is the omit-at-default "lz_scenario" key (docs/scenarios.md),
+    # which keeps every pre-existing two-channel hash byte-stable.
+    lz_mode: str = "two_channel"
+    lz_n_levels: int = 2
+    lz_bath_eta: float = 0.0
+    lz_bath_omega_c: float = 0.0
 
 
 #: StaticChoices fields that must NOT enter result identities (emulator
@@ -548,6 +632,14 @@ class StaticChoices(NamedTuple):
 #: bit, and folding it in would gratuitously invalidate every
 #: pre-existing artifact.
 ROBUSTNESS_STATIC_FIELDS = ("retry_enabled", "fault_injection")
+
+#: StaticChoices twins of SCENARIO_CONFIG_FIELDS, excluded from the
+#: positional static payload for the same single-home reason (the
+#: scenario key carries them; appending their values to the positional
+#: list would churn every legacy refcache/artifact/chunk hash).
+SCENARIO_STATIC_FIELDS = (
+    "lz_mode", "lz_n_levels", "lz_bath_eta", "lz_bath_omega_c",
+)
 
 
 def resolve_Y_chi_init(cfg: Config) -> float:
@@ -606,4 +698,8 @@ def static_choices_from_config(cfg: Config) -> StaticChoices:
         quad_panel_gl=cfg.quad_panel_gl,
         retry_enabled=cfg.retry_enabled,
         fault_injection=cfg.fault_injection,
+        lz_mode=cfg.lz_mode,
+        lz_n_levels=int(cfg.lz_n_levels),
+        lz_bath_eta=float(cfg.lz_bath_eta),
+        lz_bath_omega_c=float(cfg.lz_bath_omega_c),
     )

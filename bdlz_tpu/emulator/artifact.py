@@ -112,6 +112,7 @@ class EmulatorArtifact(NamedTuple):
 def build_identity(
     base, static, n_y: int, impl: str,
     posterior_weight: "str | None" = None,
+    lz_profile_fp: "str | None" = None,
 ) -> Dict[str, Any]:
     """The physics identity an artifact is valid for.
 
@@ -140,21 +141,39 @@ def build_identity(
     either (``check_identity``'s wildcard rule).  The knob is excluded
     from the config payload (``config.EMULATOR_CONFIG_FIELDS``), so
     this key is its single home too.
+
+    The LZ scenario plane (docs/scenarios.md) joins the same way: a
+    chain/thermal surface carries its resolved scenario as its own
+    ``lz_scenario`` key (mode + parameters; omit-at-default, single
+    home — ``config.SCENARIO_*_FIELDS`` exclude the knobs everywhere
+    else) and is STRICT both ways in ``check_identity`` — cross-mode
+    artifact/consumer skew must reject loudly.  ``lz_profile_fp``
+    (the bounce-profile fingerprint the per-point P was derived from)
+    is its own ``lz_profile`` key with the posterior_weight wildcard
+    rule: strict when the caller states a profile, wildcard when not.
     """
-    from bdlz_tpu.config import ROBUSTNESS_STATIC_FIELDS, config_identity_dict
+    from bdlz_tpu.config import (
+        ROBUSTNESS_STATIC_FIELDS,
+        SCENARIO_STATIC_FIELDS,
+        config_identity_dict,
+    )
+    from bdlz_tpu.lz.sweep_bridge import scenario_identity
 
     quad = static.quad_panel_gl
     st = static._replace(quad_panel_gl=None)
     if posterior_weight is None:
         posterior_weight = getattr(base, "posterior_weight", None)
+    excluded = set(ROBUSTNESS_STATIC_FIELDS) | set(SCENARIO_STATIC_FIELDS)
     out = {
         "base": config_identity_dict(base),
         # robustness knobs (retry/fault gates) are orchestration-only
         # and excluded: with faults off they cannot change a value bit,
-        # and keying them in would stale every pre-existing artifact
+        # and keying them in would stale every pre-existing artifact.
+        # The scenario knobs are excluded from the POSITIONAL list too —
+        # their single home is the lz_scenario key below, which keeps
+        # every pre-scenario artifact hash byte-stable.
         "static": [
-            v for f, v in zip(type(st)._fields, st)
-            if f not in ROBUSTNESS_STATIC_FIELDS
+            v for f, v in zip(type(st)._fields, st) if f not in excluded
         ],
         "n_y": int(n_y),
         "impl": str(impl),
@@ -163,6 +182,11 @@ def build_identity(
         out["quad_panel_gl"] = bool(quad)
     if posterior_weight is not None:
         out["posterior_weight"] = str(posterior_weight)
+    scen = scenario_identity(static)
+    if scen is not None:
+        out["lz_scenario"] = scen
+    if lz_profile_fp is not None:
+        out["lz_profile"] = str(lz_profile_fp)
     return out
 
 
@@ -445,7 +469,13 @@ def check_identity(
     serve/likelihood layers do.  The ``posterior_weight`` key follows
     the same rule: strict when the caller names a weighting, wildcard
     when their knob is unset (weighting moves nodes, never what the
-    exact engine computes at them — the fallback path is unaffected).
+    exact engine computes at them — the fallback path is unaffected),
+    and ``lz_profile`` (the scenario bounce-profile fingerprint) too.
+    The ``lz_scenario`` key is deliberately STRICT both ways: a chain
+    or thermal surface served to a two-channel consumer (or vice
+    versa) is cross-mode skew and must reject loudly — there is no
+    "adopt the artifact's physics scenario" story the way there is for
+    a quadrature scheme.
     """
     stored = dict(artifact.identity)
     want = dict(expect)
@@ -453,6 +483,8 @@ def check_identity(
         stored.pop("quad_panel_gl", None)
     if "posterior_weight" not in want:
         stored.pop("posterior_weight", None)
+    if "lz_profile" not in want:
+        stored.pop("lz_profile", None)
     sb = dict(stored.get("base", {}))
     wb = dict(want.get("base", {}))
     for key in set(exempt_config_keys) | set(artifact.axis_names):

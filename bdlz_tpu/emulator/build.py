@@ -133,7 +133,7 @@ def _draw_probes(
 def _exact_fields(
     base, axes: Mapping[str, np.ndarray], static, *, product: bool,
     mesh, chunk_size: int, n_y: int, impl: str,
-    fault_plan=None, retry=None, cache=None,
+    fault_plan=None, retry=None, cache=None, lz_profile=None,
 ) -> Tuple[Dict[str, np.ndarray], int]:
     """Exact pipeline over a product grid via the production sweep engine.
 
@@ -153,6 +153,7 @@ def _exact_fields(
         base, dict(axes), static, mesh=mesh, chunk_size=chunk_size,
         n_y=n_y, out_dir=None, keep_outputs=True, impl=impl,
         fault_plan=fault_plan, retry=retry, cache=cache,
+        lz_profile=lz_profile,
     )
     n_pts = res.n_points
     if res.n_failed:
@@ -173,6 +174,7 @@ def _exact_fields(
 def make_exact_evaluator(
     base, static, *, n_y: int, impl: str, mesh=None, chunk_size: int = 2048,
     retry=None, fault_plan=None, quarantine_sink=None, cache=None,
+    lz_profile=None,
 ):
     """Zipped exact-pipeline evaluator through the production engine.
 
@@ -225,6 +227,22 @@ def make_exact_evaluator(
     interpret = impl == "pallas" and jax.devices()[0].platform == "cpu"
     fields = YieldsResult._fields
 
+    # LZ scenario plane (docs/scenarios.md): a chain/thermal mode in the
+    # static derives each evaluated point's P from the bounce profile —
+    # required up-front so a scenario service/build cannot be
+    # constructed without the physics it needs to answer exactly.
+    lz_mode = getattr(static, "lz_mode", "two_channel")
+    if lz_mode != "two_channel":
+        if lz_profile is None:
+            raise ValueError(
+                f"lz_mode={lz_mode!r} derives P per point from a bounce "
+                "profile; pass lz_profile to the exact evaluator"
+            )
+        from bdlz_tpu.lz.profile import load_profile_csv
+
+        if isinstance(lz_profile, str):
+            lz_profile = load_profile_csv(lz_profile)
+
     # lazy engine: a fully cache-hit evaluate() pays no table build and
     # no compile — most of the warm-rebuild win for probe rounds
     _engine: Dict[str, Any] = {}
@@ -263,7 +281,26 @@ def make_exact_evaluator(
     calls = [0]  # the probe-fault key: one count per chunk dispatch
 
     def evaluate(axes: Mapping[str, Any]) -> Dict[str, np.ndarray]:
-        pp = build_grid(base, dict(axes), product=False)
+        # scenario configs may leave P_chi_to_B unset (the natural way
+        # to use a profile-derived P) — placeholder, overwritten below
+        pp = build_grid(
+            base, dict(axes),
+            P_base=0.0 if lz_mode != "two_channel" else None,
+            product=False,
+        )
+        if lz_mode != "two_channel":
+            # scenario P per point, BEFORE the chunk loop: the derived P
+            # joins the PointParams slice bytes, so chunk-cache keys and
+            # the step inputs see exactly what run_sweep's scenario path
+            # would have fed them
+            from bdlz_tpu.lz.sweep_bridge import (
+                scenario_probabilities_for_points,
+            )
+
+            pp = pp._replace(P=scenario_probabilities_for_points(
+                lz_profile, static, np.asarray(pp.v_w),
+                T_p_GeV=np.asarray(pp.T_p_GeV),
+            ))
         n = int(np.asarray(pp.m_chi_GeV).shape[0])
         chunk = min(int(chunk_size), n) if chunk_size else n
         out: Dict[str, List[np.ndarray]] = {f: [] for f in fields}
@@ -606,6 +643,7 @@ def build_emulator(
     cache=None,
     seam_split: Optional[bool] = None,
     posterior_weight: Optional[str] = None,
+    lz_profile=None,
 ) -> Tuple[EmulatorArtifact, BuildReport]:
     """Build (and optionally save) an error-controlled yield-surface emulator.
 
@@ -675,6 +713,36 @@ def build_emulator(
             f"posterior_weight={pw!r} is not one of "
             f"{VALID_POSTERIOR_WEIGHTS} (or None)"
         )
+    # LZ scenario plane (docs/scenarios.md): a chain/thermal mode builds
+    # the surface over profile-derived per-point P, so the profile is
+    # required — and a profile without a scenario mode would silently
+    # change nothing (the two-channel emulator evaluates P from the
+    # config/axes), which is a caller error, not a no-op.
+    lz_mode = getattr(static, "lz_mode", "two_channel")
+    lz_fp = None
+    if lz_mode != "two_channel":
+        if lz_profile is None:
+            raise EmulatorBuildError(
+                f"lz_mode={lz_mode!r} derives P per point from a bounce "
+                "profile; pass lz_profile to build_emulator"
+            )
+        from bdlz_tpu.lz.profile import load_profile_csv
+        from bdlz_tpu.lz.sweep_bridge import profile_fingerprint
+
+        if isinstance(lz_profile, str):
+            lz_profile = load_profile_csv(lz_profile)
+        lz_fp = profile_fingerprint(lz_profile)
+        if "P_chi_to_B" in spec:
+            raise EmulatorBuildError(
+                "P_chi_to_B cannot be an emulator axis when the scenario "
+                "derives P per point; use v_w (and T_p_GeV for thermal)"
+            )
+    elif lz_profile is not None:
+        raise EmulatorBuildError(
+            "lz_profile requires a scenario lz_mode ('chain'/'thermal') "
+            "in the config/static — the two-channel emulator takes P from "
+            "the config or a P_chi_to_B axis"
+        )
 
     # --- seam-split resolution (tri-state; emulator/multidomain.py) ---
     from bdlz_tpu.emulator.multidomain import (
@@ -694,6 +762,7 @@ def build_emulator(
             impl=impl, chunk_size=chunk_size, mesh=mesh,
             require_converged=require_converged, fault_plan=fault_plan,
             retry=retry, cache=cache, posterior_weight=pw,
+            lz_profile=lz_profile,
         )
     # Engine resolution mirrors run_sweep, and is done HERE (once) so the
     # product population, the probe evaluations, and the artifact identity
@@ -758,6 +827,7 @@ def build_emulator(
         base, {k: a for k, a in zip(axis_names, nodes)}, static,
         product=True, mesh=mesh, chunk_size=chunk_size, n_y=n_y, impl=impl,
         fault_plan=faults, retry=retry_policy, cache=store,
+        lz_profile=lz_profile,
     )
     values = {f: np.asarray(flat[f]).reshape(grid_shape()) for f in FIELDS}
     _check_positive(values)
@@ -771,6 +841,7 @@ def build_emulator(
         chunk_size=min(int(chunk_size), int(n_probe)),
         retry=retry_policy, fault_plan=faults,
         quarantine_sink=qsink.append, cache=store,
+        lz_profile=lz_profile,
     )
     n_quarantined_probes = 0
 
@@ -941,6 +1012,7 @@ def build_emulator(
                 base, axes_eval, static, product=True, mesh=mesh,
                 chunk_size=chunk_size, n_y=n_y, impl=impl,
                 fault_plan=faults, retry=retry_policy, cache=store,
+                lz_profile=lz_profile,
             )
             n_exact += n_new
             slab_shape = tuple(
@@ -1040,7 +1112,10 @@ def build_emulator(
         axis_nodes=tuple(nodes),
         axis_scales=tuple(scales),
         values=values,
-        identity=build_identity(base, static, n_y, impl, posterior_weight=pw),
+        identity=build_identity(
+            base, static, n_y, impl, posterior_weight=pw,
+            lz_profile_fp=lz_fp,
+        ),
         manifest=manifest,
         predicted_error=predicted,
     )

@@ -123,6 +123,17 @@ STATIC_PARAM_NAMES = {
     "breaker_window",
     "breaker_threshold",
     "rollback_budget",
+    # LZ scenario-plane knobs (lz/chain.py, lz/thermal.py,
+    # docs/scenarios.md): the mode string selects which propagation
+    # kernel derives P at the host seam, n_levels fixes the chain's
+    # array shapes at trace time, and the bath parameters enter the
+    # host-side rate Γ_φ(T, η, ω_c) before any tracer exists.  Same
+    # specific-names-only rule as above.
+    "lz_mode",
+    "lz_n_levels",
+    "lz_bath_eta",
+    "lz_bath_omega_c",
+    "n_levels",
     "n_y",
     "nz",
     "n_mu",

@@ -30,6 +30,25 @@ from bdlz_tpu.lz.profile import (  # noqa: F401
     load_profile_csv,
 )
 from bdlz_tpu.lz.sweep_bridge import (  # noqa: F401
+    PTableN,
+    eval_P_table_n,
+    make_P_table_n,
     probabilities_for_points,
     profile_fingerprint,
+    scenario_identity,
+    scenario_probabilities_for_points,
+)
+
+# LZ scenario plane (docs/scenarios.md): the N-level chain and the
+# finite-T thermal-bath kernels as first-class modes.
+from bdlz_tpu.lz.chain import (  # noqa: F401
+    chain_conversion_probability,
+    chain_populations,
+    chain_populations_for_speeds,
+    chain_probabilities_for_points,
+)
+from bdlz_tpu.lz.thermal import (  # noqa: F401
+    thermal_gamma_phi,
+    thermal_probabilities_for_points,
+    thermal_probability,
 )

@@ -1,0 +1,244 @@
+"""N-level banded Landau–Zener chain kernel (arXiv:1212.2907).
+
+The two-channel kernel (:mod:`bdlz_tpu.lz.kernel`) propagates one χ/B
+crossing; multi-species dark sectors need the N-level generalization: a
+*band* of N diabatic levels spanning the two-channel splitting with
+nearest-neighbor coupling — the natural chain model of multi-species LZ
+crossings (arXiv:1212.2907).  Construction, pinned to reduce exactly to
+the two-channel Hamiltonian at N = 2:
+
+* diagonal: ``d_k(ξ) = c_k · Δ(ξ)/2`` with ``c_k = 1 − 2k/(N−1)`` —
+  N equally spaced levels from +Δ/2 (level 0, the incident χ) down to
+  −Δ/2 (level N−1, the B channel), traceless by symmetry;
+* off-diagonal: nearest-neighbor coupling ``m_mix(ξ)`` (the profile's
+  mixing column), zero beyond the first off-diagonal.
+
+Where Δ changes sign the whole band pinches through zero — a *banded
+crossing*: every adjacent pair crosses there, and the chain transport
+distributes the incident χ amplitude over all N species.
+
+Propagation stays **all-real f64** (the axon TPU rejects complex128,
+same constraint as the SU(2) quaternion path): for the real symmetric
+midpoint Hamiltonian H of each segment, ``U = exp(−i H τ) = C − i S``
+with ``C = cos(Hτ)``, ``S = sin(Hτ)`` from one batched ``eigh`` — the
+eigendecomposition is SPEED-INDEPENDENT (τ = dξ/v only enters the
+phases), so the momentum/table layers can vmap over thousands of
+traversal speeds without re-diagonalizing.  Complex amplitudes ride the
+standard real embedding ``M = [[C, S], [−S, C]] ∈ R^{2N×2N}``; segment
+propagators compose with the same log-depth pairwise tree as the
+two-channel kernels (:func:`bdlz_tpu.lz.kernel._ordered_tree_product`),
+so the three propagators cannot structurally diverge.
+
+Per-species asymptotic populations: ``P_k = |⟨k| U_total |0⟩|²``.  The
+pipeline's scalar conversion probability is the band-traversing channel
+``P_{χ→B} = P_{N−1}`` (at N = 2 exactly the two-channel coherent P,
+pinned to ≤1e-12 rel in tests); the full vector feeds the N-aware
+P-table layout (:class:`bdlz_tpu.lz.sweep_bridge.PTableN`) for
+multi-species yields.
+"""
+from __future__ import annotations
+
+from typing import Tuple, Union
+
+import numpy as np  # host-side use only; jitted paths go through the backend.py xp seam (bdlz-lint R1 audit)
+
+from bdlz_tpu.lz.kernel import _ordered_tree_product
+from bdlz_tpu.lz.profile import BounceProfile, load_profile_csv
+
+
+def validate_n_levels(n_levels: int) -> int:
+    """Host-boundary contract shared by every chain seam."""
+    n = int(n_levels)
+    if n < 2:
+        raise ValueError(f"lz_n_levels must be >= 2, got {n_levels!r}")
+    return n
+
+
+def chain_level_weights(n_levels: int) -> np.ndarray:
+    """``c_k = 1 − 2k/(N−1)``: the banded diagonal weights (host-side).
+
+    Symmetric around zero (traceless band) and exactly ``(+1, −1)`` at
+    N = 2 — the two-channel diag(Δ/2, −Δ/2)."""
+    n = validate_n_levels(n_levels)
+    return 1.0 - 2.0 * np.arange(n, dtype=np.float64) / (n - 1)
+
+
+def _chain_hamiltonians(
+    profile: BounceProfile, n_levels: int, xp
+) -> Tuple[object, object]:
+    """Midpoint N×N Hamiltonians per segment and segment widths.
+
+    Same exponential-midpoint segmentation as the two-channel
+    ``_segment_hamiltonians`` (the N = 2 reduction must share the
+    discretization, not just the model): H has diag ``c_k·Δ_mid/2`` and
+    nearest-neighbor coupling ``mix_mid``.  Returns ``(H, dxi)`` with
+    ``H`` shaped ``(n_segments, N, N)``.
+    """
+    n = validate_n_levels(n_levels)
+    xi = xp.asarray(profile.xi, dtype=xp.float64)
+    delta = xp.asarray(profile.delta, dtype=xp.float64)
+    mix = xp.asarray(profile.mix, dtype=xp.float64)
+    dxi = xi[1:] - xi[:-1]
+    half_delta_mid = 0.25 * (delta[1:] + delta[:-1])    # Δ_mid / 2
+    mix_mid = 0.5 * (mix[1:] + mix[:-1])
+    c = xp.asarray(chain_level_weights(n))              # (N,)
+    diag = half_delta_mid[:, None] * c[None, :]         # (S, N)
+    off = xp.asarray(np.eye(n, k=1) + np.eye(n, k=-1))  # (N, N) adjacency
+    H = (
+        diag[:, :, None] * xp.asarray(np.eye(n))[None]
+        + mix_mid[:, None, None] * off[None]
+    )
+    return H, dxi
+
+
+def propagate_chain(H, dxi, v, xp):
+    """Final per-species populations from ψ₀ = |0⟩, traced.
+
+    The vmappable core: pure xp ops over the per-segment ``(S, N, N)``
+    Hamiltonian stack with traversal speed ``v`` (may be a traced scalar
+    — the table builders vmap over it).  Each segment's
+    ``U = exp(−i H τ)`` is assembled from the (speed-independent)
+    eigendecomposition as the real embedding ``[[C, S], [−S, C]]`` and
+    the ordered product is taken with the shared log-depth pairwise
+    tree.  Returns the ``(N,)`` population vector ``P_k = x_k² + y_k²``
+    (unitary by construction: Σ P_k = 1 to roundoff, pinned).
+    """
+    n = H.shape[-1]
+    tau = dxi / xp.maximum(v, 1e-12)
+    # speed-independent diagonalization: H = V diag(w) V^T per segment
+    w, V = xp.linalg.eigh(H)                       # (S, N), (S, N, N)
+    phase = w * tau[:, None]                       # (S, N)
+    # C = V diag(cos φ) V^T, S = V diag(sin φ) V^T — two batched matmuls
+    C = xp.matmul(V * xp.cos(phase)[:, None, :], xp.swapaxes(V, -1, -2))
+    S = xp.matmul(V * xp.sin(phase)[:, None, :], xp.swapaxes(V, -1, -2))
+    top = xp.concatenate([C, S], axis=-1)          # (S, N, 2N)
+    bot = xp.concatenate([-S, C], axis=-1)
+    M = xp.concatenate([top, bot], axis=-2)        # (S, 2N, 2N)
+    M_total = _ordered_tree_product(
+        M, lambda m1, m2: xp.matmul(m1, m2), np.eye(2 * n), xp
+    )
+    x = M_total[:n, 0]                             # Re ψ (ψ₀ = e_0 real)
+    y = M_total[n:, 0]                             # Im ψ
+    return x * x + y * y
+
+
+def chain_populations(
+    profile: Union[str, BounceProfile], v_w: float, n_levels: int
+) -> np.ndarray:
+    """Per-species asymptotic populations ``(N,)`` at one wall speed
+    (host seam; the chain analog of ``transfer_matrix_propagation``)."""
+    validate_n_levels(n_levels)
+    if isinstance(profile, str):
+        profile = load_profile_csv(profile)
+    # jax_numpy() probes the accelerator relay before the first backend
+    # touch — a direct jax import here would hang forever on a dead
+    # relay (documented environment failure mode)
+    from bdlz_tpu.backend import jax_numpy
+
+    jnp = jax_numpy()
+
+    H, dxi = _chain_hamiltonians(profile, n_levels, jnp)
+    v = jnp.asarray(max(float(v_w), 1e-12))
+    P = np.asarray(propagate_chain(H, dxi, v, jnp))
+    return np.clip(P, 0.0, 1.0)
+
+
+def chain_conversion_probability(
+    profile: Union[str, BounceProfile], v_w: float, n_levels: int
+) -> float:
+    """``P_{χ→B} = P_{N−1}``: the band-traversing conversion channel."""
+    return float(chain_populations(profile, v_w, n_levels)[-1])
+
+
+def chain_populations_for_speeds(
+    profile: Union[str, BounceProfile],
+    v_w,
+    n_levels: int,
+    speed_chunk_bytes: "int | None" = None,
+) -> np.ndarray:
+    """Populations ``(n_points, N)`` for many wall speeds, chunk-jitted.
+
+    The chain twin of the coherent branch of
+    ``sweep_bridge.probabilities_for_points``: work is done per *unique*
+    speed and scattered back, the per-chunk program is jitted once
+    (short tail chunks padded with the last speed — one compile), and
+    the chunk size follows the chain's own memory model: the tree
+    product stages ``(padded_segments, 2N, 2N)`` f64 embeddings PER
+    SPEED, so the leaf budget divides by ``padded·8·(2N)²`` where the
+    two-channel quaternion path divides by ``padded·8·4``.
+    """
+    import os
+
+    n = validate_n_levels(n_levels)
+    if isinstance(profile, str):
+        profile = load_profile_csv(profile)
+    v_w = np.asarray(v_w, dtype=np.float64)
+    if v_w.size == 0:
+        return np.zeros((0, n))
+    from bdlz_tpu.backend import jax_numpy
+
+    jnp = jax_numpy()
+    import jax
+
+    H, dxi = _chain_hamiltonians(profile, n, jnp)
+    uniq, inverse = np.unique(v_w, return_inverse=True)
+    speeds = jnp.clip(jnp.asarray(uniq), 1e-6, 1.0 - 1e-12)
+    n_seg = int(np.asarray(dxi).shape[0])
+    padded = 1 << max(n_seg - 1, 1).bit_length()
+    per_speed = padded * 8 * (2 * n) ** 2
+    budget = (
+        int(os.environ.get("BDLZ_LZ_SPEED_CHUNK_BYTES", 1 << 30))
+        if speed_chunk_bytes is None else int(speed_chunk_bytes)
+    )
+    chunk = max(1, min(len(uniq), budget // max(per_speed, 1)))
+    run_chunk = jax.jit(
+        jax.vmap(lambda sp: propagate_chain(H, dxi, sp, jnp))
+    )
+    nu = len(uniq)
+    P_uniq = np.empty((nu, n))
+    for lo in range(0, nu, chunk):
+        hi = min(lo + chunk, nu)
+        sp = speeds[lo:hi]
+        if hi - lo < chunk:
+            sp = jnp.concatenate(
+                [sp, jnp.broadcast_to(speeds[-1], (chunk - (hi - lo),))]
+            )
+        P_uniq[lo:hi] = np.asarray(run_chunk(sp))[: hi - lo]
+    return np.clip(P_uniq, 0.0, 1.0)[inverse]
+
+
+def chain_probabilities_for_points(
+    profile: Union[str, BounceProfile], v_w, n_levels: int
+) -> np.ndarray:
+    """``P_{χ→B}`` per sweep point: the last (band-traversing) column of
+    :func:`chain_populations_for_speeds` — the scalar the yields
+    pipeline consumes as ``P_chi_to_B``."""
+    return chain_populations_for_speeds(profile, v_w, n_levels)[:, -1]
+
+
+def uniform_chain_populations_analytic(
+    n_levels: int, coupling: float, length: float, v: float
+) -> np.ndarray:
+    """Closed-form populations for the flat band (Δ ≡ 0, constant mix).
+
+    With Δ ≡ 0 the chain Hamiltonian is ``m·A`` with ``A`` the path-graph
+    adjacency matrix, whose spectrum is analytic: eigenvalues
+    ``λ_j = 2m·cos(jπ/(N+1))`` with eigenvectors
+    ``φ_j(k) = √(2/(N+1))·sin(jπ(k+1)/(N+1))``.  The propagator over
+    traversal time ``t = L/v`` is then exactly
+
+        U_{k0} = Σ_j φ_j(k) φ_j(0) e^{−i λ_j t},   P_k = |U_{k0}|².
+
+    This is the known-N-level reference check the chain validation gate
+    pins the kernel against (the midpoint segmentation is EXACT for a
+    constant Hamiltonian, so agreement is to roundoff)."""
+    n = validate_n_levels(n_levels)
+    t = float(length) / max(float(v), 1e-12)
+    j = np.arange(1, n + 1, dtype=np.float64)
+    lam = 2.0 * float(coupling) * np.cos(j * np.pi / (n + 1))
+    k = np.arange(n, dtype=np.float64)
+    phi = np.sqrt(2.0 / (n + 1)) * np.sin(
+        np.pi * np.outer(j, k + 1.0) / (n + 1)
+    )                                                   # (j, k)
+    amp = (phi * phi[:, :1] * np.exp(-1j * lam * t)[:, None]).sum(axis=0)
+    return np.abs(amp) ** 2

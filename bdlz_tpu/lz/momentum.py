@@ -149,6 +149,15 @@ def momentum_averaged_probability(
     from bdlz_tpu.lz.kernel import validate_gamma_phi
 
     validate_gamma_phi(gamma_phi, method)
+    if method == "dephased":
+        # Γ = 0 IS the coherent kernel: route it through the quaternion
+        # path itself (the shared lz.thermal.thermal_method_for rule) so
+        # the dephased average at zero rate reduces to the coherent one
+        # BITWISE, not to a ~1e-15 SO(3)-Bloch neighbor (pinned in
+        # tests/test_lz.py)
+        from bdlz_tpu.lz.thermal import thermal_method_for
+
+        method, gamma_phi = thermal_method_for(gamma_phi)
     # relay-probed backend import: a direct jax import hangs forever on a
     # dead accelerator relay (documented environment failure mode)
     from bdlz_tpu.backend import jax_numpy
@@ -259,6 +268,11 @@ def local_momentum_average_batch(
     if isinstance(profile, str):
         profile = load_profile_csv(profile)
     v_ws = np.clip(np.asarray(v_ws, dtype=np.float64), 1e-6, 1.0 - 1e-12)
+    if v_ws.size == 0:
+        # empty speed window: nothing to average — the sweep layer's
+        # all-points-filtered case must get an empty result, not a
+        # max()-over-no-grids crash (pinned in tests/test_lz.py)
+        return np.zeros(0)
     T = max(float(T_GeV), 1e-30)
     m = max(float(m_GeV), 0.0)
     lam1 = lambda_eff_from_profile(profile, v_w=1.0)

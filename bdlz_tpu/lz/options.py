@@ -1,0 +1,153 @@
+"""Shared LZ command-line options for the three drivers.
+
+The ``--lz-method``/``--lz-gamma-phi`` argparse blocks and their
+``gamma_phi_cli_error`` wiring were triplicated across ``cli.py``,
+``sweep_cli.py``, and ``mcmc_cli.py`` — and had already drifted (the
+single-point CLI defaults to the coherent kernel, the sweep/MCMC
+drivers to the analytic local composition).  This module is the one
+home: each CLI declares only its *documented* divergences (its default
+estimator and method menu) and everything else — flag names, dests,
+help text, the Γ-pairing validation, and the scenario-plane flags
+(``--lz-mode``/``--lz-n-levels``/``--lz-bath-eta``/``--lz-bath-omega-c``)
+— cannot drift again.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+#: The single-point CLI's estimator menu (no sweep-only local-momentum).
+POINT_METHODS = ("coherent", "local", "dephased")
+#: The sweep/MCMC drivers' menu.
+SWEEP_METHODS = ("local", "coherent", "local-momentum", "dephased")
+
+
+def add_lz_method_flags(
+    ap,
+    *,
+    default: Optional[str],
+    choices: Sequence[str],
+    method_help: str,
+    include_profile: bool = True,
+    profile_help: str = (
+        "Bounce-profile CSV: derive each point's P_chi_to_B from its own "
+        "wall speed through the LZ kernel"
+    ),
+) -> None:
+    """Register ``[--lz-profile] --lz-method --lz-gamma-phi``.
+
+    ``default`` stays per-CLI (None is the single-point CLI's
+    hook-eligibility sentinel; the sweep/MCMC drivers pin "local") —
+    the documented divergence this helper preserves while deduping
+    everything else.
+    """
+    if include_profile:
+        ap.add_argument("--lz-profile", default=None, dest="lz_profile",
+                        help=profile_help)
+    ap.add_argument("--lz-method", default=default, dest="lz_method",
+                    choices=tuple(choices), help=method_help)
+    ap.add_argument("--lz-gamma-phi", type=float, default=0.0,
+                    dest="lz_gamma_phi",
+                    help="Diabatic-basis dephasing rate for --lz-method "
+                         "dephased (energy units of the profile's Delta)")
+
+
+def add_lz_scenario_flags(ap) -> None:
+    """Register the scenario-plane flags (docs/scenarios.md).
+
+    Each defaults to None = "keep the config key", so reference-shaped
+    invocations are untouched and an explicit flag overrides the config
+    (the --quad pattern).
+    """
+    ap.add_argument("--lz-mode", default=None, dest="lz_mode",
+                    choices=("two_channel", "chain", "thermal"),
+                    help="LZ physics scenario with --lz-profile: "
+                         "two_channel (the legacy chi/B kernel; "
+                         "--lz-method picks the estimator), chain "
+                         "(N-level banded LZ chain, arXiv:1212.2907 — "
+                         "multi-species dark sectors), thermal "
+                         "(finite-T oscillator-bath dephasing, "
+                         "arXiv:1410.0516 — Gamma_phi derived from each "
+                         "point's T_p).  Default: the config's lz_mode "
+                         "key; the resolved scenario joins the "
+                         "sweep/artifact identities")
+    ap.add_argument("--lz-n-levels", type=int, default=None,
+                    dest="lz_n_levels",
+                    help="Chain levels N for --lz-mode chain (>= 2; "
+                         "N=2 reduces to the coherent two-channel "
+                         "kernel, pinned)")
+    ap.add_argument("--lz-bath-eta", type=float, default=None,
+                    dest="lz_bath_eta",
+                    help="Ohmic bath coupling eta for --lz-mode thermal "
+                         "(Gamma_phi = 2 eta T (1 - e^(-omega_c/T)))")
+    ap.add_argument("--lz-bath-omega-c", type=float, default=None,
+                    dest="lz_bath_omega_c",
+                    help="Bath cutoff omega_c in GeV for --lz-mode "
+                         "thermal")
+
+
+def lz_flags_error(args, *, default_method: str = "coherent") -> "str | None":
+    """The shared flag-pairing validation (None = valid).
+
+    Wraps :func:`bdlz_tpu.lz.kernel.gamma_phi_cli_error` (negativity
+    first, then the Γ↔dephased pairing) and layers the scenario-plane
+    pairing rules on top: a scenario mode owns its P derivation, so an
+    estimator/Γ flag it would silently ignore is a caller error, and a
+    scenario parameter without its mode is one too.
+    """
+    from bdlz_tpu.lz.kernel import gamma_phi_cli_error
+
+    method = getattr(args, "lz_method", None)
+    mode = getattr(args, "lz_mode", None)
+    if mode in ("chain", "thermal"):
+        # the scenario-pairing rules outrank the generic Γ↔dephased one:
+        # with a scenario mode the whole estimator surface is owned by
+        # the mode, and the message should say so.  The sweep/MCMC
+        # default is "local" so an explicitly typed default cannot be
+        # distinguished from an untouched flag, but any non-default
+        # estimator VALUE is always a pairing error.
+        if getattr(args, "lz_gamma_phi", 0.0) < 0.0:
+            return "--lz-gamma-phi must be >= 0"
+        if method not in (None, default_method):
+            return (f"--lz-method {method} has no effect with "
+                    f"--lz-mode {mode} (the scenario owns the kernel)")
+        if getattr(args, "lz_gamma_phi", 0.0):
+            return (f"--lz-gamma-phi has no effect with --lz-mode {mode} "
+                    "(the scenario derives its own dephasing)")
+    else:
+        err = gamma_phi_cli_error(method or default_method,
+                                  getattr(args, "lz_gamma_phi", 0.0))
+        if err:
+            return err
+    if getattr(args, "lz_n_levels", None) is not None and mode != "chain":
+        return "--lz-n-levels requires --lz-mode chain"
+    if mode != "thermal" and (
+        getattr(args, "lz_bath_eta", None) is not None
+        or getattr(args, "lz_bath_omega_c", None) is not None
+    ):
+        return "--lz-bath-eta/--lz-bath-omega-c require --lz-mode thermal"
+    return None
+
+
+def apply_scenario_flags(cfg, args):
+    """Fold explicit scenario flags over the config's lz_* keys.
+
+    Returns a (re-validated) Config — the flags are config overrides
+    exactly like ``--quad``, so the resolved values flow into
+    StaticChoices and from there into every identity.
+    """
+    from bdlz_tpu.config import validate
+
+    overrides = {}
+    for flag, key in (
+        ("lz_mode", "lz_mode"),
+        ("lz_n_levels", "lz_n_levels"),
+        ("lz_bath_eta", "lz_bath_eta"),
+        ("lz_bath_omega_c", "lz_bath_omega_c"),
+    ):
+        v = getattr(args, flag, None)
+        if v is not None:
+            overrides[key] = v
+    if not overrides:
+        return cfg
+    return validate(dataclasses.replace(cfg, **overrides), backend="tpu")

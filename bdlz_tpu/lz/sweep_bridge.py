@@ -414,6 +414,161 @@ def eval_P_table_2d(v_w, gamma_phi, table: PTable2D, xp):
     return xp.clip(P, 0.0, 1.0)
 
 
+# ---------------------------------------------------------------------------
+# LZ scenario plane (docs/scenarios.md): chain / thermal modes as
+# first-class sweep axes.  ONE dispatch home shared by run_sweep, the
+# emulator's exact evaluator, and the MCMC CLI so the three consumers
+# cannot drift in what a mode means.
+# ---------------------------------------------------------------------------
+
+def scenario_identity(static) -> "dict | None":
+    """The resolved scenario as an identity payload (None = two-channel).
+
+    The SINGLE identity home of the ``lz_mode``/``lz_n_levels``/
+    ``lz_bath_*`` knobs (config.SCENARIO_CONFIG_FIELDS excludes them
+    from the shared config payload): ``engine_identity_extra`` folds
+    this dict into sweep manifest/chunk identities and
+    ``emulator.artifact.build_identity`` stamps it on artifacts —
+    omit-at-default, so every pre-existing two-channel hash is
+    byte-stable.
+    """
+    mode = getattr(static, "lz_mode", "two_channel")
+    if mode == "two_channel":
+        return None
+    if mode == "chain":
+        return {"mode": "chain", "n_levels": int(static.lz_n_levels)}
+    if mode == "thermal":
+        return {
+            "mode": "thermal",
+            "eta": float(static.lz_bath_eta),
+            "omega_c": float(static.lz_bath_omega_c),
+        }
+    raise ValueError(f"unknown lz_mode {mode!r}")
+
+
+def scenario_probabilities_for_points(
+    profile: Union[str, BounceProfile],
+    static,
+    v_w,
+    T_p_GeV=None,
+) -> np.ndarray:
+    """Per-point P under the static's resolved scenario mode.
+
+    ``"chain"`` derives P from the N-level banded chain's band-traversing
+    channel (``lz.chain``); ``"thermal"`` derives Γ_φ from each point's
+    own T_p through the oscillator-bath rate and runs the dephased (or,
+    at Γ = 0, bitwise-coherent) kernel (``lz.thermal``).  Two-channel
+    callers stay on :func:`probabilities_for_points` — this dispatch is
+    only for the scenario modes, and raises on ``"two_channel"`` so a
+    caller cannot silently route the legacy path through it.
+    """
+    mode = getattr(static, "lz_mode", "two_channel")
+    if mode == "chain":
+        from bdlz_tpu.lz.chain import chain_probabilities_for_points
+
+        return chain_probabilities_for_points(
+            profile, v_w, int(static.lz_n_levels)
+        )
+    if mode == "thermal":
+        from bdlz_tpu.lz.thermal import thermal_probabilities_for_points
+
+        if T_p_GeV is None:
+            raise ValueError(
+                "lz_mode='thermal' derives Gamma_phi from each point's "
+                "T_p_GeV; pass the per-point temperatures"
+            )
+        return thermal_probabilities_for_points(
+            profile, v_w, T_p_GeV,
+            float(static.lz_bath_eta), float(static.lz_bath_omega_c),
+        )
+    raise ValueError(
+        f"scenario dispatch is for lz_mode 'chain'/'thermal', got {mode!r} "
+        "(two-channel sweeps use probabilities_for_points)"
+    )
+
+
+class PTableN(NamedTuple):
+    """Dense per-species P(v_w) table for the N-level chain, in-jit.
+
+    The N-aware layout of :class:`PTable`: ``values`` is ``(n, N)`` —
+    one column per species' asymptotic population — on the same uniform
+    1/v node grid (every chain crossing's adiabaticity parameter scales
+    as 1/v, like the two-channel case).  Column N−1 is the pipeline's
+    ``P_chi_to_B``; the full vector feeds multi-species yields
+    (``Y_χ`` per level) through the same cubic interpolation stencil.
+
+    Memory model: a table build stages ``(padded_segments, 2N, 2N)``
+    f64 embeddings per speed, so the speed-chunk budget divides by
+    ``(2N)²`` where the two-channel quaternion path divides by 4 —
+    ``lz.chain.chain_populations_for_speeds`` owns that clamp.
+    """
+
+    u0: float        # first node in u = 1/v (= 1/v_hi)
+    inv_du: float    # 1 / node spacing in u
+    values: Any      # populations at the nodes, shape (n, N)
+    v_lo: float      # domain of validity (queries are clamped into it)
+    v_hi: float
+    n_levels: int
+
+
+def make_P_table_n(
+    profile: Union[str, BounceProfile],
+    n_levels: int,
+    v_lo: float,
+    v_hi: float,
+    n: int = 0,
+    xp=np,
+) -> PTableN:
+    """Precompute per-species chain populations over [v_lo, v_hi].
+
+    The chain analog of :func:`make_P_of_vw_table` — one chunk-jitted
+    pass over the 1/v node grid (``lz.chain`` memory model), N columns
+    per node.  The coherent default density applies: the chain carries
+    the same Stückelberg-phase oscillations in u as the two-channel
+    coherent kernel.
+    """
+    from bdlz_tpu.lz.chain import (
+        chain_populations_for_speeds,
+        validate_n_levels,
+    )
+
+    n_levels = validate_n_levels(n_levels)
+    if not (0.0 < v_lo < v_hi <= 1.0):
+        raise ValueError(f"need 0 < v_lo < v_hi <= 1, got [{v_lo}, {v_hi}]")
+    n = int(n) or _TABLE_N_DEFAULT["coherent"]
+    if n < 8:
+        raise ValueError(f"table needs >= 8 nodes, got {n}")
+    us = np.linspace(1.0 / v_hi, 1.0 / v_lo, n)
+    P = chain_populations_for_speeds(profile, 1.0 / us, n_levels)
+    return PTableN(
+        u0=1.0 / v_hi,
+        inv_du=(n - 1) / (1.0 / v_lo - 1.0 / v_hi),
+        values=xp.asarray(P),
+        v_lo=float(v_lo),
+        v_hi=float(v_hi),
+        n_levels=n_levels,
+    )
+
+
+def eval_P_table_n(v_w, table: PTableN, xp):
+    """Per-species populations by cubic interpolation on the 1/v grid.
+
+    Trace-safe scalar query returning the ``(N,)`` vector: the shared
+    ``cubic_lagrange_uniform`` stencil applied per species column (N is
+    trace-static, so the loop unrolls).  Clamped into the table's
+    wall-speed domain and into [0, 1] per species.
+    """
+    from bdlz_tpu.ops.kjma_table import cubic_lagrange_uniform
+
+    u = 1.0 / xp.clip(v_w, table.v_lo, table.v_hi)
+    t = (u - table.u0) * table.inv_du
+    cols = [
+        cubic_lagrange_uniform(t, table.values[:, k], xp)
+        for k in range(int(table.n_levels))
+    ]
+    return xp.clip(xp.stack(cols, axis=-1), 0.0, 1.0)
+
+
 def eval_P_table(v_w, table: PTable, xp):
     """P(v_w) by cubic Lagrange interpolation on the 1/v grid, in-jit.
 

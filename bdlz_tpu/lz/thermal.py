@@ -1,0 +1,152 @@
+"""Finite-temperature oscillator-bath dephasing (arXiv:1410.0516).
+
+The dephased two-channel kernel (:func:`bdlz_tpu.lz.kernel.
+propagate_bloch`) treats Γ_φ as a free knob.  The thermal scenario
+replaces it with a physically derived rate: the χ/B two-level system
+coupled to a finite-temperature harmonic-oscillator bath (arXiv:
+1410.0516) with Ohmic spectral density ``J(ω) = η ω e^{−ω/ω_c}``
+pure-dephases at the zero-frequency limit of the symmetrized bath
+correlator,
+
+    Γ_φ(T, η, ω_c) = η · lim_{ω→0} ω coth(ω/2T) e^{−ω/ω_c} → 2 η T,
+
+regularized here by the exponential cutoff into
+
+    Γ_φ = 2 η T (1 − e^{−ω_c/T}),
+
+which keeps the classic Ohmic rate ``2ηT`` for ``T ≪ ω_c`` and
+saturates at ``2ηω_c`` when the bath cannot resolve frequencies above
+its cutoff (``T ≫ ω_c``).  Two limits the validation gate pins:
+
+* **T → 0 (or η → 0): coherent, bitwise.**  Γ_φ = 0 *is* the coherent
+  kernel, so the scenario dispatches the Γ = 0 case through the SU(2)
+  quaternion path itself — not through the SO(3) Bloch path at Γ = 0,
+  which agrees only to ~1e-15 — making the cold limit reproduce the
+  two-channel coherent kernel bit for bit (after first-jit warm-up;
+  see the XLA-CPU first-run note in docs/scenarios.md).
+* **monotone in T**: ``dΓ/dT = 2η(1 − e^{−x}(1+x)) ≥ 0`` for
+  ``x = ω_c/T ≥ 0`` (since ``e^x ≥ 1+x``), so a hotter bath never
+  dephases less — the physical sanity audit
+  (:func:`bdlz_tpu.validation.thermal_mode_audit`).
+
+Units: ``T`` and ``ω_c`` in GeV (the bath temperature is the sweep
+point's own ``T_p_GeV``), ``η`` dimensionless, Γ_φ in the profile's
+energy units like the free knob it replaces.
+"""
+from __future__ import annotations
+
+from typing import Tuple, Union
+
+import numpy as np  # host-side use only; jitted paths go through the backend.py xp seam (bdlz-lint R1 audit)
+
+from bdlz_tpu.lz.profile import BounceProfile, load_profile_csv
+
+
+def validate_bath(eta: float, omega_c: float) -> Tuple[float, float]:
+    """Host-boundary bath contract shared by every thermal seam."""
+    eta = float(eta)
+    omega_c = float(omega_c)
+    if eta < 0.0 or omega_c < 0.0:
+        raise ValueError(
+            f"bath coupling eta and cutoff omega_c must be >= 0, got "
+            f"eta={eta}, omega_c={omega_c}"
+        )
+    return eta, omega_c
+
+
+def thermal_gamma_phi(T_GeV, eta: float, omega_c_GeV: float):
+    """``Γ_φ = 2 η T (1 − e^{−ω_c/T})`` — the derived dephasing rate.
+
+    Vectorized over ``T_GeV`` (a sweep's per-point percolation
+    temperatures).  T ≤ 0 maps to Γ = 0 (the coherent limit), and the
+    ``ω_c/T`` exponent is evaluated with the division guarded so the
+    cold limit is an exact 0.0, not an underflow artifact.
+    """
+    eta, omega_c = validate_bath(eta, omega_c_GeV)
+    T = np.asarray(T_GeV, dtype=np.float64)
+    with np.errstate(divide="ignore", over="ignore", invalid="ignore"):
+        x = np.where(T > 0.0, omega_c / np.where(T > 0.0, T, 1.0), np.inf)
+        gam = 2.0 * eta * np.where(T > 0.0, T, 0.0) * (-np.expm1(-x))
+    out = np.where(T > 0.0, gam, 0.0)
+    # a NaN temperature must stay NaN (T > 0 is False for NaN, which
+    # would silently map a poisoned point onto the coherent limit);
+    # the sweep layer's mask-and-report machinery absorbs it per point
+    out = np.where(np.isnan(T), np.nan, out)
+    return float(out) if np.ndim(T_GeV) == 0 else out
+
+
+def thermal_method_for(gamma_phi: float) -> Tuple[str, float]:
+    """``(method, gamma)`` the thermal scenario evaluates P with.
+
+    Γ = 0 IS the coherent kernel, and the cold limit must reproduce it
+    BITWISE (the gate's contract), so the dispatch routes Γ = 0 through
+    the quaternion path instead of the Bloch path at zero rate.
+    """
+    g = float(gamma_phi)
+    if g < 0.0:
+        raise ValueError(f"gamma_phi must be >= 0, got {g}")
+    return ("coherent", 0.0) if g == 0.0 else ("dephased", g)
+
+
+def thermal_probability(
+    profile: Union[str, BounceProfile],
+    v_w: float,
+    T_GeV: float,
+    eta: float,
+    omega_c_GeV: float,
+) -> float:
+    """P_{χ→B} under bath dephasing at one (v_w, T) point (host seam)."""
+    from bdlz_tpu.lz.kernel import (
+        dephased_probability,
+        transfer_matrix_propagation,
+    )
+
+    if isinstance(profile, str):
+        profile = load_profile_csv(profile)
+    method, gam = thermal_method_for(
+        thermal_gamma_phi(float(T_GeV), eta, omega_c_GeV)
+    )
+    if method == "coherent":
+        _, P = transfer_matrix_propagation(profile, v_w)
+        return float(min(max(P, 0.0), 1.0))
+    return dephased_probability(profile, v_w, gam)
+
+
+def thermal_probabilities_for_points(
+    profile: Union[str, BounceProfile],
+    v_w,
+    T_p_GeV,
+    eta: float,
+    omega_c_GeV: float,
+) -> np.ndarray:
+    """P per sweep point with Γ_φ derived from each point's own T_p.
+
+    Points are grouped by their derived rate (a T_p scan over n_T
+    temperatures costs n_T dephased table passes, not n_points), and
+    each group's speeds go through the shared two-channel batch path
+    (``sweep_bridge.probabilities_for_points``) — the Γ = 0 group
+    through the coherent kernel itself (bitwise cold limit).
+    """
+    from bdlz_tpu.lz.sweep_bridge import probabilities_for_points
+
+    if isinstance(profile, str):
+        profile = load_profile_csv(profile)
+    v_w = np.asarray(v_w, dtype=np.float64)
+    if v_w.size == 0:
+        validate_bath(eta, omega_c_GeV)
+        return np.zeros(0)
+    T = np.broadcast_to(
+        np.asarray(T_p_GeV, dtype=np.float64), v_w.shape
+    )
+    gam = np.atleast_1d(np.asarray(thermal_gamma_phi(T, eta, omega_c_GeV)))
+    out = np.full(v_w.shape, np.nan)
+    # non-finite (T, v) rows stay NaN — the sweep layer's mask-and-report
+    # machinery absorbs them per point, like the local-momentum path
+    finite = np.isfinite(gam) & np.isfinite(v_w)
+    for g in np.unique(gam[finite]):
+        sel = finite & (gam == g)
+        method, g_used = thermal_method_for(float(g))
+        out[sel] = probabilities_for_points(
+            profile, v_w[sel], method=method, gamma_phi=g_used
+        )
+    return out

@@ -56,29 +56,37 @@ def main(argv=None) -> None:
                          "segment (bitwise-identical to uninterrupted)")
     ap.add_argument("--checkpoint-every", type=int, default=100,
                     help="Steps per checkpoint segment (with --checkpoint-dir)")
-    ap.add_argument("--lz-profile", default=None, dest="lz_profile",
-                    help="Bounce-profile CSV: tie P_chi_to_B to the sampled "
-                         "wall speed through the two-channel LZ kernel, so "
-                         "sampling v_w samples the distributed-LZ physics")
-    ap.add_argument("--lz-method", default="local", dest="lz_method",
-                    choices=("local", "coherent", "local-momentum", "dephased"),
-                    help="LZ estimator with --lz-profile: local (analytic in "
-                         "v_w, evaluated exactly in-jit), coherent (full "
-                         "transfer matrix), local-momentum (thermal "
-                         "flux-weighted average), and dephased (density-"
-                         "matrix transport at --lz-gamma-phi) via a dense "
-                         "P(v_w) interpolation table built once at startup")
+    # shared LZ flag helper (lz/options.py): one home for the
+    # --lz-profile/--lz-method/--lz-gamma-phi surface and the
+    # scenario-plane flags across the three drivers; this CLI's
+    # documented divergence is its "local" default estimator (and the
+    # MCMC-only --lz-table-n below)
+    from bdlz_tpu.lz.options import (
+        SWEEP_METHODS,
+        add_lz_method_flags,
+        add_lz_scenario_flags,
+        lz_flags_error,
+    )
+
+    add_lz_method_flags(
+        ap, default="local", choices=SWEEP_METHODS,
+        profile_help="Bounce-profile CSV: tie P_chi_to_B to the sampled "
+                     "wall speed through the two-channel LZ kernel, so "
+                     "sampling v_w samples the distributed-LZ physics",
+        method_help="LZ estimator with --lz-profile: local (analytic in "
+                    "v_w, evaluated exactly in-jit), coherent (full "
+                    "transfer matrix), local-momentum (thermal "
+                    "flux-weighted average), and dephased (density-"
+                    "matrix transport at --lz-gamma-phi) via a dense "
+                    "P(v_w) interpolation table built once at startup",
+    )
+    add_lz_scenario_flags(ap)
     ap.add_argument("--lz-table-n", type=int, default=0, dest="lz_table_n",
                     help="Nodes of the P(v_w) table for coherent/"
-                         "local-momentum/dephased (0 = per-method default)")
-    ap.add_argument("--lz-gamma-phi", type=float, default=0.0,
-                    dest="lz_gamma_phi",
-                    help="Diabatic-basis dephasing rate for --lz-method "
-                         "dephased (energy units of the profile's Delta)")
+                         "local-momentum/dephased/chain/thermal "
+                         "(0 = per-method default)")
     args = ap.parse_args(argv)
-    from bdlz_tpu.lz.kernel import gamma_phi_cli_error
-
-    _gerr = gamma_phi_cli_error(args.lz_method, args.lz_gamma_phi)
+    _gerr = lz_flags_error(args, default_method="local")
     if _gerr:
         raise SystemExit(_gerr)
     if not 0 <= args.burn < args.steps:
@@ -117,6 +125,12 @@ def main(argv=None) -> None:
 
     # the MCMC likelihood always executes on the JAX path — strict validation
     cfg = validate(load_config(args.config), backend="tpu")
+    # explicit scenario flags override the config's lz_* keys (the --quad
+    # pattern); the RESOLVED mode flows through StaticChoices into the
+    # P derivation and the checkpoint identity (docs/scenarios.md)
+    from bdlz_tpu.lz.options import apply_scenario_flags
+
+    cfg = apply_scenario_flags(cfg, args)
     static = static_choices_from_config(cfg)
     params = dict(parse_param(s) for s in args.param)
 
@@ -126,9 +140,37 @@ def main(argv=None) -> None:
             "--lz-method/--lz-table-n/lz_gamma_phi sampling have no effect "
             "without --lz-profile"
         )
+    if cfg.lz_mode != "two_channel":
+        if not args.lz_profile:
+            raise SystemExit(
+                f"lz_mode={cfg.lz_mode!r} derives P from a bounce profile; "
+                "pass --lz-profile"
+            )
+        # a config-driven scenario mode forbids the two-channel estimator
+        # knobs it would silently ignore (the flag-driven case is caught
+        # by lz_flags_error above)
+        if args.lz_method != "local" or args.lz_gamma_phi:
+            raise SystemExit(
+                f"--lz-method/--lz-gamma-phi have no effect with "
+                f"lz_mode={cfg.lz_mode!r} (the scenario owns the kernel)"
+            )
+        if "lz_gamma_phi" in params:
+            raise SystemExit(
+                f"sampling lz_gamma_phi has no effect with lz_mode="
+                f"{cfg.lz_mode!r} (the scenario derives its own dephasing)"
+            )
+        if cfg.lz_mode == "thermal" and "T_p_GeV" in params:
+            # Γ_φ(T_p) would decouple from a sampled thermal state (the
+            # P(v_w) table is built at the pinned T_p) — same rule as
+            # --lz-method local-momentum
+            raise SystemExit(
+                "lz_mode='thermal' derives Gamma_phi at the pinned "
+                "T_p_GeV; T_p_GeV cannot be sampled with it"
+            )
     lz_kwargs = {}
     _profile_fp = None
     _table_n = None
+    _scenario = None
     if args.lz_profile:
         if "P_chi_to_B" in params:
             raise SystemExit(
@@ -170,7 +212,69 @@ def main(argv=None) -> None:
                         f"pinned thermal state; {k} cannot be sampled "
                         "with it"
                     )
-        if args.lz_method == "local":
+        if cfg.lz_mode != "two_channel":
+            # LZ scenario plane (docs/scenarios.md): the mode owns the P
+            # derivation; the resolved scenario joins the checkpoint
+            # identity below (its single home, omit-at-default)
+            from bdlz_tpu.lz.sweep_bridge import scenario_identity
+
+            _scenario = scenario_identity(static)
+            if "v_w" not in params:
+                # pinned wall speed: the scenario P is one number —
+                # resolve it host-side and pin it (no table to mistrust)
+                if args.lz_table_n:
+                    raise SystemExit(
+                        "--lz-table-n has no effect when v_w is not "
+                        "sampled (P is resolved once host-side — no "
+                        "table is built)"
+                    )
+                from bdlz_tpu.lz.sweep_bridge import (
+                    scenario_probabilities_for_points,
+                )
+
+                P_pin = float(scenario_probabilities_for_points(
+                    profile, static, [cfg.v_w], T_p_GeV=[cfg.T_p_GeV]
+                )[0])
+                import dataclasses
+
+                cfg = dataclasses.replace(cfg, P_chi_to_B=P_pin)
+            elif cfg.lz_mode == "chain":
+                # chain P(v_w): the N-aware table's band-traversing
+                # column (PTableN[:, -1]) through the same in-jit cubic
+                # 1/v interpolation the two-channel tables use
+                from bdlz_tpu.lz.sweep_bridge import PTable, make_P_table_n
+
+                v_lo, v_hi = params["v_w"]
+                tn = make_P_table_n(
+                    profile, cfg.lz_n_levels, v_lo, v_hi,
+                    n=args.lz_table_n, xp=jnp,
+                )
+                lz_kwargs["lz_P_table"] = PTable(
+                    u0=tn.u0, inv_du=tn.inv_du, values=tn.values[:, -1],
+                    v_lo=tn.v_lo, v_hi=tn.v_hi, method="chain",
+                )
+                _table_n = int(tn.values.shape[0])
+            else:
+                # thermal: Γ_φ derived from the bath at the pinned T_p,
+                # then the standard dephased table — or, at Γ = 0, the
+                # coherent kernel itself (the bitwise cold limit)
+                from bdlz_tpu.lz.sweep_bridge import make_P_of_vw_table
+                from bdlz_tpu.lz.thermal import (
+                    thermal_gamma_phi,
+                    thermal_method_for,
+                )
+
+                method, gam = thermal_method_for(thermal_gamma_phi(
+                    cfg.T_p_GeV, cfg.lz_bath_eta, cfg.lz_bath_omega_c
+                ))
+                v_lo, v_hi = params["v_w"]
+                ptab = make_P_of_vw_table(
+                    profile, method, v_lo, v_hi, n=args.lz_table_n,
+                    gamma_phi=gam, xp=jnp,
+                )
+                lz_kwargs["lz_P_table"] = ptab
+                _table_n = int(ptab.values.shape[0])
+        elif args.lz_method == "local":
             if args.lz_table_n:
                 raise SystemExit(
                     "--lz-table-n has no effect with --lz-method local "
@@ -295,6 +399,11 @@ def main(argv=None) -> None:
                     {
                         "lz": {
                             "profile": _profile_fp,
+                            # the resolved scenario plane joins the run
+                            # identity (omit-at-default: two-channel
+                            # checkpoints keep their hashes)
+                            **({"scenario": _scenario}
+                               if _scenario is not None else {}),
                             "method": args.lz_method,
                             # resolved node count, not the raw flag — a
                             # change to the per-method default must also
@@ -370,6 +479,12 @@ def main(argv=None) -> None:
         summary["resumed_segments"] = resumed_segments
     if args.lz_profile:
         summary["lz"] = {"profile": args.lz_profile, "method": args.lz_method}
+        if _scenario is not None:
+            # a scenario run must not be misreported as the two-channel
+            # default estimator
+            summary["lz"]["mode"] = cfg.lz_mode
+            summary["lz"]["scenario"] = _scenario
+            del summary["lz"]["method"]
         if args.lz_method == "dephased":
             # a sampled rate must not be misreported as pinned-at-0
             summary["lz"]["gamma_phi"] = (

@@ -149,9 +149,19 @@ def engine_identity_extra(
       byte-stable);
     * ``fault_plan`` — an ARMED plan joins every identity
       (omit-at-default): nan/poison injection changes output bits, so
-      chaos results must never collide with clean ones.
+      chaos results must never collide with clean ones;
+    * ``lz_scenario`` — the resolved LZ scenario plane (chain/thermal
+      mode + parameters, docs/scenarios.md); omit-at-default
+      (two-channel), and the SINGLE identity home of the
+      ``lz_mode``/``lz_n_levels``/``lz_bath_*`` knobs
+      (``config.SCENARIO_*_FIELDS`` exclude them everywhere else).
     """
+    from bdlz_tpu.lz.sweep_bridge import scenario_identity
+
     extra: Dict[str, Any] = {}
+    scen = scenario_identity(static)
+    if scen is not None:
+        extra["lz_scenario"] = scen
     if impl == "tabulated" and static.quad_panel_gl:
         from bdlz_tpu.solvers.panels import (
             N_PANELS_DEFAULT,
@@ -867,6 +877,30 @@ def run_sweep(
     pp_all = build_grid(base, axes, P_base=P_base)
     n_total = len(np.asarray(pp_all.m_chi_GeV))
     hash_extra = None
+    # LZ scenario plane (docs/scenarios.md): a chain/thermal mode in the
+    # static OWNS the per-point P derivation — it needs the profile and
+    # forbids the two-channel estimator knobs it would silently ignore.
+    lz_mode = getattr(static, "lz_mode", "two_channel")
+    if lz_mode != "two_channel":
+        if lz_profile is None:
+            raise ValueError(
+                f"lz_mode={lz_mode!r} derives P per point from a bounce "
+                "profile; pass lz_profile"
+            )
+        if lz_gamma_phi:
+            raise ValueError(
+                f"lz_gamma_phi has no effect with lz_mode={lz_mode!r} "
+                "(the scenario derives its own dephasing)"
+            )
+        if lz_method != "local":
+            # "local" is this function's default, so an explicit
+            # non-default estimator is always a discarded choice — the
+            # CLIs guard this at the flag layer; library callers get
+            # the same loud contract here
+            raise ValueError(
+                f"lz_method={lz_method!r} has no effect with "
+                f"lz_mode={lz_mode!r} (the scenario owns the kernel)"
+            )
     if lz_profile is not None:
         if "P_chi_to_B" in axes:
             raise ValueError(
@@ -877,26 +911,37 @@ def run_sweep(
         from bdlz_tpu.lz.sweep_bridge import (
             probabilities_for_points,
             profile_fingerprint,
+            scenario_probabilities_for_points,
         )
 
         if isinstance(lz_profile, str):
             lz_profile = load_profile_csv(lz_profile)  # parse the CSV once
-        P_pts = probabilities_for_points(
-            lz_profile, np.asarray(pp_all.v_w), method=lz_method,
-            T_p_GeV=np.asarray(pp_all.T_p_GeV),
-            m_chi_GeV=np.asarray(pp_all.m_chi_GeV),
-            gamma_phi=lz_gamma_phi,
-        )
+        if lz_mode != "two_channel":
+            P_pts = scenario_probabilities_for_points(
+                lz_profile, static, np.asarray(pp_all.v_w),
+                T_p_GeV=np.asarray(pp_all.T_p_GeV),
+            )
+            # the resolved scenario itself joins the identity through
+            # engine_identity_extra (its single home); only the profile
+            # fingerprint is keyed here
+            hash_extra = {"lz_profile": profile_fingerprint(lz_profile)}
+        else:
+            P_pts = probabilities_for_points(
+                lz_profile, np.asarray(pp_all.v_w), method=lz_method,
+                T_p_GeV=np.asarray(pp_all.T_p_GeV),
+                m_chi_GeV=np.asarray(pp_all.m_chi_GeV),
+                gamma_phi=lz_gamma_phi,
+            )
+            hash_extra = {
+                "lz_profile": profile_fingerprint(lz_profile),
+                "lz_method": lz_method,
+            }
+            if lz_method == "dephased":
+                # the dephasing rate changes every P — different Γ_φ are
+                # different sweeps (only keyed for the method that uses
+                # it, so existing directories keep their hashes)
+                hash_extra["lz_gamma_phi"] = float(lz_gamma_phi)
         pp_all = pp_all._replace(P=P_pts)
-        hash_extra = {
-            "lz_profile": profile_fingerprint(lz_profile),
-            "lz_method": lz_method,
-        }
-        if lz_method == "dephased":
-            # the dephasing rate changes every P — different Γ_φ are
-            # different sweeps (only keyed for the method that uses it,
-            # so existing directories keep their hashes)
-            hash_extra["lz_gamma_phi"] = float(lz_gamma_phi)
     if mesh is not None:
         # The sharded batch axis must divide evenly across the mesh; chunks
         # are padded to chunk_size, so just round chunk_size itself up.

@@ -125,16 +125,22 @@ def config_payload(base) -> Dict[str, Any]:
 
 def static_payload(static, *, normalize_quad: bool = False) -> list:
     """The StaticChoices side: field values in declaration order with the
-    ``ROBUSTNESS_STATIC_FIELDS`` excluded.  ``normalize_quad=True``
-    additionally zeroes the quadrature tri-state out of the tuple — for
-    identities that carry the RESOLVED scheme as a separate key (the
-    emulator artifact's ``quad_panel_gl``)."""
-    from bdlz_tpu.config import ROBUSTNESS_STATIC_FIELDS
+    ``ROBUSTNESS_STATIC_FIELDS`` and ``SCENARIO_STATIC_FIELDS`` excluded
+    (robustness is orchestration-only; the LZ scenario's single identity
+    home is the omit-at-default ``lz_scenario`` key — appending its
+    values here would churn every legacy refcache/chunk/artifact hash).
+    ``normalize_quad=True`` additionally zeroes the quadrature tri-state
+    out of the tuple — for identities that carry the RESOLVED scheme as
+    a separate key (the emulator artifact's ``quad_panel_gl``)."""
+    from bdlz_tpu.config import (
+        ROBUSTNESS_STATIC_FIELDS,
+        SCENARIO_STATIC_FIELDS,
+    )
 
     st = static._replace(quad_panel_gl=None) if normalize_quad else static
+    excluded = set(ROBUSTNESS_STATIC_FIELDS) | set(SCENARIO_STATIC_FIELDS)
     return [
-        v for f, v in zip(type(st)._fields, st)
-        if f not in ROBUSTNESS_STATIC_FIELDS
+        v for f, v in zip(type(st)._fields, st) if f not in excluded
     ]
 
 

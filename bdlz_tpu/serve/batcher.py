@@ -118,6 +118,7 @@ class MicroBatcher:
         deadline_s: Optional[float] = None,
         fault_plan=None,
         queue_bound: Optional[int] = None,
+        lz_mode: Optional[str] = None,
     ):
         if max_batch_size < 1:
             raise ValueError("max_batch_size must be >= 1")
@@ -161,6 +162,12 @@ class MicroBatcher:
         #: the clock at dispatch — requests look older, deadlines fire —
         #: never as a real sleep.
         self._faults = fault_plan
+        #: The LZ physics scenario the backing service serves
+        #: (docs/scenarios.md) — stamped on every stats row so mode
+        #: audits read straight off the serving telemetry.  None when
+        #: this batcher fronts a bare process function with no service
+        #: (unit-test harnesses).
+        self.lz_mode = None if lz_mode is None else str(lz_mode)
         self._clock = clock
         self.stats = stats if stats is not None else ServeStats()
         self._queue: Deque[_Pending] = deque()
@@ -294,6 +301,7 @@ class MicroBatcher:
             n_retries=int(result.n_retries),
             n_error=sum(e is not None for e in errors),
             n_gated=int(result.n_gated),
+            lz_mode=self.lz_mode,
         )
         self._batch_index += 1
         for p, v, e in zip(batch, values, errors):

@@ -110,6 +110,11 @@ class FleetResponse(NamedTuple):
     replica: int
     fallback_reason: Optional[str] = None
     degraded: bool = False
+    #: The LZ physics scenario the answering artifact serves
+    #: ("two_channel" | "chain" | "thermal"; docs/scenarios.md) — every
+    #: response names its mode, so a consumer can assert it got the
+    #: physics it asked for.
+    lz_mode: Optional[str] = None
 
 
 class _Replica:
@@ -516,11 +521,21 @@ class FleetService:
         error_gate_tol=None,
         health=None,
         store=None,
+        lz_profile=None,
     ):
         from bdlz_tpu.emulator.artifact import build_identity
         from bdlz_tpu.provenance import resolve_store
+        from bdlz_tpu.serve.service import (
+            artifact_lz_mode,
+            resolve_service_profile,
+        )
 
         static, n_y, impl = resolve_service_static(artifact, base, static)
+        #: The LZ physics scenario this fleet serves (docs/scenarios.md)
+        #: — stamped on every stats row and FleetResponse; the identity
+        #: check above already rejects cross-mode artifact/static skew.
+        self.lz_mode = artifact_lz_mode(artifact)
+        lz_profile = resolve_service_profile(artifact, lz_profile)
         #: The exact-fallback error gate (shared resolution with
         #: YieldService — resolve_error_gate): None = membership-only.
         self.error_gate_tol = resolve_error_gate(
@@ -557,7 +572,7 @@ class FleetService:
         self._fallback = ExactFallback(
             base, static, n_y=n_y, impl=impl, mesh=mesh,
             chunk_size=self.max_batch_size, retry=retry,
-            fault_plan=fault_plan,
+            fault_plan=fault_plan, lz_profile=lz_profile,
         )
         self._faults = self._fallback.fault_plan
         self.replica_set = ReplicaSet(
@@ -933,6 +948,7 @@ class FleetService:
             n_gated=int(gated.sum()),
             artifact_hash=item.artifact_hash,
             replica=replica_index,
+            lz_mode=self.lz_mode,
         )
         if self.health is not None and heal_cause is None:
             # success bookkeeping (latency-SLO scored inside, on the
@@ -955,6 +971,7 @@ class FleetService:
                     artifact_hash=item.artifact_hash,
                     replica=replica_index,
                     fallback_reason=reason,
+                    lz_mode=self.lz_mode,
                 ))
         if self._observer is not None:
             self._observer(now)
@@ -1052,6 +1069,7 @@ class FleetService:
             n_gated=0,
             artifact_hash=replica_set.artifact_hash,
             replica=-1,
+            lz_mode=self.lz_mode,
         )
         for p, v in zip(batch, values):
             self.stats.record_latency(done - p.enqueued_at)
@@ -1070,6 +1088,7 @@ class FleetService:
                     replica=-1,
                     fallback_reason=REASON_DEGRADED,
                     degraded=True,
+                    lz_mode=self.lz_mode,
                 ))
         if self._observer is not None:
             self._observer(done)

@@ -16,8 +16,11 @@ docs/serving.md).
 Requests are JSON lines, one query each, either an object mapping the
 artifact's axis names to values (``{"m_chi_GeV": 0.95, "T_p_GeV":
 100.0}``) or ``{"theta": [0.95, 100.0]}`` in artifact axis order; an
-optional ``"id"`` is echoed back.  Responses are JSON lines on stdout:
-``{"id", "value", "fallback_reason", "latency_s"}`` in request order
+optional ``"id"`` is echoed back; an optional ``"lz_mode"`` states the
+physics scenario the caller expects and is rejected with a structured
+error when it disagrees with the artifact's mode (cross-mode skew,
+docs/scenarios.md).  Responses are JSON lines on stdout:
+``{"id", "value", "lz_mode", "fallback_reason", "latency_s"}`` in request order
 (``fallback_reason`` is null when the emulator fast path answered,
 ``"ood"`` for a domain miss, ``"predicted_error"`` when the per-cell
 error gate routed the request to the exact path; ``latency_s`` is
@@ -112,6 +115,14 @@ def main(argv: Optional[list] = None) -> int:
                          "(--replicas only; docs/robustness.md): auto "
                          "= the config tri-state (fleet default ON), "
                          "off = the pre-health byte-identical behavior")
+    ap.add_argument("--lz-profile", default=None, dest="lz_profile",
+                    help="Bounce-profile CSV for a scenario "
+                         "(chain/thermal) artifact: its exact fallback "
+                         "derives P per point from this profile, which "
+                         "must fingerprint-match the one the artifact "
+                         "was built from (docs/scenarios.md).  Required "
+                         "for scenario artifacts, rejected for "
+                         "two-channel ones.")
     ap.add_argument("--events", default=None,
                     help="JSON-lines event log path (default stderr)")
     args = ap.parse_args(argv)
@@ -145,15 +156,18 @@ def main(argv: Optional[list] = None) -> int:
                 None if args.deadline_ms is None else args.deadline_ms / 1e3
             ),
             health={"auto": None, "on": True, "off": False}[args.health],
+            lz_profile=args.lz_profile,
         )
         service = None
     else:
         service = YieldService(
-            artifact, base, field=args.field, max_batch_size=args.max_batch
+            artifact, base, field=args.field, max_batch_size=args.max_batch,
+            lz_profile=args.lz_profile,
         )
     event_log.emit(
         "serve_start",
         artifact=args.artifact,
+        lz_mode=(fleet or service).lz_mode,
         axes=list(artifact.axis_names),
         n_grid_points=artifact.n_points,
         max_rel_err=artifact.manifest.get("max_rel_err"),
@@ -202,13 +216,22 @@ def main(argv: Optional[list] = None) -> int:
             rid = obj.get("id", ln) if isinstance(obj, dict) else ln
             front = fleet if fleet is not None else service
             try:
-                theta = (
-                    np.asarray(obj["theta"], dtype=np.float64)
-                    if "theta" in obj
-                    else front.theta_from_mapping(
+                if "theta" in obj:
+                    # mapping-style requests validate their stated mode
+                    # inside theta_from_mapping; theta-style ones here
+                    stated = obj.get("lz_mode")
+                    if stated is not None and str(stated) != front.lz_mode:
+                        raise ValueError(
+                            f"request states lz_mode={str(stated)!r} but "
+                            f"this artifact serves lz_mode="
+                            f"{front.lz_mode!r} — cross-mode "
+                            "artifact/request skew"
+                        )
+                    theta = np.asarray(obj["theta"], dtype=np.float64)
+                else:
+                    theta = front.theta_from_mapping(
                         {k: v for k, v in obj.items() if k != "id"}
                     )
-                )
             except Exception as exc:  # noqa: BLE001 — report per request
                 print(json.dumps(_error_record(rid, exc, line=ln)))
                 continue
@@ -272,6 +295,8 @@ def main(argv: Optional[list] = None) -> int:
             print(json.dumps({
                 "id": rid,
                 "value": float(answer.value),
+                # the physics scenario that answered (docs/scenarios.md)
+                "lz_mode": service.lz_mode,
                 "fallback_reason": answer.fallback_reason,
                 "latency_s": round(time.monotonic() - t0, 6),
             }))
@@ -334,6 +359,8 @@ def _serve_requests_fleet(fleet, requests) -> int:
             "value": float(resp.value),
             "artifact_hash": resp.artifact_hash,
             "replica": resp.replica,
+            # the physics scenario that answered (docs/scenarios.md)
+            "lz_mode": resp.lz_mode,
             "fallback_reason": resp.fallback_reason,
             # loud degraded-mode marker (every breaker open, answered
             # by the exact pipeline — docs/robustness.md)

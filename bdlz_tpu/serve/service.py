@@ -99,12 +99,84 @@ def _pad_rows(arr: np.ndarray, n: int) -> np.ndarray:
     )
 
 
+def artifact_lz_mode(artifact) -> str:
+    """The LZ physics scenario ``artifact`` serves (docs/scenarios.md).
+
+    Read off the artifact identity's ``lz_scenario`` key —
+    omit-at-default, so every pre-scenario artifact is ``"two_channel"``.
+    The one rule every serve-layer mode consumer (request validation,
+    stats rows, responses) delegates to.
+    """
+    scen = dict(artifact.identity).get("lz_scenario")
+    return str(scen["mode"]) if scen else "two_channel"
+
+
+def resolve_service_profile(artifact, lz_profile):
+    """The bounce profile a service's exact fallback must run with.
+
+    A chain/thermal artifact derives every exact-fallback P from the
+    bounce profile, so constructing its service REQUIRES one — and it
+    must be the very profile the surface was built from (fingerprint
+    vs the artifact identity's ``lz_profile`` key), or the fallback
+    would silently answer from different physics than the emulator
+    path.  A two-channel artifact takes no profile (its P comes from
+    the config/axes); passing one is a caller error, not a no-op.
+    Returns the loaded :class:`~bdlz_tpu.lz.profile.BounceProfile` (or
+    None for two-channel).
+    """
+    mode = artifact_lz_mode(artifact)
+    if mode == "two_channel":
+        if lz_profile is not None:
+            raise ValueError(
+                "lz_profile requires a scenario (chain/thermal) artifact "
+                "— this two-channel artifact's exact fallback takes P "
+                "from the config or its axes"
+            )
+        return None
+    if lz_profile is None:
+        raise ValueError(
+            f"this artifact serves lz_mode={mode!r}: its exact fallback "
+            "derives P per point from a bounce profile; pass lz_profile"
+        )
+    from bdlz_tpu.lz.profile import load_profile_csv
+    from bdlz_tpu.lz.sweep_bridge import profile_fingerprint
+
+    if isinstance(lz_profile, str):
+        lz_profile = load_profile_csv(lz_profile)
+    recorded = dict(artifact.identity).get("lz_profile")
+    got = profile_fingerprint(lz_profile)
+    if recorded is not None and got != recorded:
+        raise ValueError(
+            f"lz_profile fingerprint {got} does not match the profile "
+            f"this artifact was built from ({recorded}): the exact "
+            "fallback would answer from different physics than the "
+            "emulator surface"
+        )
+    return lz_profile
+
+
 def theta_from_mapping(
     artifact: EmulatorArtifact, point: Dict[str, float]
 ) -> np.ndarray:
     """(d,) query vector from an {axis_name: value} mapping — the one
     request-parsing rule both serving fronts (YieldService and the
-    fleet) delegate to."""
+    fleet) delegate to.
+
+    A request may state the scenario it expects (``"lz_mode"`` key,
+    docs/scenarios.md); a statement that disagrees with the artifact's
+    mode is cross-mode skew and rejects loudly — a chain query must
+    never be answered from a two-channel surface (or vice versa).
+    """
+    point = dict(point)
+    stated = point.pop("lz_mode", None)
+    if stated is not None:
+        mode = artifact_lz_mode(artifact)
+        if str(stated) != mode:
+            raise ValueError(
+                f"request states lz_mode={str(stated)!r} but this "
+                f"artifact serves lz_mode={mode!r} — cross-mode "
+                "artifact/request skew"
+            )
     missing = [n for n in artifact.axis_names if n not in point]
     if missing:
         raise ValueError(f"query is missing axes {missing}")
@@ -206,16 +278,19 @@ class ExactFallback:
 
     def __init__(
         self, base, static, *, n_y: int, impl: str, mesh=None,
-        chunk_size: int, retry=None, fault_plan=None,
+        chunk_size: int, retry=None, fault_plan=None, lz_profile=None,
     ):
         from bdlz_tpu.faults import FaultPlan
         from bdlz_tpu.utils.retry import resolve_engine_retry
 
         self._retry = resolve_engine_retry(retry, base, static)
         self._faults = FaultPlan.resolve(fault_plan, base)
+        # a chain/thermal static needs the bounce profile here — the
+        # evaluator refuses to construct without it, so a scenario
+        # service is loud at build time, not at its first OOD request
         self._exact = make_exact_evaluator(
             base, static, n_y=n_y, impl=impl, mesh=mesh,
-            chunk_size=chunk_size,
+            chunk_size=chunk_size, lz_profile=lz_profile,
         )
         self._calls = 0
 
@@ -281,11 +356,17 @@ class YieldService:
         fault_plan=None,
         warm: bool = True,
         error_gate_tol=None,
+        lz_profile=None,
     ):
         # identity resolution + the retried/fault-injectable exact path
         # are shared with the fleet (resolve_service_static /
         # ExactFallback) so the two serving fronts cannot drift.
         static, n_y, impl = resolve_service_static(artifact, base, static)
+        #: The LZ physics scenario this surface serves (docs/scenarios.md)
+        #: — stamped on every stats row and checked against any
+        #: mode-stating request.
+        self.lz_mode = artifact_lz_mode(artifact)
+        lz_profile = resolve_service_profile(artifact, lz_profile)
         self.artifact = artifact
         self.field = field
         self.max_batch_size = int(max_batch_size)
@@ -304,7 +385,7 @@ class YieldService:
         self._exact_guarded = ExactFallback(
             base, static, n_y=n_y, impl=impl, mesh=mesh,
             chunk_size=self.max_batch_size, retry=retry,
-            fault_plan=fault_plan,
+            fault_plan=fault_plan, lz_profile=lz_profile,
         )
         self._faults = self._exact_guarded.fault_plan
         self.stats = ServeStats()
@@ -463,6 +544,7 @@ class YieldService:
             stats=self.stats if stats is None else stats,
             deadline_s=deadline_s,
             fault_plan=self._faults,
+            lz_mode=self.lz_mode,
         )
 
     def theta_from_mapping(self, point: Dict[str, float]) -> np.ndarray:

@@ -82,24 +82,31 @@ def main(argv=None) -> None:
                          "trapezoid).  Overrides the config's quad_panel_gl "
                          "tri-state; the resolved scheme joins the resume "
                          "manifest hash")
-    ap.add_argument("--lz-profile", default=None, dest="lz_profile",
-                    help="Bounce-profile CSV: derive each point's P_chi_to_B "
-                         "from its own wall speed through the two-channel LZ "
-                         "kernel (v_w scans then exercise the distributed-LZ "
-                         "physics end to end)")
-    ap.add_argument("--lz-method", default="local", dest="lz_method",
-                    choices=("local", "coherent", "local-momentum", "dephased"),
-                    help="Per-point LZ estimator with --lz-profile: local "
-                         "(analytic composition, spectrally exact — the "
-                         "1e-6-contract default), coherent (full transfer "
-                         "matrix, carries Stueckelberg oscillations), "
-                         "local-momentum (thermal flux-weighted average), "
-                         "dephased (density-matrix transport with "
-                         "--lz-gamma-phi dephasing)")
-    ap.add_argument("--lz-gamma-phi", type=float, default=0.0,
-                    dest="lz_gamma_phi",
-                    help="Diabatic-basis dephasing rate for --lz-method "
-                         "dephased (energy units of the profile's Delta)")
+    # shared LZ flag helper (lz/options.py): one home for the
+    # --lz-profile/--lz-method/--lz-gamma-phi surface and the
+    # scenario-plane flags across the three drivers; this CLI's
+    # documented divergence is its "local" default estimator
+    from bdlz_tpu.lz.options import (
+        SWEEP_METHODS,
+        add_lz_method_flags,
+        add_lz_scenario_flags,
+    )
+
+    add_lz_method_flags(
+        ap, default="local", choices=SWEEP_METHODS,
+        profile_help="Bounce-profile CSV: derive each point's P_chi_to_B "
+                     "from its own wall speed through the two-channel LZ "
+                     "kernel (v_w scans then exercise the distributed-LZ "
+                     "physics end to end)",
+        method_help="Per-point LZ estimator with --lz-profile: local "
+                    "(analytic composition, spectrally exact — the "
+                    "1e-6-contract default), coherent (full transfer "
+                    "matrix, carries Stueckelberg oscillations), "
+                    "local-momentum (thermal flux-weighted average), "
+                    "dephased (density-matrix transport with "
+                    "--lz-gamma-phi dephasing)",
+    )
+    add_lz_scenario_flags(ap)
     ap.add_argument("--multihost", action="store_true",
                     help="Initialize jax.distributed from JAX_COORDINATOR_ADDRESS/"
                          "JAX_NUM_PROCESSES/JAX_PROCESS_ID before building the mesh "
@@ -107,11 +114,14 @@ def main(argv=None) -> None:
     args = ap.parse_args(argv)
     if args.fuse_exp and args.impl != "pallas":
         ap.error("--fuse-exp requires --impl pallas")
-    from bdlz_tpu.lz.kernel import gamma_phi_cli_error
+    from bdlz_tpu.lz.options import lz_flags_error
 
-    _gerr = gamma_phi_cli_error(args.lz_method, args.lz_gamma_phi)
+    _gerr = lz_flags_error(args, default_method="local")
     if _gerr:
         ap.error(_gerr)
+    if args.lz_mode in ("chain", "thermal") and not args.lz_profile:
+        ap.error(f"--lz-mode {args.lz_mode} derives P per point from a "
+                 "bounce profile; pass --lz-profile")
 
     if args.multihost:
         from bdlz_tpu.parallel import init_multihost
@@ -148,6 +158,26 @@ def main(argv=None) -> None:
 
     # the sweep engine always executes on the JAX path — strict validation
     cfg = validate(load_config(args.config), backend="tpu")
+    # explicit scenario flags override the config's lz_* keys (the --quad
+    # pattern); the RESOLVED mode flows through StaticChoices into the
+    # engine dispatch and every identity (docs/scenarios.md)
+    from bdlz_tpu.lz.options import apply_scenario_flags
+
+    cfg = apply_scenario_flags(cfg, args)
+    if cfg.lz_mode != "two_channel":
+        if not args.lz_profile:
+            raise SystemExit(
+                f"lz_mode={cfg.lz_mode!r} derives P per point from a bounce "
+                "profile; pass --lz-profile"
+            )
+        # a config-driven scenario mode forbids the two-channel estimator
+        # knobs it would silently ignore (the flag-driven case is caught
+        # by lz_flags_error above)
+        if args.lz_method != "local" or args.lz_gamma_phi:
+            raise SystemExit(
+                f"--lz-method/--lz-gamma-phi have no effect with "
+                f"lz_mode={cfg.lz_mode!r} (the scenario owns the kernel)"
+            )
     axes: Dict[str, np.ndarray] = dict(parse_axis(s) for s in args.axis)
     if not axes:
         raise SystemExit("at least one --axis is required")
@@ -206,6 +236,9 @@ def main(argv=None) -> None:
     else:
         closest = None  # every point failed; keep the summary strict JSON
     print(json.dumps({
+        # omit-at-default, like the identity rule: two-channel summaries
+        # stay byte-identical to pre-scenario output
+        **({"lz_mode": cfg.lz_mode} if cfg.lz_mode != "two_channel" else {}),
         "n_points": res.n_points,
         "n_failed": res.n_failed,
         "n_quarantined": res.n_quarantined,

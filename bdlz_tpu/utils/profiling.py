@@ -93,6 +93,12 @@ class ServeBatch:
     # cutover never mixes surfaces within a dispatch.
     artifact_hash: "str | None" = None
     replica: "int | None" = None
+    #: The LZ physics scenario the answering artifact serves
+    #: ("two_channel" | "chain" | "thermal"; docs/scenarios.md) — every
+    #: service-recorded row names its mode so cross-mode traffic audits
+    #: read straight off the stats.  None only on rows recorded by a
+    #: bare MicroBatcher with no service behind it.
+    lz_mode: "str | None" = None
 
 
 @dataclass

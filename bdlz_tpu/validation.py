@@ -545,3 +545,215 @@ def reference_ratios(grid, static, n_y: "int | None" = None) -> np.ndarray:
         pp_i = type(grid)(*(float(np.asarray(f)[i]) for f in grid))
         out[i] = float(point_yields(pp_i, static, grid_np, np).DM_over_B)
     return out
+
+
+# ---------------------------------------------------------------------------
+# LZ scenario-mode gates (docs/scenarios.md): each new physics mode of
+# the scenario plane carries its own validation-gate population, the
+# same pattern as the panel-quadrature audit above — a deterministic
+# adversarial sample scored against an independent reference, with
+# non-finite values surfacing as GateFailure, never as a small error.
+# ---------------------------------------------------------------------------
+
+class ChainAuditResult(NamedTuple):
+    """Verdict of :func:`chain_mode_audit`."""
+
+    ok: bool
+    #: max rel err of the N = 2 chain vs the coherent two-channel
+    #: transfer-matrix kernel over the speed population (contract:
+    #: <= 1e-12 — the chain must REDUCE to, not merely approximate, the
+    #: existing kernel).
+    n2_vs_coherent: float
+    #: max abs err of the flat-band (Δ ≡ 0) chain at the audited N vs
+    #: the closed-form path-graph spectrum reference
+    #: (``lz.chain.uniform_chain_populations_analytic``) — the midpoint
+    #: segmentation is exact for a constant Hamiltonian, so this is a
+    #: roundoff-level check of the propagation itself.
+    analytic_flat_band: float
+    #: max |Σ_k P_k − 1| over the population — the propagator is unitary
+    #: by construction, so probability leakage means a broken embedding.
+    unitarity_defect: float
+    reason: "str | None" = None
+
+
+def chain_mode_audit(
+    profile,
+    n_levels: int = 3,
+    n_sample: int = 24,
+    rtol_n2: float = 1e-12,
+    atol_analytic: float = 1e-10,
+) -> ChainAuditResult:
+    """The ``lz_mode="chain"`` gate population (docs/scenarios.md).
+
+    Three independent checks over a deterministic geomspace speed
+    sample: (a) at N = 2 the chain kernel must agree with the coherent
+    two-channel transfer-matrix kernel to ``rtol_n2`` (they share the
+    segmentation and the tree product, so this bounds the banded
+    construction, not discretization); (b) at the audited ``n_levels``
+    the flat-band limit must reproduce the closed-form path-graph
+    spectrum populations to roundoff; (c) populations must stay
+    normalized.  Non-finite kernel output raises through
+    :class:`GateFailure` into a failed result, mask-and-report style.
+    """
+    from bdlz_tpu.lz.chain import (
+        chain_populations_for_speeds,
+        uniform_chain_populations_analytic,
+        validate_n_levels,
+    )
+    from bdlz_tpu.lz.profile import BounceProfile, load_profile_csv
+    from bdlz_tpu.lz.sweep_bridge import probabilities_for_points
+
+    n_levels = validate_n_levels(n_levels)
+    if isinstance(profile, str):
+        profile = load_profile_csv(profile)
+    # deterministic adversarial sample: geomspace speeds reach into the
+    # adiabatic (v -> 0) corner where the Stueckelberg phases wind
+    # fastest and any construction error is amplified
+    v = np.geomspace(0.02, 0.95, int(n_sample))
+    try:
+        P2 = chain_populations_for_speeds(profile, v, 2)[:, -1]
+        P_ref = probabilities_for_points(profile, v, method="coherent")
+        n2_err = float(relative_errors(P2, P_ref).max())
+
+        Pn = chain_populations_for_speeds(profile, v, n_levels)
+        if not np.isfinite(Pn).all():
+            raise GateFailure("non-finite chain populations")
+        unit = float(np.abs(Pn.sum(axis=1) - 1.0).max())
+
+        # flat-band analytic reference: Δ ≡ 0, constant mix — the
+        # closed-form path-graph spectrum (arXiv:1212.2907 limit)
+        m_flat, L = 0.35, 6.0
+        xi = np.linspace(0.0, L, 257)
+        flat = BounceProfile(
+            xi=xi, delta=np.zeros_like(xi), mix=np.full_like(xi, m_flat)
+        )
+        an_err = 0.0
+        for vv in (0.2, 0.5, 0.9):
+            got = chain_populations_for_speeds(flat, [vv], n_levels)[0]
+            ref = uniform_chain_populations_analytic(
+                n_levels, m_flat, L, vv
+            )
+            an_err = max(an_err, float(np.abs(got - ref).max()))
+    except GateFailure as exc:
+        return ChainAuditResult(
+            ok=False, n2_vs_coherent=np.inf, analytic_flat_band=np.inf,
+            unitarity_defect=np.inf, reason=str(exc),
+        )
+    ok = (n2_err <= rtol_n2 and an_err <= atol_analytic
+          and unit <= atol_analytic)
+    reason = None
+    if not ok:
+        reason = (
+            f"chain gate breach: N=2 vs coherent {n2_err:.3e} "
+            f"(<= {rtol_n2:.0e}), flat-band analytic {an_err:.3e}, "
+            f"unitarity {unit:.3e} (<= {atol_analytic:.0e})"
+        )
+    return ChainAuditResult(
+        ok=ok, n2_vs_coherent=n2_err, analytic_flat_band=an_err,
+        unitarity_defect=unit, reason=reason,
+    )
+
+
+class ThermalAuditResult(NamedTuple):
+    """Verdict of :func:`thermal_mode_audit`."""
+
+    ok: bool
+    #: The T -> 0 (and eta -> 0) limit reproduces the coherent kernel
+    #: BITWISE: the thermal dispatch routes Γ = 0 through the quaternion
+    #: path itself (``lz.thermal.thermal_method_for``), so the cold
+    #: limit is the same program on the same inputs, not a 1e-15
+    #: neighbor.
+    cold_limit_bitwise: bool
+    #: max Γ_φ(T_i) − Γ_φ(T_{i+1}) over an ascending T grid (<= 0 when
+    #: monotone: a hotter bath never dephases less).
+    monotonicity_defect: float
+    #: |Γ(T >> ω_c) / (2 η ω_c) − 1|: the cutoff-saturation limit.
+    saturation_err: float
+    reason: "str | None" = None
+
+
+def thermal_mode_audit(
+    profile,
+    eta: float,
+    omega_c_GeV: float,
+    n_sample: int = 16,
+    T_grid=None,
+) -> ThermalAuditResult:
+    """The ``lz_mode="thermal"`` gate population (docs/scenarios.md).
+
+    (a) **Cold limit, bitwise**: P under the bath at T = 0 (and at
+    η = 0) must equal the coherent two-channel P bit for bit over the
+    speed sample — the first jitted run of a process can wobble ~3e-9
+    on XLA-CPU, so callers comparing across processes must warm up
+    first (tests use the shared ``jit_warmup`` fixture).
+    (b) **Monotone in T**: the derived rate Γ_φ(T) = 2ηT(1 − e^(−ω_c/T))
+    must be non-decreasing on an ascending temperature grid, with
+    Γ(0) = 0 exactly.  (c) **Cutoff saturation**: Γ(T ≫ ω_c) → 2ηω_c.
+    """
+    from bdlz_tpu.lz.profile import load_profile_csv
+    from bdlz_tpu.lz.sweep_bridge import probabilities_for_points
+    from bdlz_tpu.lz.thermal import (
+        thermal_gamma_phi,
+        thermal_probabilities_for_points,
+        validate_bath,
+    )
+
+    eta, omega_c = validate_bath(eta, omega_c_GeV)
+    if isinstance(profile, str):
+        profile = load_profile_csv(profile)
+    v = np.geomspace(0.05, 0.95, int(n_sample))
+    if T_grid is None:
+        T_grid = np.geomspace(
+            max(omega_c, 1e-6) * 1e-3, max(omega_c, 1e-6) * 1e3, 41
+        )
+    T_grid = np.asarray(T_grid, dtype=np.float64)
+    try:
+        P_cold = thermal_probabilities_for_points(
+            profile, v, 0.0, eta, omega_c
+        )
+        P_eta0 = thermal_probabilities_for_points(
+            profile, v, float(T_grid[-1]), 0.0, omega_c
+        )
+        P_ref = probabilities_for_points(profile, v, method="coherent")
+        if not (np.isfinite(P_cold).all() and np.isfinite(P_eta0).all()):
+            raise GateFailure("non-finite thermal-mode populations")
+        cold_bitwise = bool(
+            np.array_equal(P_cold, P_ref) and np.array_equal(P_eta0, P_ref)
+        )
+    except GateFailure as exc:
+        return ThermalAuditResult(
+            ok=False, cold_limit_bitwise=False,
+            monotonicity_defect=np.inf, saturation_err=np.inf,
+            reason=str(exc),
+        )
+    gam = np.asarray(thermal_gamma_phi(np.sort(T_grid), eta, omega_c))
+    if not np.isfinite(gam).all():
+        return ThermalAuditResult(
+            ok=False, cold_limit_bitwise=cold_bitwise,
+            monotonicity_defect=np.inf, saturation_err=np.inf,
+            reason="non-finite derived dephasing rate",
+        )
+    mono = float(np.max(np.diff(gam) * -1.0, initial=0.0))
+    gam0 = float(thermal_gamma_phi(0.0, eta, omega_c))
+    sat_ref = 2.0 * eta * omega_c
+    if sat_ref > 0.0:
+        sat = abs(
+            float(thermal_gamma_phi(omega_c * 1e6, eta, omega_c)) / sat_ref
+            - 1.0
+        )
+    else:
+        # eta = 0 or omega_c = 0: the rate is identically zero — the
+        # saturation statement degenerates to Γ ≡ 0
+        sat = float(np.abs(gam).max(initial=0.0))
+    ok = cold_bitwise and mono <= 0.0 and gam0 == 0.0 and sat <= 1e-3
+    reason = None
+    if not ok:
+        reason = (
+            f"thermal gate breach: cold_bitwise={cold_bitwise}, "
+            f"monotonicity defect {mono:.3e} (<= 0), Gamma(0)={gam0}, "
+            f"saturation err {sat:.3e} (<= 1e-3)"
+        )
+    return ThermalAuditResult(
+        ok=ok, cold_limit_bitwise=cold_bitwise, monotonicity_defect=mono,
+        saturation_err=sat, reason=reason,
+    )

@@ -1652,7 +1652,10 @@ def main(argv=None) -> None:
                               min(4096, n_total) if on_cpu else n_total))
     pp_lz_base = jax.tree.map(lambda a: np.asarray(a)[:n_lz], pp_all)
 
-    def lz_metric(metric_name, unit_detail, derive_P):
+    _OMIT = object()  # "emit no vs_two_channel key" (the legacy legs)
+
+    def lz_metric(metric_name, unit_detail, derive_P, extra=None,
+                  baseline=_OMIT):
         t0 = time.time()
         P_lz = np.clip(np.asarray(derive_P(np.asarray(pp_lz_base.v_w))),
                        0.0, 1.0)
@@ -1690,6 +1693,17 @@ def main(argv=None) -> None:
                 "n_quad_nodes": n_quad_main,
                 "platform": jax.devices()[0].platform,
                 "tpu_unavailable": tpu_unavailable,
+                # scenario legs only: throughput vs the coherent
+                # two-channel leg (the baseline both modes generalize;
+                # null when that leg failed), plus the mode's
+                # validation-gate residuals
+                **({} if baseline is _OMIT else {
+                    "vs_two_channel": (
+                        round(per_chip_lz / baseline, 3)
+                        if baseline else None
+                    ),
+                }),
+                **(extra or {}),
             }
         )
         return per_chip_lz
@@ -1737,6 +1751,89 @@ def main(argv=None) -> None:
             lz_per_chip = val
         else:
             lz_coherent_per_chip = val
+
+    # LZ scenario plane (docs/scenarios.md): the N-level chain and the
+    # finite-T thermal-bath modes as measured production workloads —
+    # same leg shape as the two-channel lines above, with each mode's
+    # validation-gate residuals (bdlz_tpu.validation.chain_mode_audit /
+    # thermal_mode_audit — a leg whose gate breaches never reports a
+    # throughput) and the vs-two-channel throughput ratio on the line.
+    n_chain_levels = int(os.environ.get("BDLZ_BENCH_LZ_N_LEVELS", 3))
+    bath_eta = float(os.environ.get("BDLZ_BENCH_LZ_BATH_ETA", 0.05))
+    bath_omega_c = float(os.environ.get("BDLZ_BENCH_LZ_BATH_OMEGA_C", 1.0))
+
+    def lz_chain_metric():
+        from bdlz_tpu.lz.chain import chain_probabilities_for_points
+        from bdlz_tpu.validation import chain_mode_audit
+
+        audit = chain_mode_audit(lz_prof, n_levels=n_chain_levels)
+        if not audit.ok:
+            raise RuntimeError(audit.reason)
+        return lz_metric(
+            "lz_chain_sweep_points_per_sec_per_chip",
+            "N=%d banded-chain per-species P(v_w) derivation"
+            % n_chain_levels,
+            lambda v_w: chain_probabilities_for_points(
+                lz_prof, v_w, n_chain_levels
+            ),
+            extra={
+                "lz_mode": "chain",
+                "lz_n_levels": n_chain_levels,
+                "gate_n2_vs_coherent": float(
+                    f"{audit.n2_vs_coherent:.3e}"
+                ),
+                "gate_analytic_flat_band": float(
+                    f"{audit.analytic_flat_band:.3e}"
+                ),
+            },
+            baseline=lz_coherent_per_chip,
+        )
+
+    def lz_thermal_metric():
+        from bdlz_tpu.lz.thermal import thermal_probabilities_for_points
+        from bdlz_tpu.validation import thermal_mode_audit
+
+        audit = thermal_mode_audit(
+            lz_prof, bath_eta, bath_omega_c, n_sample=8
+        )
+        if not audit.ok:
+            raise RuntimeError(audit.reason)
+        T_pts = np.asarray(pp_lz_base.T_p_GeV)
+        return lz_metric(
+            "lz_thermal_sweep_points_per_sec_per_chip",
+            "finite-T bath Gamma_phi(T_p) derivation + dephased kernel",
+            lambda v_w: thermal_probabilities_for_points(
+                lz_prof, v_w, T_pts, bath_eta, bath_omega_c
+            ),
+            extra={
+                "lz_mode": "thermal",
+                "lz_bath_eta": bath_eta,
+                "lz_bath_omega_c": bath_omega_c,
+                "gate_cold_limit_bitwise": bool(audit.cold_limit_bitwise),
+                "gate_monotonicity_defect": float(
+                    audit.monotonicity_defect
+                ),
+            },
+            baseline=lz_coherent_per_chip,
+        )
+
+    lz_chain_per_chip = None
+    lz_thermal_per_chip = None
+    for attr, name, fn in (
+        ("lz_chain_per_chip",
+         "lz_chain_sweep_points_per_sec_per_chip", lz_chain_metric),
+        ("lz_thermal_per_chip",
+         "lz_thermal_sweep_points_per_sec_per_chip", lz_thermal_metric),
+    ):
+        try:
+            val = run_leg(attr.replace("_per_chip", ""), fn)
+        except Exception as exc:  # noqa: BLE001 — secondary metric is best-effort
+            print(f"[bench] {name} unavailable: {exc}", file=sys.stderr)
+            val = None
+        if attr == "lz_chain_per_chip":
+            lz_chain_per_chip = val
+        else:
+            lz_thermal_per_chip = val
 
     # main metric LAST (the driver parses the final line)
     print(
@@ -1815,6 +1912,15 @@ def main(argv=None) -> None:
                 "lz_sweep_points_per_sec_per_chip": lz_per_chip,
                 "lz_coherent_sweep_points_per_sec_per_chip": (
                     lz_coherent_per_chip
+                ),
+                # the LZ scenario plane's workload legs
+                # (docs/scenarios.md; null = leg failed — the secondary
+                # lines carry gate residuals + vs_two_channel)
+                "lz_chain_sweep_points_per_sec_per_chip": (
+                    lz_chain_per_chip
+                ),
+                "lz_thermal_sweep_points_per_sec_per_chip": (
+                    lz_thermal_per_chip
                 ),
             }
         )

@@ -197,12 +197,17 @@ def test_bench_cpu_smoke():
     # secondary legs must carry numbers, flagged with their platform
     assert d["lz_sweep_points_per_sec_per_chip"] > 0
     assert d["lz_coherent_sweep_points_per_sec_per_chip"] > 0
+    # the LZ scenario-plane legs (docs/scenarios.md) carry numbers too
+    assert d["lz_chain_sweep_points_per_sec_per_chip"] > 0
+    assert d["lz_thermal_sweep_points_per_sec_per_chip"] > 0
     assert d["esdirk_points_per_sec_per_chip"] > 0
     secondary = [json.loads(ln) for ln in out.stdout.strip().splitlines()[:-1]]
     names = {s["metric"] for s in secondary}
     assert {"esdirk_sweep_points_per_sec_per_chip",
             "lz_sweep_points_per_sec_per_chip",
             "lz_coherent_sweep_points_per_sec_per_chip",
+            "lz_chain_sweep_points_per_sec_per_chip",
+            "lz_thermal_sweep_points_per_sec_per_chip",
             "emulator_query_points_per_sec",
             "quad_gl_sweep_points_per_sec_per_chip",
             "chaos_sweep_points_per_sec_per_chip",
@@ -221,6 +226,22 @@ def test_bench_cpu_smoke():
                            "chaos_serve_availability"):
             continue  # query/serving metrics, not sweep lines
         assert {"n_failed", "n_quarantined", "n_retries"} <= set(s), s["metric"]
+    # the scenario-plane legs (docs/scenarios.md): mode, gate residuals
+    # and the vs-two-channel throughput ratio ride each line; the chain
+    # gate pins the N=2 reduction at the acceptance tolerance and the
+    # thermal gate's cold limit is bitwise by construction
+    ch = next(s for s in secondary
+              if s["metric"] == "lz_chain_sweep_points_per_sec_per_chip")
+    assert ch["lz_mode"] == "chain" and ch["lz_n_levels"] >= 2
+    assert ch["gate_n2_vs_coherent"] <= 1e-12
+    assert ch["gate_analytic_flat_band"] <= 1e-10
+    assert "vs_two_channel" in ch
+    th = next(s for s in secondary
+              if s["metric"] == "lz_thermal_sweep_points_per_sec_per_chip")
+    assert th["lz_mode"] == "thermal"
+    assert th["gate_cold_limit_bitwise"] is True
+    assert th["gate_monotonicity_defect"] <= 0.0
+    assert "vs_two_channel" in th
     # the chaos line: healed sweep under the canned fault plan — the
     # injected poison point is quarantined, the NaN point masked, the
     # transient chunk retried, and every unaffected point bit-identical

@@ -77,6 +77,10 @@ PRE_HEALTH_ROW_KEYS = (
     "batch_index", "size", "occupancy", "wait_s", "n_fallback",
     "seconds", "n_retries", "n_error", "n_gated", "artifact_hash",
     "replica",
+    # the scenario plane (docs/scenarios.md) stamps the serving mode on
+    # every row for BOTH health states — a schema extension, not health
+    # overhead, so it belongs in the frozen baseline
+    "lz_mode",
 )
 
 

@@ -238,3 +238,24 @@ def test_seam_split_and_gating_modules_clean():
     assert report.files_scanned == 6
     offenders = "\n".join(f.render() for f in report.active)
     assert not report.active, f"seam-split findings:\n{offenders}"
+
+
+def test_scenario_plane_modules_clean():
+    """The LZ scenario plane (docs/scenarios.md): chain.py carries the
+    jitted N-level eigendecomposition propagator (prime R1/R2 surface —
+    host np use next to traced xp math), thermal.py the host-side bath
+    rate + dispatch, options.py the shared CLI flag surface, and
+    sweep_bridge.py gained the scenario dispatch + the N-aware P table
+    — exactly the code the STATIC_PARAM_NAMES additions
+    (lz_mode/lz_n_levels/lz_bath_eta/lz_bath_omega_c/n_levels) must
+    keep out of tracer-analysis false positives.  All pinned per-file
+    at zero unsuppressed findings."""
+    report = lint_paths([
+        str(PACKAGE / "lz" / "chain.py"),
+        str(PACKAGE / "lz" / "thermal.py"),
+        str(PACKAGE / "lz" / "options.py"),
+        str(PACKAGE / "lz" / "sweep_bridge.py"),
+    ])
+    assert report.files_scanned == 4
+    offenders = "\n".join(f.render() for f in report.active)
+    assert not report.active, f"scenario-plane findings:\n{offenders}"

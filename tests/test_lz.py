@@ -658,3 +658,74 @@ def test_local_momentum_points_match_unbatched_kernel():
         assert P[i] == pytest.approx(ref, rel=1e-12), i
     # repeated (v, T, m) combinations get identical values
     assert P[1] == P[4]
+
+
+class TestMomentumDephasedEdges:
+    """lz/momentum.py dephased-averaging edge cases (scenario-plane PR
+    satellite): the Γ_φ = 0 average must reduce to the coherent one
+    BITWISE (the thermal_method_for dispatch routes zero rate through
+    the quaternion path, not the ~1e-15-away SO(3) Bloch path), a
+    single-node profile degenerates cleanly, and an empty speed window
+    returns empty instead of crashing the batch builder."""
+
+    xi = np.linspace(-20.0, 20.0, 401)
+    prof = BounceProfile(
+        xi=xi, delta=-0.08 * np.tanh(xi / 4.0), mix=np.full_like(xi, 0.02)
+    )
+
+    def test_gamma_zero_bitwise_reduces_to_coherent(self):
+        from bdlz_tpu.lz.momentum import momentum_averaged_probability
+
+        Pd, Fd = momentum_averaged_probability(
+            self.prof, 0.3, 100.0, 0.95, n_k=32, n_mu=8,
+            method="dephased", gamma_phi=0.0,
+        )
+        Pc, Fc = momentum_averaged_probability(
+            self.prof, 0.3, 100.0, 0.95, n_k=32, n_mu=8, method="coherent",
+        )
+        # bitwise, not approx: same program on the same inputs
+        assert Pd == Pc and Fd == Fc
+
+    def test_gamma_positive_differs_from_coherent(self):
+        # the dispatch must not swallow a real rate
+        from bdlz_tpu.lz.momentum import momentum_averaged_probability
+
+        Pd, _ = momentum_averaged_probability(
+            self.prof, 0.3, 100.0, 0.95, n_k=32, n_mu=8,
+            method="dephased", gamma_phi=0.5,
+        )
+        Pc, _ = momentum_averaged_probability(
+            self.prof, 0.3, 100.0, 0.95, n_k=32, n_mu=8, method="coherent",
+        )
+        assert Pd != Pc
+
+    def test_negative_gamma_still_rejected(self):
+        from bdlz_tpu.lz.momentum import momentum_averaged_probability
+
+        with pytest.raises(ValueError, match="gamma_phi"):
+            momentum_averaged_probability(
+                self.prof, 0.3, 100.0, 0.95,
+                method="dephased", gamma_phi=-1.0,
+            )
+
+    def test_single_node_profile_degenerates_cleanly(self):
+        # one profile sample = zero segments = identity propagator:
+        # nothing converts, and F_k = <P>/P(v_w) is 0/0 -> nan, reported
+        # not raised (the CLI's warn-and-fall-back seam absorbs it)
+        from bdlz_tpu.lz.momentum import momentum_averaged_probability
+
+        single = BounceProfile(
+            xi=np.array([0.0]), delta=np.array([0.1]), mix=np.array([0.02])
+        )
+        P, F_k = momentum_averaged_probability(
+            single, 0.3, 100.0, 0.95, n_k=16, n_mu=8,
+            method="dephased", gamma_phi=0.0,
+        )
+        assert P == 0.0
+        assert np.isnan(F_k)
+
+    def test_empty_speed_window_returns_empty(self):
+        from bdlz_tpu.lz.momentum import local_momentum_average_batch
+
+        out = local_momentum_average_batch(self.prof, [], 100.0, 0.95)
+        assert out.shape == (0,)

@@ -156,9 +156,17 @@ class TestIdentityCompat:
             h.update(np.ascontiguousarray(
                 np.asarray(f, dtype=np.float64)
             ).tobytes())
+        # the scenario-plane fields postdate the legacy key and are
+        # excluded from the payload (config.SCENARIO_STATIC_FIELDS —
+        # their single identity home is the omit-at-default lz_scenario
+        # key), which is precisely what keeps this digest byte-stable:
+        # the legacy tuple never contained them
+        from bdlz_tpu.config import SCENARIO_STATIC_FIELDS
+
         ident = tuple(
             v for f, v in zip(type(static)._fields, static)
             if f not in ROBUSTNESS_STATIC_FIELDS
+            and f not in SCENARIO_STATIC_FIELDS
         )
         h.update(repr((ident, 200)).encode())
         h.update(fp.hexdigest()[:16].encode())

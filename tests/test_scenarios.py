@@ -1,0 +1,796 @@
+"""LZ scenario plane (docs/scenarios.md): the N-level chain and
+finite-T thermal-bath modes as first-class config/sweep/emulator/serve
+axes.
+
+Pins the acceptance contract: the N = 2 chain reduces to the coherent
+two-channel kernel to <= 1e-12 rel, the thermal T -> 0 limit reproduces
+the coherent kernel BITWISE (after the shared jit warm-up), the
+scenario knobs have ONE identity home (the omit-at-default
+``lz_scenario`` key — legacy hashes byte-stable), and both modes
+round-trip sweep -> emulator build -> registry publish -> fleet query
+with the mode on the artifact identity and every ServeStats row, with
+cross-mode artifact/request skew rejected loudly.
+"""
+import dataclasses
+import json
+
+import numpy as np
+import pytest
+
+from bdlz_tpu.config import (
+    Config,
+    ConfigError,
+    config_from_dict,
+    config_identity_dict,
+    static_choices_from_config,
+    validate,
+)
+from bdlz_tpu.lz.profile import BounceProfile
+from bdlz_tpu.lz.sweep_bridge import (
+    probabilities_for_points,
+    profile_fingerprint,
+    scenario_identity,
+    scenario_probabilities_for_points,
+)
+
+XI = np.linspace(-30.0, 30.0, 1001)
+PROF = BounceProfile(
+    xi=XI, delta=-0.08 * np.tanh(XI / 4.0), mix=np.full_like(XI, 0.02)
+)
+
+#: The tiny_emulator-style physics base the scenario boxes build on.
+PHYS = {
+    "regime": "nonthermal",
+    "source_shape_sigma_y": 9.0,
+    "incident_flux_scale": 1.07e-9,
+    "Y_chi_init": 4.90e-10,
+}
+
+
+def _cfg(**kw):
+    return validate(config_from_dict({**PHYS, **kw}), backend="tpu")
+
+
+def _write_profile_csv(path):
+    rows = "\n".join(
+        f"{x},{d},{m}" for x, d, m in zip(PROF.xi, PROF.delta, PROF.mix)
+    )
+    path.write_text("xi,delta,m_mix\n" + rows + "\n")
+    return str(path)
+
+
+# ---------------------------------------------------------------------------
+# kernels
+# ---------------------------------------------------------------------------
+
+class TestChainKernel:
+    def test_n2_reduces_to_coherent_within_1e12(self):
+        # the acceptance pin: the chain at N = 2 must REDUCE to the
+        # existing coherent transfer-matrix kernel, not approximate it
+        from bdlz_tpu.lz.chain import chain_probabilities_for_points
+
+        v = np.geomspace(0.02, 0.95, 24)
+        P2 = chain_probabilities_for_points(PROF, v, 2)
+        P_ref = probabilities_for_points(PROF, v, method="coherent")
+        rel = np.max(np.abs(P2 / np.where(P_ref == 0, 1.0, P_ref) - 1.0))
+        assert rel <= 1e-12, rel
+
+    def test_three_level_flat_band_matches_analytic(self):
+        # Δ ≡ 0, constant mix: the closed-form path-graph spectrum —
+        # the midpoint segmentation is exact for a constant Hamiltonian
+        from bdlz_tpu.lz.chain import (
+            chain_populations_for_speeds,
+            uniform_chain_populations_analytic,
+        )
+
+        L, m = 6.0, 0.35
+        xi = np.linspace(0.0, L, 257)
+        flat = BounceProfile(
+            xi=xi, delta=np.zeros_like(xi), mix=np.full_like(xi, m)
+        )
+        for n_levels in (2, 3, 5):
+            for v in (0.2, 0.6):
+                got = chain_populations_for_speeds(flat, [v], n_levels)[0]
+                ref = uniform_chain_populations_analytic(n_levels, m, L, v)
+                assert np.abs(got - ref).max() < 1e-10, (n_levels, v)
+
+    def test_populations_unitary_and_clipped(self):
+        from bdlz_tpu.lz.chain import chain_populations_for_speeds
+
+        P = chain_populations_for_speeds(PROF, np.linspace(0.1, 0.9, 7), 4)
+        assert P.shape == (7, 4)
+        assert np.all(P >= 0.0) and np.all(P <= 1.0)
+        assert np.abs(P.sum(axis=1) - 1.0).max() < 1e-10
+
+    def test_n_levels_contract(self):
+        from bdlz_tpu.lz.chain import chain_populations, validate_n_levels
+
+        with pytest.raises(ValueError, match="lz_n_levels"):
+            validate_n_levels(1)
+        with pytest.raises(ValueError, match="lz_n_levels"):
+            chain_populations(PROF, 0.3, 0)
+
+    def test_chain_mode_audit_passes(self):
+        from bdlz_tpu.validation import chain_mode_audit
+
+        audit = chain_mode_audit(PROF, n_levels=3)
+        assert audit.ok, audit.reason
+        assert audit.n2_vs_coherent <= 1e-12
+        assert audit.analytic_flat_band <= 1e-10
+
+
+class TestThermalKernel:
+    def test_rate_formula_and_limits(self):
+        from bdlz_tpu.lz.thermal import thermal_gamma_phi
+
+        eta, wc = 0.3, 1.0
+        # classic Ohmic 2ηT below the cutoff
+        assert thermal_gamma_phi(1e-3 * wc, eta, wc) == pytest.approx(
+            2.0 * eta * 1e-3 * wc, rel=1e-12
+        )
+        # saturation at 2ηω_c above it
+        assert thermal_gamma_phi(1e6 * wc, eta, wc) == pytest.approx(
+            2.0 * eta * wc, rel=1e-3
+        )
+        # the cold limit is an exact 0.0, not an underflow artifact
+        assert thermal_gamma_phi(0.0, eta, wc) == 0.0
+        assert thermal_gamma_phi(-1.0, eta, wc) == 0.0
+        # monotone in T
+        T = np.geomspace(1e-3, 1e3, 64)
+        gam = thermal_gamma_phi(T, eta, wc)
+        assert np.all(np.diff(gam) >= 0.0)
+
+    def test_bath_contract(self):
+        from bdlz_tpu.lz.thermal import thermal_gamma_phi, validate_bath
+
+        with pytest.raises(ValueError, match="eta"):
+            validate_bath(-0.1, 1.0)
+        with pytest.raises(ValueError, match="eta"):
+            thermal_gamma_phi(1.0, 0.1, -1.0)
+
+    def test_cold_limit_bitwise_after_warmup(self, jit_warmup):
+        # acceptance pin: Γ = 0 dispatches through the quaternion path
+        # itself, so T -> 0 (and η -> 0) reproduce the coherent kernel
+        # bit for bit — the first-jit wobble flushed by the shared
+        # fixture first
+        from bdlz_tpu.lz.thermal import thermal_probabilities_for_points
+
+        v = np.geomspace(0.05, 0.9, 12)
+        jit_warmup(probabilities_for_points, PROF, v, method="coherent")
+        P_ref = probabilities_for_points(PROF, v, method="coherent")
+        P_cold = thermal_probabilities_for_points(PROF, v, 0.0, 0.3, 1.0)
+        P_eta0 = thermal_probabilities_for_points(PROF, v, 100.0, 0.0, 1.0)
+        assert np.array_equal(P_cold, P_ref)
+        assert np.array_equal(P_eta0, P_ref)
+
+    def test_hot_bath_differs_and_groups_by_rate(self):
+        from bdlz_tpu.lz.thermal import thermal_probabilities_for_points
+
+        v = np.full(6, 0.3)
+        T = np.array([50.0, 50.0, 100.0, 100.0, 0.0, np.nan])
+        P = thermal_probabilities_for_points(PROF, v, T, 0.3, 1.0)
+        # same derived rate -> identical P; different rate -> different
+        assert P[0] == P[1] and P[2] == P[3]
+        assert P[0] != P[2]
+        # non-finite T stays NaN, mask-and-report style
+        assert np.isnan(P[5]) and np.isfinite(P[:5]).all()
+
+    def test_thermal_mode_audit_passes(self):
+        from bdlz_tpu.validation import thermal_mode_audit
+
+        audit = thermal_mode_audit(PROF, 0.3, 1.0, n_sample=8)
+        assert audit.ok, audit.reason
+        assert audit.cold_limit_bitwise is True
+        assert audit.monotonicity_defect <= 0.0
+
+
+# ---------------------------------------------------------------------------
+# config + identity rules
+# ---------------------------------------------------------------------------
+
+class TestScenarioConfig:
+    def test_valid_modes(self):
+        assert _cfg(P_chi_to_B=0.1).lz_mode == "two_channel"
+        assert _cfg(lz_mode="chain", lz_n_levels=4).lz_n_levels == 4
+        c = _cfg(lz_mode="thermal", lz_bath_eta=0.1, lz_bath_omega_c=1.0)
+        assert c.lz_bath_eta == 0.1
+
+    def test_invalid_mode_and_pairings(self):
+        with pytest.raises(ConfigError, match="lz_mode"):
+            _cfg(lz_mode="dissipative")
+        with pytest.raises(ConfigError, match="lz_n_levels"):
+            _cfg(lz_mode="chain", lz_n_levels=1)
+        with pytest.raises(ConfigError, match="lz_n_levels"):
+            _cfg(lz_n_levels=3)  # no effect without chain
+        with pytest.raises(ConfigError, match="lz_bath"):
+            _cfg(lz_bath_eta=0.1)  # no effect without thermal
+        with pytest.raises(ConfigError, match="omega_c"):
+            # η > 0 with no cutoff: Γ ≡ 0 — a silently-coherent "bath"
+            _cfg(lz_mode="thermal", lz_bath_eta=0.1, lz_bath_omega_c=0.0)
+
+    def test_scenario_fields_excluded_from_config_identity(self):
+        # single-home rule: the knobs must NOT enter the shared config
+        # payload (they join via the lz_scenario key instead), so legacy
+        # refcache/checkpoint identities stay byte-stable
+        a = _cfg(P_chi_to_B=0.1)
+        b = validate(dataclasses.replace(
+            a, lz_mode="chain", lz_n_levels=5
+        ), backend="tpu")
+        assert config_identity_dict(a) == config_identity_dict(b)
+
+    def test_scenario_fields_excluded_from_static_payload(self):
+        from bdlz_tpu.provenance.identity import static_payload
+
+        sa = static_choices_from_config(_cfg(P_chi_to_B=0.1))
+        sb = sa._replace(lz_mode="thermal", lz_bath_eta=0.2,
+                         lz_bath_omega_c=1.0)
+        assert static_payload(sa) == static_payload(sb)
+
+    def test_scenario_identity_single_home(self):
+        from bdlz_tpu.parallel.sweep import engine_identity_extra
+
+        s2 = static_choices_from_config(_cfg(P_chi_to_B=0.1))
+        assert scenario_identity(s2) is None          # omit-at-default
+        sc = static_choices_from_config(_cfg(lz_mode="chain",
+                                             lz_n_levels=3))
+        assert scenario_identity(sc) == {"mode": "chain", "n_levels": 3}
+        st = static_choices_from_config(_cfg(
+            lz_mode="thermal", lz_bath_eta=0.1, lz_bath_omega_c=2.0
+        ))
+        assert scenario_identity(st) == {
+            "mode": "thermal", "eta": 0.1, "omega_c": 2.0
+        }
+        # engine_identity_extra folds it in (and stays empty at default,
+        # keeping every pre-existing manifest hash byte-stable)
+        assert "lz_scenario" not in engine_identity_extra(s2, "tabulated")
+        extra = engine_identity_extra(sc, "tabulated")
+        assert extra["lz_scenario"] == {"mode": "chain", "n_levels": 3}
+
+    def test_scenario_dispatch_contract(self):
+        s2 = static_choices_from_config(_cfg(P_chi_to_B=0.1))
+        with pytest.raises(ValueError, match="two-channel"):
+            scenario_probabilities_for_points(PROF, s2, [0.3])
+        st = static_choices_from_config(_cfg(
+            lz_mode="thermal", lz_bath_eta=0.1, lz_bath_omega_c=1.0
+        ))
+        with pytest.raises(ValueError, match="T_p_GeV"):
+            scenario_probabilities_for_points(PROF, st, [0.3])
+
+    def test_chain_dispatch_matches_kernel(self):
+        from bdlz_tpu.lz.chain import chain_probabilities_for_points
+
+        sc = static_choices_from_config(_cfg(lz_mode="chain",
+                                             lz_n_levels=3))
+        v = np.linspace(0.2, 0.6, 5)
+        assert np.array_equal(
+            scenario_probabilities_for_points(PROF, sc, v),
+            chain_probabilities_for_points(PROF, v, 3),
+        )
+
+
+class TestPTableN:
+    def test_table_matches_direct_chain_and_layout(self):
+        from bdlz_tpu.lz.chain import chain_populations_for_speeds
+        from bdlz_tpu.lz.sweep_bridge import eval_P_table_n, make_P_table_n
+
+        tab = make_P_table_n(PROF, 3, 0.1, 0.9, n=512)
+        assert tab.n_levels == 3 and tab.values.shape == (512, 3)
+        for v in (0.15, 0.4, 0.82):
+            got = np.asarray(eval_P_table_n(v, tab, np))
+            ref = chain_populations_for_speeds(PROF, [v], 3)[0]
+            assert got.shape == (3,)
+            # cubic interpolation on the dense 1/v grid
+            assert np.abs(got - ref).max() < 5e-4
+
+    def test_table_contract(self):
+        from bdlz_tpu.lz.sweep_bridge import make_P_table_n
+
+        with pytest.raises(ValueError, match="v_lo"):
+            make_P_table_n(PROF, 3, 0.9, 0.1)
+        with pytest.raises(ValueError, match="nodes"):
+            make_P_table_n(PROF, 3, 0.1, 0.9, n=4)
+
+
+# ---------------------------------------------------------------------------
+# sweep integration
+# ---------------------------------------------------------------------------
+
+class TestScenarioSweep:
+    AXES = {"v_w": np.linspace(0.2, 0.6, 6)}
+
+    def _run(self, cfg, out_dir=None, **kw):
+        from bdlz_tpu.parallel import run_sweep
+
+        static = static_choices_from_config(cfg)
+        return run_sweep(
+            cfg, dict(self.AXES), static, mesh=None, chunk_size=8,
+            n_y=400, out_dir=out_dir, keep_outputs=True, **kw
+        )
+
+    def test_chain_sweep_runs_and_hashes_apart(self, tmp_path):
+        cfg = _cfg(lz_mode="chain", lz_n_levels=3, P_chi_to_B=0.1)
+        res3 = self._run(cfg, out_dir=str(tmp_path / "n3"), lz_profile=PROF)
+        assert res3.n_failed == 0
+        cfg4 = _cfg(lz_mode="chain", lz_n_levels=4, P_chi_to_B=0.1)
+        res4 = self._run(cfg4, out_dir=str(tmp_path / "n4"),
+                         lz_profile=PROF)
+        coh = self._run(
+            _cfg(P_chi_to_B=0.1), out_dir=str(tmp_path / "coh"),
+            lz_profile=PROF, lz_method="coherent",
+        )
+        hashes = [
+            json.load(open(tmp_path / d / "manifest.json"))["hash"]
+            for d in ("n3", "n4", "coh")
+        ]
+        # the resolved scenario joins the manifest hash: N=3, N=4 and
+        # two-channel-coherent sweeps can never splice on resume
+        assert len(set(hashes)) == 3
+        # and different physics really flowed through the pipeline
+        assert not np.array_equal(
+            res3.outputs["DM_over_B"], coh.outputs["DM_over_B"]
+        )
+        assert not np.array_equal(
+            res3.outputs["DM_over_B"], res4.outputs["DM_over_B"]
+        )
+
+    def test_chain_n2_sweep_tracks_coherent(self, tmp_path):
+        # N=2 P agrees with coherent to <=1e-12, so the yields do too
+        # (smoothly) — the end-to-end expression of the reduction pin
+        cfg = _cfg(lz_mode="chain", lz_n_levels=2, P_chi_to_B=0.1)
+        res2 = self._run(cfg, lz_profile=PROF)
+        coh = self._run(_cfg(P_chi_to_B=0.1), lz_profile=PROF,
+                        lz_method="coherent")
+        np.testing.assert_allclose(
+            res2.outputs["DM_over_B"], coh.outputs["DM_over_B"],
+            rtol=1e-8,
+        )
+
+    def test_thermal_sweep_derives_per_point_rate(self):
+        from bdlz_tpu.lz.thermal import thermal_probabilities_for_points
+
+        cfg = _cfg(lz_mode="thermal", lz_bath_eta=0.3,
+                   lz_bath_omega_c=1.0, P_chi_to_B=0.1, T_p_GeV=80.0)
+        res = self._run(cfg, lz_profile=PROF)
+        assert res.n_failed == 0
+        # the same points through a hotter bath give different yields
+        hot = _cfg(lz_mode="thermal", lz_bath_eta=0.6,
+                   lz_bath_omega_c=1.0, P_chi_to_B=0.1, T_p_GeV=80.0)
+        res_hot = self._run(hot, lz_profile=PROF)
+        assert not np.array_equal(
+            res.outputs["DM_over_B"], res_hot.outputs["DM_over_B"]
+        )
+        # and the derivation really is the thermal kernel's
+        P_direct = thermal_probabilities_for_points(
+            PROF, self.AXES["v_w"], 80.0, 0.3, 1.0
+        )
+        assert np.isfinite(P_direct).all()
+
+    def test_scenario_requires_profile_and_forbids_gamma(self):
+        cfg = _cfg(lz_mode="chain", lz_n_levels=3, P_chi_to_B=0.1)
+        with pytest.raises(ValueError, match="bounce"):
+            self._run(cfg)
+        with pytest.raises(ValueError, match="lz_gamma_phi"):
+            self._run(cfg, lz_profile=PROF, lz_method="dephased",
+                      lz_gamma_phi=0.5)
+        # an explicit non-default estimator is a discarded choice, not
+        # a no-op — library callers get the same loud contract the
+        # CLIs enforce at the flag layer
+        with pytest.raises(ValueError, match="owns the kernel"):
+            self._run(cfg, lz_profile=PROF, lz_method="coherent")
+
+
+# ---------------------------------------------------------------------------
+# emulator build -> registry publish -> fleet query (the round-trip)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def chain_emulator(tmp_path_factory, jit_warmup):
+    """A tiny chain-mode (N = 3) emulator box over (m_chi, v_w)."""
+    from bdlz_tpu.emulator import AxisSpec, build_emulator
+
+    base = _cfg(lz_mode="chain", lz_n_levels=3, P_chi_to_B=0.1)
+    spec = {
+        "m_chi_GeV": AxisSpec(0.9, 1.1, 2, "log"),
+        "v_w": AxisSpec(0.25, 0.35, 3, "lin"),
+    }
+    out = str(tmp_path_factory.mktemp("chain_emu") / "artifact")
+    artifact, report = build_emulator(
+        base, spec, rtol=1e-2, n_probe=4, n_holdout=8, max_rounds=1,
+        n_y=400, chunk_size=64, out_dir=out, require_converged=False,
+        lz_profile=PROF,
+    )
+    return base, out, artifact, report
+
+
+@pytest.fixture(scope="module")
+def thermal_emulator(tmp_path_factory):
+    """A tiny thermal-mode emulator box over (T_p, v_w)."""
+    from bdlz_tpu.emulator import AxisSpec, build_emulator
+
+    base = _cfg(lz_mode="thermal", lz_bath_eta=0.3, lz_bath_omega_c=1.0,
+                P_chi_to_B=0.1)
+    spec = {
+        "T_p_GeV": AxisSpec(90.0, 110.0, 2, "log"),
+        "v_w": AxisSpec(0.25, 0.35, 2, "lin"),
+    }
+    out = str(tmp_path_factory.mktemp("thermal_emu") / "artifact")
+    artifact, report = build_emulator(
+        base, spec, rtol=1e-2, n_probe=4, n_holdout=8, max_rounds=1,
+        n_y=400, chunk_size=64, out_dir=out, require_converged=False,
+        lz_profile=PROF,
+    )
+    return base, out, artifact, report
+
+
+class TestEmulatorScenario:
+    def test_identity_carries_scenario_and_profile(self, chain_emulator):
+        _, _, artifact, _ = chain_emulator
+        ident = dict(artifact.identity)
+        assert ident["lz_scenario"] == {"mode": "chain", "n_levels": 3}
+        assert ident["lz_profile"] == profile_fingerprint(PROF)
+
+    def test_build_contract_errors(self):
+        from bdlz_tpu.emulator import AxisSpec, build_emulator
+        from bdlz_tpu.emulator.build import EmulatorBuildError
+
+        base = _cfg(lz_mode="chain", lz_n_levels=3, P_chi_to_B=0.1)
+        spec = {"v_w": AxisSpec(0.25, 0.35, 2, "lin")}
+        with pytest.raises(EmulatorBuildError, match="bounce"):
+            build_emulator(base, spec, max_rounds=0, n_y=400,
+                           require_converged=False)
+        with pytest.raises(EmulatorBuildError, match="P_chi_to_B"):
+            build_emulator(
+                base,
+                {**spec, "P_chi_to_B": AxisSpec(0.1, 0.2, 2, "lin")},
+                max_rounds=0, n_y=400, require_converged=False,
+                lz_profile=PROF,
+            )
+        two = _cfg(P_chi_to_B=0.1)
+        with pytest.raises(EmulatorBuildError, match="lz_profile"):
+            build_emulator(two, spec, max_rounds=0, n_y=400,
+                           require_converged=False, lz_profile=PROF)
+
+    def test_load_round_trip_keeps_scenario(self, chain_emulator):
+        from bdlz_tpu.emulator import load_any_artifact
+
+        _, out, artifact, _ = chain_emulator
+        loaded = load_any_artifact(out)
+        assert dict(loaded.identity)["lz_scenario"] == {
+            "mode": "chain", "n_levels": 3
+        }
+        assert loaded.content_hash == artifact.content_hash
+
+    def test_emulator_values_match_scenario_exact(self, chain_emulator):
+        # the surface really was populated from chain-mode physics:
+        # re-deriving one grid node exactly through the scenario
+        # evaluator reproduces the stored value
+        from bdlz_tpu.emulator.build import make_exact_evaluator
+
+        base, _, artifact, _ = chain_emulator
+        static = static_choices_from_config(base)
+        ev = make_exact_evaluator(
+            base, static, n_y=400, impl="tabulated", chunk_size=16,
+            lz_profile=PROF,
+        )
+        i, j = 1, 2
+        axes = {
+            "m_chi_GeV": np.asarray([artifact.axis_nodes[0][i]]),
+            "v_w": np.asarray([artifact.axis_nodes[1][j]]),
+        }
+        got = ev(axes)["DM_over_B"][0]
+        # rel 1e-8, not bitwise: the build ran at chunk_size=64 and this
+        # evaluator at 16 — different padded chunk shapes shift XLA
+        # fusion by ulps (plus the documented ~3e-9 first-jit wobble);
+        # a cross-mode value would be off at the 1e-2 level
+        assert got == pytest.approx(
+            float(artifact.values["DM_over_B"][i, j]), rel=1e-8
+        )
+
+
+class TestRegistryAndFleetRoundTrip:
+    def _drain_one(self, fleet, theta, lz_mode=None):
+        point = dict(theta)
+        if lz_mode is not None:
+            point["lz_mode"] = lz_mode
+        fut = fleet.submit(fleet.theta_from_mapping(point))
+        fleet.run_once(force=True)
+        fleet.poll(block=True)
+        return fut.result(timeout=5)
+
+    @pytest.mark.parametrize("which", ["chain", "thermal"])
+    def test_publish_fetch_fleet_round_trip(
+        self, which, chain_emulator, thermal_emulator, tmp_path
+    ):
+        from bdlz_tpu.provenance import Store, fetch_artifact, publish_artifact
+        from bdlz_tpu.serve.fleet import FleetService
+
+        base, _, artifact, _ = (
+            chain_emulator if which == "chain" else thermal_emulator
+        )
+        store = Store(str(tmp_path / "store"))
+        h = publish_artifact(store, artifact)
+        fetched = fetch_artifact(store, h)
+        assert dict(fetched.identity)["lz_scenario"]["mode"] == which
+
+        fleet = FleetService(
+            fetched, base, n_replicas=2, max_batch_size=8,
+            lz_profile=PROF, error_gate_tol=False, warm=True,
+        )
+        try:
+            assert fleet.lz_mode == which
+            assert fleet.expected_identity["lz_scenario"]["mode"] == which
+            mid = {
+                n: float(np.sqrt(nodes[0] * nodes[-1]))
+                for n, nodes in zip(artifact.axis_names,
+                                    artifact.axis_nodes)
+            }
+            # a request STATING the mode is accepted and answered with
+            # the mode stamped on the response
+            resp = self._drain_one(fleet, mid, lz_mode=which)
+            assert np.isfinite(resp.value)
+            assert resp.lz_mode == which
+            assert resp.artifact_hash == h
+            assert resp.fallback_reason is None
+            # out-of-domain: the exact fallback derives P from the
+            # profile through the scenario evaluator
+            ood = dict(mid)
+            ood["v_w"] = 0.6
+            resp_ood = self._drain_one(fleet, ood)
+            assert resp_ood.fallback_reason == "ood"
+            assert np.isfinite(resp_ood.value)
+            assert resp_ood.lz_mode == which
+            # EVERY stats row names the mode (the acceptance pin)
+            rows = fleet.stats.as_rows()
+            assert rows and all(r["lz_mode"] == which for r in rows)
+        finally:
+            fleet.close()
+
+    def test_yield_service_rows_carry_mode(self, chain_emulator):
+        from bdlz_tpu.serve.service import YieldService
+
+        base, _, artifact, _ = chain_emulator
+        svc = YieldService(
+            artifact, base, max_batch_size=4, warm=False,
+            lz_profile=PROF, error_gate_tol=False,
+        )
+        assert svc.lz_mode == "chain"
+        batcher = svc.make_batcher(clock=lambda: 0.0)
+        theta = svc.theta_from_mapping({
+            "m_chi_GeV": 1.0, "v_w": 0.3, "lz_mode": "chain",
+        })
+        fut = batcher.submit(theta)
+        batcher.run_once(force=True)
+        assert np.isfinite(fut.result(timeout=5))
+        rows = svc.stats.as_rows()
+        assert rows and all(r["lz_mode"] == "chain" for r in rows)
+
+
+class TestCrossModeSkewRejection:
+    def test_service_rejects_cross_mode_base(self, chain_emulator):
+        from bdlz_tpu.emulator.artifact import EmulatorArtifactError
+        from bdlz_tpu.serve.service import YieldService
+
+        _, _, artifact, _ = chain_emulator
+        two = _cfg(P_chi_to_B=0.1)
+        with pytest.raises(EmulatorArtifactError, match="lz_scenario"):
+            YieldService(artifact, two, warm=False, lz_profile=PROF)
+
+    def test_service_rejects_wrong_scenario_params(self, chain_emulator):
+        from bdlz_tpu.emulator.artifact import EmulatorArtifactError
+        from bdlz_tpu.serve.service import YieldService
+
+        _, _, artifact, _ = chain_emulator
+        other = _cfg(lz_mode="chain", lz_n_levels=4, P_chi_to_B=0.1)
+        with pytest.raises(EmulatorArtifactError, match="lz_scenario"):
+            YieldService(artifact, other, warm=False, lz_profile=PROF)
+
+    def test_two_channel_artifact_rejects_scenario_consumer(
+        self, tiny_emulator
+    ):
+        from bdlz_tpu.emulator.artifact import EmulatorArtifactError
+        from bdlz_tpu.serve.service import YieldService
+
+        base, _, artifact, _ = tiny_emulator
+        chain_base = validate(dataclasses.replace(
+            base, lz_mode="chain", lz_n_levels=3
+        ), backend="tpu")
+        with pytest.raises(EmulatorArtifactError, match="lz_scenario"):
+            YieldService(artifact, chain_base, warm=False, lz_profile=PROF)
+
+    def test_request_mode_skew_rejected(self, chain_emulator):
+        from bdlz_tpu.serve.service import theta_from_mapping
+
+        _, _, artifact, _ = chain_emulator
+        with pytest.raises(ValueError, match="cross-mode"):
+            theta_from_mapping(
+                artifact,
+                {"m_chi_GeV": 1.0, "v_w": 0.3, "lz_mode": "two_channel"},
+            )
+
+    def test_profile_contract(self, chain_emulator, tiny_emulator):
+        from bdlz_tpu.serve.service import resolve_service_profile
+
+        _, _, chain_art, _ = chain_emulator
+        # scenario artifact without a profile: loud
+        with pytest.raises(ValueError, match="bounce profile"):
+            resolve_service_profile(chain_art, None)
+        # wrong profile: fingerprint skew is loud
+        other = BounceProfile(
+            xi=XI, delta=-0.1 * np.tanh(XI / 4.0),
+            mix=np.full_like(XI, 0.02),
+        )
+        with pytest.raises(ValueError, match="fingerprint"):
+            resolve_service_profile(chain_art, other)
+        # two-channel artifact with a profile: a caller error, not a
+        # no-op
+        _, _, two_art, _ = tiny_emulator
+        with pytest.raises(ValueError, match="two-channel"):
+            resolve_service_profile(two_art, PROF)
+
+
+# ---------------------------------------------------------------------------
+# CLI surface (lz/options.py — the deduped flag helper + scenario flags)
+# ---------------------------------------------------------------------------
+
+class TestSharedCliOptions:
+    def _args(self, **kw):
+        import argparse
+
+        from bdlz_tpu.lz.options import (
+            SWEEP_METHODS,
+            add_lz_method_flags,
+            add_lz_scenario_flags,
+        )
+
+        ap = argparse.ArgumentParser()
+        add_lz_method_flags(ap, default="local", choices=SWEEP_METHODS,
+                            method_help="m")
+        add_lz_scenario_flags(ap)
+        argv = []
+        for k, v in kw.items():
+            argv += [f"--{k.replace('_', '-')}", str(v)]
+        return ap.parse_args(argv)
+
+    def test_gamma_pairing_preserved(self):
+        from bdlz_tpu.lz.options import lz_flags_error
+
+        assert lz_flags_error(self._args()) is None
+        err = lz_flags_error(self._args(lz_gamma_phi=0.5),
+                             default_method="local")
+        assert "dephased" in err
+        err = lz_flags_error(self._args(lz_gamma_phi=-1.0))
+        assert ">= 0" in err
+
+    def test_scenario_pairings(self):
+        from bdlz_tpu.lz.options import lz_flags_error
+
+        ok = self._args(lz_mode="chain", lz_n_levels=3)
+        assert lz_flags_error(ok, default_method="local") is None
+        err = lz_flags_error(
+            self._args(lz_mode="chain", lz_method="coherent"),
+            default_method="local",
+        )
+        assert "owns the kernel" in err
+        err = lz_flags_error(
+            self._args(lz_mode="thermal", lz_gamma_phi=0.5),
+            default_method="local",
+        )
+        assert "derives its own" in err
+        err = lz_flags_error(self._args(lz_n_levels=3),
+                             default_method="local")
+        assert "--lz-mode chain" in err
+        err = lz_flags_error(self._args(lz_bath_eta=0.1),
+                             default_method="local")
+        assert "--lz-mode thermal" in err
+
+    def test_apply_scenario_flags_overrides_config(self):
+        from bdlz_tpu.lz.options import apply_scenario_flags
+
+        cfg = _cfg(P_chi_to_B=0.1)
+        out = apply_scenario_flags(
+            cfg, self._args(lz_mode="chain", lz_n_levels=4)
+        )
+        assert out.lz_mode == "chain" and out.lz_n_levels == 4
+        # no flags = untouched config object (reference-shaped runs)
+        assert apply_scenario_flags(cfg, self._args()) is cfg
+        # an invalid combination surfaces as the config's own error
+        with pytest.raises(ConfigError):
+            apply_scenario_flags(cfg, self._args(lz_mode="thermal",
+                                                 lz_bath_eta=0.1))
+
+
+class TestScenarioCli:
+    def test_sweep_cli_chain(self, tmp_path, capsys):
+        from bdlz_tpu import sweep_cli
+
+        cfg_path = tmp_path / "cfg.json"
+        cfg_path.write_text(json.dumps({**PHYS, "P_chi_to_B": 0.1}))
+        prof_path = _write_profile_csv(tmp_path / "prof.csv")
+        sweep_cli.main([
+            "--config", str(cfg_path),
+            "--axis", "v_w=lin:0.2:0.5:4",
+            "--chunk", "4", "--n-y", "400",
+            "--lz-profile", prof_path,
+            "--lz-mode", "chain", "--lz-n-levels", "3",
+        ])
+        out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert out["lz_mode"] == "chain"
+        assert out["n_points"] == 4 and out["n_failed"] == 0
+
+    def test_sweep_cli_scenario_needs_profile(self, tmp_path):
+        from bdlz_tpu import sweep_cli
+
+        cfg_path = tmp_path / "cfg.json"
+        cfg_path.write_text(json.dumps(
+            {**PHYS, "P_chi_to_B": 0.1, "lz_mode": "chain",
+             "lz_n_levels": 3}
+        ))
+        with pytest.raises(SystemExit, match="bounce"):
+            sweep_cli.main([
+                "--config", str(cfg_path),
+                "--axis", "v_w=lin:0.2:0.5:4",
+            ])
+
+    def test_mcmc_cli_thermal_pinned_vw(self, tmp_path, capsys):
+        # pinned wall speed: the scenario P resolves host-side and the
+        # sampler runs on the pinned config — the cheap scenario path
+        from bdlz_tpu import mcmc_cli
+
+        cfg_path = tmp_path / "cfg.json"
+        cfg_path.write_text(json.dumps(
+            {**PHYS, "P_chi_to_B": 0.1, "v_w": 0.3, "T_p_GeV": 100.0}
+        ))
+        prof_path = _write_profile_csv(tmp_path / "prof.csv")
+        mcmc_cli.main([
+            "--config", str(cfg_path),
+            "--param", "m_chi_GeV=0.9:1.1",
+            "--walkers", "16", "--steps", "6", "--burn", "2",
+            "--lz-profile", prof_path,
+            "--lz-mode", "thermal", "--lz-bath-eta", "0.3",
+            "--lz-bath-omega-c", "1.0",
+        ])
+        out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert out["lz"]["mode"] == "thermal"
+        assert out["lz"]["scenario"] == {
+            "mode": "thermal", "eta": 0.3, "omega_c": 1.0
+        }
+        assert "method" not in out["lz"]
+
+    def test_point_cli_rejects_scenario_config(self, tmp_path, capsys):
+        # the single-point CLI has no scenario path: a chain/thermal
+        # config must refuse loudly, never silently derive P under the
+        # two-channel kernel
+        from bdlz_tpu import cli
+
+        cfg_path = tmp_path / "cfg.json"
+        cfg_path.write_text(json.dumps(
+            {**PHYS, "P_chi_to_B": 0.1, "lz_mode": "chain",
+             "lz_n_levels": 4}
+        ))
+        prof_path = _write_profile_csv(tmp_path / "prof.csv")
+        with pytest.raises(SystemExit) as exc:
+            cli.main([
+                "--config", str(cfg_path),
+                "--maybe-compute-P-from-profile", prof_path,
+                "--lz-method", "coherent",
+            ])
+        assert exc.value.code == 2
+        assert "two-channel kernel only" in capsys.readouterr().err
+
+    def test_mcmc_cli_scenario_forbids_gamma_sampling(self, tmp_path):
+        from bdlz_tpu import mcmc_cli
+
+        cfg_path = tmp_path / "cfg.json"
+        cfg_path.write_text(json.dumps(
+            {**PHYS, "P_chi_to_B": 0.1, "v_w": 0.3}
+        ))
+        prof_path = _write_profile_csv(tmp_path / "prof.csv")
+        with pytest.raises(SystemExit, match="lz_gamma_phi"):
+            mcmc_cli.main([
+                "--config", str(cfg_path),
+                "--param", "v_w=0.2:0.4",
+                "--param", "lz_gamma_phi=0.0:1.0",
+                "--walkers", "16", "--steps", "4", "--burn", "0",
+                "--lz-profile", prof_path,
+                "--lz-mode", "chain", "--lz-n-levels", "3",
+            ])
