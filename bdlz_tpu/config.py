@@ -244,6 +244,33 @@ class Config:
     lz_n_levels: int = 2
     lz_bath_eta: float = 0.0
     lz_bath_omega_c: float = 0.0
+    # ---- MCMC sampler knobs (bdlz_tpu/sampling/nuts.py, mcmc_cli;
+    # docs/perf_notes.md "Gradient-based inference").  Which sampler
+    # explores the Planck posterior and how NUTS adapts:
+    #   sampler       — "stretch" (the affine-invariant default, bit-
+    #                   stable against every existing chain) or "nuts"
+    #                   (gradient-based No-U-Turn, orders of magnitude
+    #                   better ESS per pipeline evaluation);
+    #   mass_matrix   — NUTS warmup metric: "diag" variances or "dense"
+    #                   covariance (aligns the thin curved Planck ridge);
+    #   target_accept — NUTS dual-averaging acceptance target.
+    # Identity rule: excluded from the shared config payload
+    # (SAMPLER_CONFIG_FIELDS below) — the sampler cannot stale sweep
+    # manifests or emulator artifacts it never touches; its single
+    # identity home is the MCMC checkpoint identity, which mcmc_cli
+    # joins with the RESOLVED sampler (omit-at-default: stretch chains
+    # keep their hashes, a sampler flip invalidates resume loudly).
+    sampler: str = "stretch"
+    mass_matrix: str = "diag"
+    target_accept: float = 0.8
+    # Emulator refinement signal (emulator/build.py): None = the legacy
+    # axis-local |f''| curvature criterion; "fisher" = gradient-aware —
+    # exact-pipeline Jacobians at failing probes attribute each error to
+    # the axis whose resolution causes it (sampling/grad.py), reaching
+    # the same held-out tolerance with fewer exact evaluations.  Node
+    # placement depends on it, so its identity home is the artifact's
+    # own refine_signal key (build_identity), like posterior_weight.
+    refine_signal: Optional[str] = None
 
 
 def default_config() -> Dict[str, Any]:
@@ -346,10 +373,33 @@ CACHE_CONFIG_FIELDS = ("cache_enabled", "cache_root")
 #:   ``posterior_weight`` key (``emulator.artifact.build_identity``),
 #:   mirroring ``quad_panel_gl`` — folding it into the shared config
 #:   payload would also stale sweep/MCMC identities it cannot touch.
-EMULATOR_CONFIG_FIELDS = ("seam_split", "error_gate_tol", "posterior_weight")
+#: ``refine_signal`` rides this list for the same single-home reason as
+#: ``posterior_weight``: it changes artifact BYTES (node placement) but
+#: its identity home is the artifact's own ``refine_signal`` key.
+EMULATOR_CONFIG_FIELDS = (
+    "seam_split", "error_gate_tol", "posterior_weight", "refine_signal",
+)
 
 #: Valid values of the ``posterior_weight`` knob (None = off).
 VALID_POSTERIOR_WEIGHTS = ("planck",)
+
+#: Valid values of the ``refine_signal`` knob (None = legacy curvature).
+VALID_REFINE_SIGNALS = ("fisher",)
+
+#: Valid MCMC samplers (mcmc_cli / sampling layer).
+VALID_SAMPLERS = ("stretch", "nuts")
+VALID_MASS_MATRICES = ("diag", "dense")
+
+#: MCMC sampler knobs, excluded from the shared config identity payload
+#: deliberately (pinned in tests/test_config.py): the sampler explores a
+#: posterior — it cannot change what a sweep computes or what an
+#: emulator artifact contains, so folding it into config identities
+#: would stale manifests/artifacts it never touches.  Its single
+#: identity home is the MCMC checkpoint identity: ``mcmc_cli`` passes
+#: the RESOLVED sampler spec to ``provenance.mcmc_segment_identity``
+#: (omit-at-default — every existing stretch chain keeps its hash, and
+#: flipping the sampler invalidates resume loudly, the PR-7 pattern).
+SAMPLER_CONFIG_FIELDS = ("sampler", "mass_matrix", "target_accept")
 
 #: Valid LZ scenario modes (docs/scenarios.md).
 VALID_LZ_MODES = ("two_channel", "chain", "thermal")
@@ -391,6 +441,7 @@ def config_identity_dict(cfg: Config) -> Dict[str, Any]:
             or k in CACHE_CONFIG_FIELDS
             or k in EMULATOR_CONFIG_FIELDS
             or k in SCENARIO_CONFIG_FIELDS
+            or k in SAMPLER_CONFIG_FIELDS
         ):
             continue
         if k in RESULT_AFFECTING_EXTENSIONS or getattr(cfg, k) != defaults[k]:
@@ -486,6 +537,27 @@ def validate(cfg: Config, backend: Optional[str] = None) -> Config:
         raise ConfigError(
             f"posterior_weight={cfg.posterior_weight!r} is not one of "
             f"{VALID_POSTERIOR_WEIGHTS} (or null)"
+        )
+    if cfg.refine_signal is not None and (
+        cfg.refine_signal not in VALID_REFINE_SIGNALS
+    ):
+        raise ConfigError(
+            f"refine_signal={cfg.refine_signal!r} is not one of "
+            f"{VALID_REFINE_SIGNALS} (or null = curvature)"
+        )
+    if cfg.sampler not in VALID_SAMPLERS:
+        raise ConfigError(
+            f"sampler={cfg.sampler!r} is not one of {VALID_SAMPLERS}"
+        )
+    if cfg.mass_matrix not in VALID_MASS_MATRICES:
+        raise ConfigError(
+            f"mass_matrix={cfg.mass_matrix!r} is not one of "
+            f"{VALID_MASS_MATRICES}"
+        )
+    if not (0.0 < float(cfg.target_accept) < 1.0):
+        raise ConfigError(
+            f"target_accept must be a fraction in (0, 1), got "
+            f"{cfg.target_accept!r}"
         )
     if cfg.retry_max_attempts < 1:
         raise ConfigError("retry_max_attempts must be >= 1")

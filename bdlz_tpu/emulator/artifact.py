@@ -113,6 +113,7 @@ def build_identity(
     base, static, n_y: int, impl: str,
     posterior_weight: "str | None" = None,
     lz_profile_fp: "str | None" = None,
+    refine_signal: "str | None" = None,
 ) -> Dict[str, Any]:
     """The physics identity an artifact is valid for.
 
@@ -182,6 +183,13 @@ def build_identity(
         out["quad_panel_gl"] = bool(quad)
     if posterior_weight is not None:
         out["posterior_weight"] = str(posterior_weight)
+    if refine_signal is None:
+        refine_signal = getattr(base, "refine_signal", None)
+    if refine_signal is not None:
+        # the Fisher-aware refinement signal moves nodes exactly like a
+        # posterior weighting: same single-home omit-at-default key,
+        # same wildcard rule in check_identity
+        out["refine_signal"] = str(refine_signal)
     scen = scenario_identity(static)
     if scen is not None:
         out["lz_scenario"] = scen
@@ -483,6 +491,11 @@ def check_identity(
         stored.pop("quad_panel_gl", None)
     if "posterior_weight" not in want:
         stored.pop("posterior_weight", None)
+    if "refine_signal" not in want:
+        # wildcard like posterior_weight: the signal steers node
+        # placement during the build, never what the exact engine
+        # computes — a caller with no expectation matches either
+        stored.pop("refine_signal", None)
     if "lz_profile" not in want:
         stored.pop("lz_profile", None)
     sb = dict(stored.get("base", {}))

@@ -45,6 +45,14 @@ from bdlz_tpu.emulator.grid import axis_coord, interp_log_fields
 
 VALID_SCALES = ("lin", "log")
 
+#: The fields the Fisher-aware signal compares gradients of, in the
+#: order ``sampling.grad.make_field_log10_jacobian`` emits them.  The
+#: other stored fields are affine images of these in log-space (Y_B,
+#: Y_chi are fixed rescalings; DM_over_B is their difference), so their
+#: gradient mismatch is bounded by these two — no information is lost
+#: by not differentiating all five.
+_GRAD_FIELDS = ["rho_B_kg_m3", "rho_DM_kg_m3"]
+
 #: Node spacing below which a midpoint insert is refused (relative to
 #: the axis span): past this the surface error is not interpolation-
 #: limited and further splitting just burns sweep evaluations.
@@ -91,6 +99,15 @@ class BuildReport(NamedTuple):
     #: ``weighted_max_rel_err`` is the held-out error under the weight.
     posterior_weight: "str | None" = None
     weighted_max_rel_err: "float | None" = None
+    #: The probe-split attribution signal (None = the legacy axis-local
+    #: |f''| stencil; "fisher" = exact-pipeline gradient mismatch — see
+    #: ``build_emulator``).  ``n_grad_evals`` counts the reverse-mode
+    #: pipeline Jacobians the fisher signal paid (they are NOT exact
+    #: point evaluations and are billed separately on purpose: the
+    #: acceptance comparison is on ``n_exact_evals`` with the gradient
+    #: bill in plain sight).
+    refine_signal: "str | None" = None
+    n_grad_evals: int = 0
 
 
 def _axis_nodes(spec: AxisSpec) -> np.ndarray:
@@ -465,6 +482,87 @@ def _curvature_scores(
     return scores
 
 
+def _interp_grad_at(
+    log_values: Dict[str, np.ndarray],
+    axis_nodes: List[np.ndarray],
+    axis_scales: List[str],
+    probe: np.ndarray,
+) -> np.ndarray:
+    """Gradient of the INTERPOLANT at one probe, (n_fields, d), in each
+    axis's scale coordinate.
+
+    ∂/∂u_k of the multilinear surface = (face value difference)/Δu_k,
+    with the two faces evaluated by the same shared stencil
+    (:func:`grid.interp_log_fields`) at the probe with coordinate k
+    pinned to its bracketing nodes — so the gradient compared against
+    the exact pipeline's is exactly the served surface's, not a
+    re-derivation that could drift.
+    """
+    d = len(axis_nodes)
+    fields = list(log_values)
+    out = np.zeros((len(fields), d))
+    for k in range(d):
+        nodes = axis_nodes[k]
+        i = int(np.clip(np.searchsorted(nodes, probe[k], side="right") - 1,
+                        0, len(nodes) - 2))
+        u = axis_coord(np.asarray(nodes[[i, i + 1]]), axis_scales[k], np)
+        du = float(u[1] - u[0])
+        lo_p = probe.copy()
+        lo_p[k] = nodes[i]
+        hi_p = probe.copy()
+        hi_p[k] = nodes[i + 1]
+        lo_v = interp_log_fields(lo_p, axis_nodes, axis_scales, log_values, np)
+        hi_v = interp_log_fields(hi_p, axis_nodes, axis_scales, log_values, np)
+        for f_i, f in enumerate(fields):
+            out[f_i, k] = (float(hi_v[f]) - float(lo_v[f])) / du
+    return out
+
+
+def _fisher_axis_scores(
+    jac_exact: np.ndarray,
+    log_values: Dict[str, np.ndarray],
+    axis_nodes: List[np.ndarray],
+    axis_scales: List[str],
+    probe: np.ndarray,
+    fields: List[str],
+) -> np.ndarray:
+    """Per-axis error attribution at one failing probe, gradient-aware.
+
+    ``|∂log10f/∂u_k (exact) − ∂log10f/∂u_k (interpolant)| · h_k`` maxed
+    over fields, with ``h_k`` the probe's bracketing gap in the axis's
+    scale coordinate: a first-order bound on the log-interpolation
+    error ATTRIBUTABLE to axis k's resolution at this exact probe.  The
+    legacy signal (:func:`_curvature_scores`) can only inspect an
+    axis-local second-difference stencil at the nearest grid node — on
+    anisotropic surfaces it misattributes, and every misattributed
+    insert costs a full hyperplane of exact evaluations.  An axis whose
+    direction the surface is exactly (log-)linear in scores ~0 here and
+    is never split on a probe's account — INCLUDING 2-node axes, where
+    the legacy rule is structurally blind (no second difference exists,
+    so it scores +inf and burns a full hyperplane on the first failing
+    probe even when the surface is a pure power law along that axis;
+    the gradient field is exactly the information it lacks).  A curved
+    2-node axis is not missed systematically: a single probe can sit
+    near its cell midpoint (where the mismatch vanishes), but the pool
+    accumulates probes at fresh offsets every round, and the held-out
+    gate still vouches for the final surface.
+    """
+    d = len(axis_nodes)
+    g_emu = _interp_grad_at(log_values, axis_nodes, axis_scales, probe)
+    order = {f: i for i, f in enumerate(log_values)}
+    scores = np.zeros(d)
+    for k in range(d):
+        nodes = axis_nodes[k]
+        i = int(np.clip(np.searchsorted(nodes, probe[k], side="right") - 1,
+                        0, len(nodes) - 2))
+        u = axis_coord(np.asarray(nodes[[i, i + 1]]), axis_scales[k], np)
+        h = float(u[1] - u[0])
+        for f_i, f in enumerate(fields):
+            mismatch = abs(float(jac_exact[f_i, k]) - g_emu[order[f], k])
+            scores[k] = max(scores[k], mismatch * h)
+    return scores
+
+
 def _axis_interval_estimates(
     log_values: Dict[str, np.ndarray],
     nodes: List[np.ndarray],
@@ -643,6 +741,7 @@ def build_emulator(
     cache=None,
     seam_split: Optional[bool] = None,
     posterior_weight: Optional[str] = None,
+    refine_signal: Optional[str] = None,
     lz_profile=None,
 ) -> Tuple[EmulatorArtifact, BuildReport]:
     """Build (and optionally save) an error-controlled yield-surface emulator.
@@ -682,9 +781,24 @@ def build_emulator(
     exceed ``rtol`` by design — the persisted per-cell estimates keep
     the serving layer's error gate honest there), and the resolved
     weight name joins the artifact identity.
+
+    ``refine_signal`` ("fisher", or ``Config.refine_signal`` when None;
+    None = legacy) upgrades the PROBE-driven split attribution from the
+    axis-local |f''| stencil to the exact pipeline's gradient field
+    (:func:`bdlz_tpu.sampling.grad.make_field_log10_jacobian` — the
+    differentiable-posterior by-product): each failing probe pays one
+    reverse-mode Jacobian (billed separately as ``n_grad_evals`` on the
+    report) and splits the axis whose exact-vs-interpolant gradient
+    mismatch actually causes its error.  Second-order where the stencil
+    is axis-local: the same held-out tolerance is reached with fewer
+    exact hyperplane evaluations (A/B-pinned in tests).  Two-channel +
+    tabulated-impl only, loudly — a scenario mode derives P host-side
+    (no in-graph gradient) and the stiff/direct engines never evaluate
+    through the differentiable closure this signal uses.
     """
     from bdlz_tpu.config import (
         VALID_POSTERIOR_WEIGHTS,
+        VALID_REFINE_SIGNALS,
         static_choices_from_config,
         validate,
     )
@@ -712,6 +826,15 @@ def build_emulator(
         raise EmulatorBuildError(
             f"posterior_weight={pw!r} is not one of "
             f"{VALID_POSTERIOR_WEIGHTS} (or None)"
+        )
+    rs = (
+        refine_signal if refine_signal is not None
+        else getattr(base, "refine_signal", None)
+    )
+    if rs is not None and rs not in VALID_REFINE_SIGNALS:
+        raise EmulatorBuildError(
+            f"refine_signal={rs!r} is not one of "
+            f"{VALID_REFINE_SIGNALS} (or None = curvature)"
         )
     # LZ scenario plane (docs/scenarios.md): a chain/thermal mode builds
     # the surface over profile-derived per-point P, so the profile is
@@ -762,7 +885,7 @@ def build_emulator(
             impl=impl, chunk_size=chunk_size, mesh=mesh,
             require_converged=require_converged, fault_plan=fault_plan,
             retry=retry, cache=cache, posterior_weight=pw,
-            lz_profile=lz_profile,
+            refine_signal=rs, lz_profile=lz_profile,
         )
     # Engine resolution mirrors run_sweep, and is done HERE (once) so the
     # product population, the probe evaluations, and the artifact identity
@@ -818,6 +941,33 @@ def build_emulator(
         audit_grid, static, impl, n_y, label="emulator",
     )
     static = static._replace(quad_panel_gl=quad_on)
+
+    # --- Fisher-aware refinement signal (gradient layer by-product) ---
+    field_jac = None
+    n_grad_evals = 0
+    if rs == "fisher":
+        if lz_mode != "two_channel":
+            raise EmulatorBuildError(
+                f"refine_signal='fisher' needs the differentiable "
+                f"two-channel path; lz_mode={lz_mode!r} derives P "
+                "host-side per point (no in-graph gradient — a silent "
+                "zero would mis-steer every split)"
+            )
+        if impl != "tabulated":
+            raise EmulatorBuildError(
+                f"refine_signal='fisher' differentiates the tabulated "
+                f"fast path; the resolved engine is impl={impl!r} "
+                "(I_p axes and stiff configs keep the curvature signal)"
+            )
+        import jax.numpy as jnp
+
+        from bdlz_tpu.ops.kjma_table import make_f_table
+        from bdlz_tpu.sampling.grad import make_field_log10_jacobian
+
+        field_jac = make_field_log10_jacobian(
+            base, static, make_f_table(float(base.I_p), jnp),
+            axis_names, scales, n_y=n_y,
+        )
 
     def grid_shape() -> Tuple[int, ...]:
         return tuple(len(a) for a in nodes)
@@ -960,10 +1110,26 @@ def build_emulator(
         # --- probe-driven inserts: one midpoint per failing pool probe
         # (measured error — it goes in even where the estimate is calm) ---
         inserts: Dict[int, set] = {}
-        for p in failing:
-            scores = _curvature_scores(
-                log_values, nodes, scales, pool_probes[p]
-            )
+        fail_jacs = None
+        if field_jac is not None and len(failing):
+            # one vmapped reverse-mode Jacobian batch per round, failing
+            # probes only — billed on the report as n_grad_evals
+            import jax.numpy as jnp
+
+            fail_jacs = np.asarray(field_jac(
+                jnp.asarray(pool_probes[np.asarray(failing)])
+            ))
+            n_grad_evals += int(len(failing))
+        for j_f, p in enumerate(failing):
+            if fail_jacs is not None:
+                scores = _fisher_axis_scores(
+                    fail_jacs[j_f], log_values, nodes, scales,
+                    pool_probes[p], _GRAD_FIELDS,
+                )
+            else:
+                scores = _curvature_scores(
+                    log_values, nodes, scales, pool_probes[p]
+                )
             for k in np.argsort(-scores):
                 k = int(k)
                 ax = nodes[k]
@@ -1088,6 +1254,8 @@ def build_emulator(
         quarantined_probes=int(n_quarantined_probes),
         posterior_weight=pw,
         weighted_max_rel_err=weighted_max_rel_err,
+        refine_signal=rs,
+        n_grad_evals=int(n_grad_evals),
     )
     manifest = {
         "rtol_target": float(rtol),
@@ -1107,6 +1275,9 @@ def build_emulator(
     if pw is not None:
         manifest["posterior_weight"] = pw
         manifest["weighted_max_rel_err"] = weighted_max_rel_err
+    if rs is not None:
+        manifest["refine_signal"] = rs
+        manifest["n_grad_evals"] = int(n_grad_evals)
     artifact = EmulatorArtifact(
         axis_names=tuple(axis_names),
         axis_nodes=tuple(nodes),
@@ -1114,7 +1285,7 @@ def build_emulator(
         values=values,
         identity=build_identity(
             base, static, n_y, impl, posterior_weight=pw,
-            lz_profile_fp=lz_fp,
+            lz_profile_fp=lz_fp, refine_signal=rs,
         ),
         manifest=manifest,
         predicted_error=predicted,

@@ -134,6 +134,15 @@ STATIC_PARAM_NAMES = {
     "lz_bath_eta",
     "lz_bath_omega_c",
     "n_levels",
+    # MCMC sampler knobs (sampling/nuts.py, mcmc_cli; docs/perf_notes.md
+    # "Gradient-based inference"): the sampler/metric names select which
+    # transition kernel and mass-matrix structure are BUILT (host-side
+    # closure construction), and the dual-averaging target is folded
+    # into the adaptation closure before any tracer exists.  Same
+    # specific-names-only rule as above.
+    "sampler",
+    "mass_matrix",
+    "target_accept",
     "n_y",
     "nz",
     "n_mu",

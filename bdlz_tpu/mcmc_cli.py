@@ -85,6 +85,35 @@ def main(argv=None) -> None:
                     help="Nodes of the P(v_w) table for coherent/"
                          "local-momentum/dephased/chain/thermal "
                          "(0 = per-method default)")
+    # gradient-based inference (docs/perf_notes.md "Gradient-based
+    # inference"): the sampler knob and its NUTS-only companions.  Flags
+    # override the config's sampler/mass_matrix/target_accept keys (the
+    # --quad pattern); the RESOLVED sampler spec joins the checkpoint
+    # identity, so a sampler flip invalidates resume loudly.
+    ap.add_argument("--sampler", choices=("stretch", "nuts"), default=None,
+                    help="Transition kernel: the affine-invariant stretch "
+                         "move (default; gradient-free, bit-stable) or "
+                         "gradient-based multinomial NUTS (vmapped "
+                         "chains, far higher ESS per pipeline "
+                         "evaluation). Default: the config's 'sampler'")
+    ap.add_argument("--mass-matrix", choices=("diag", "dense"), default=None,
+                    dest="mass_matrix",
+                    help="NUTS warmup metric (default: config "
+                         "'mass_matrix'); 'dense' aligns correlated "
+                         "posterior ridges")
+    ap.add_argument("--target-accept", type=float, default=None,
+                    dest="target_accept",
+                    help="NUTS dual-averaging acceptance target "
+                         "(default: config 'target_accept')")
+    ap.add_argument("--nuts-warmup", type=int, default=None,
+                    dest="nuts_warmup",
+                    help="NUTS adaptation draws (step-size search, dual "
+                         "averaging, mass estimation) before sampling "
+                         "(default 300)")
+    ap.add_argument("--max-tree-depth", type=int, default=None,
+                    dest="max_tree_depth",
+                    help="NUTS trajectory doubling cap (2^depth leapfrog "
+                         "steps max per draw; default 8)")
     args = ap.parse_args(argv)
     _gerr = lz_flags_error(args, default_method="local")
     if _gerr:
@@ -133,6 +162,30 @@ def main(argv=None) -> None:
     cfg = apply_scenario_flags(cfg, args)
     static = static_choices_from_config(cfg)
     params = dict(parse_param(s) for s in args.param)
+
+    # sampler resolution: explicit flags > config keys > defaults — and a
+    # NUTS-only knob stated with the stretch sampler is a caller error,
+    # not a silent no-op (the gamma_phi rule)
+    sampler = args.sampler or cfg.sampler
+    if sampler == "stretch" and any(
+        v is not None for v in (args.mass_matrix, args.target_accept,
+                                args.nuts_warmup, args.max_tree_depth)
+    ):
+        raise SystemExit(
+            "--mass-matrix/--target-accept/--nuts-warmup/--max-tree-depth "
+            "have no effect with the stretch sampler; pass --sampler nuts"
+        )
+    mass_matrix = args.mass_matrix or cfg.mass_matrix
+    target_accept = (
+        cfg.target_accept if args.target_accept is None
+        else args.target_accept
+    )
+    nuts_warmup = 300 if args.nuts_warmup is None else args.nuts_warmup
+    max_tree_depth = 8 if args.max_tree_depth is None else args.max_tree_depth
+    if not 0.0 < target_accept < 1.0:
+        raise SystemExit(
+            f"--target-accept must be in (0, 1), got {target_accept}"
+        )
 
     if not args.lz_profile and (args.lz_method != "local" or args.lz_table_n
                                 or "lz_gamma_phi" in params):
@@ -351,8 +404,15 @@ def main(argv=None) -> None:
     )
 
     n_dev = len(jax.devices())
-    W = ((args.walkers + 2 * n_dev - 1) // (2 * n_dev)) * 2 * n_dev
-    mesh = make_mesh(shape=(n_dev, 1)) if n_dev > 1 else None
+    if sampler == "nuts":
+        # NUTS chains are vmapped, not mesh-sharded: a handful of
+        # gradient chains replaces hundreds of walkers, so there is no
+        # walker axis worth scattering (documented in perf_notes)
+        W = max(int(args.walkers), 1)
+        mesh = None
+    else:
+        W = ((args.walkers + 2 * n_dev - 1) // (2 * n_dev)) * 2 * n_dev
+        mesh = make_mesh(shape=(n_dev, 1)) if n_dev > 1 else None
 
     key = jax.random.PRNGKey(args.seed)
     keys = jax.random.split(key, len(params))
@@ -388,6 +448,19 @@ def main(argv=None) -> None:
             out_dir=args.checkpoint_dir,
             checkpoint_every=args.checkpoint_every, mesh=mesh,
             static=static_resolved,
+            # the RESOLVED sampler spec joins the run identity inside
+            # (omit-at-default: stretch chains keep their hashes; a
+            # sampler or NUTS-knob flip invalidates resume loudly)
+            sampler=sampler,
+            sampler_opts=(
+                {
+                    "mass_matrix": mass_matrix,
+                    "target_accept": float(target_accept),
+                    "max_tree_depth": int(max_tree_depth),
+                    "n_warmup": int(nuts_warmup),
+                }
+                if sampler == "nuts" else None
+            ),
             # fingerprint of the posterior: the physics config (extension
             # keys only when non-default, so new framework fields don't
             # invalidate old chains) + the sampled-parameter spec + the
@@ -426,12 +499,39 @@ def main(argv=None) -> None:
         full_chain, full_logp = run.chain, run.logp_chain
         acceptance = run.acceptance
         resumed_segments = run.resumed_segments
+        nuts_info = (
+            {
+                "step_size": float(run.step_size),
+                "n_logp_evals": int(run.n_logp_evals),
+                "n_divergent": int(run.n_divergent),
+            }
+            if sampler == "nuts" else None
+        )
+    elif sampler == "nuts":
+        from bdlz_tpu.sampling import run_nuts
+
+        run = run_nuts(
+            jax.random.PRNGKey(args.seed + 1), logp, init,
+            n_steps=args.steps, n_warmup=int(nuts_warmup),
+            target_accept=float(target_accept), mass_matrix=mass_matrix,
+            max_tree_depth=int(max_tree_depth),
+        )
+        full_chain = np.asarray(run.chain)
+        full_logp = np.asarray(run.logp_chain)
+        acceptance = float(run.acceptance)
+        nuts_info = {
+            "step_size": float(run.step_size),
+            "n_logp_evals": int(run.n_logp_evals),
+            "n_divergent": int(run.n_divergent),
+            "mean_tree_depth": round(float(run.mean_tree_depth), 3),
+        }
     else:
         run = run_ensemble(jax.random.PRNGKey(args.seed + 1), logp, init,
                            n_steps=args.steps, mesh=mesh)
         # global arrays in multi-process runs; identity single-process
         full_chain, full_logp = gather_to_host((run.chain, run.logp_chain))
         acceptance = float(run.acceptance)
+        nuts_info = None
 
     if args.sanitize:
         from bdlz_tpu import sanitize
@@ -460,6 +560,7 @@ def main(argv=None) -> None:
         "walkers": W,
         "steps": args.steps,
         "burn": args.burn,
+        "sampler": sampler,
         "acceptance": round(acceptance, 4),
         "map_logp": float(logps[best]),
         "map_params": {k: float(chain[best, i]) for i, k in enumerate(params)},
@@ -474,6 +575,10 @@ def main(argv=None) -> None:
         # τ estimates need n ≳ 50·τ to be trustworthy (Sokal's criterion)
         "tau_reliable": bool(post.shape[0] >= 50 * float(tau.max())),
     }
+    if nuts_info is not None:
+        # a NUTS run must say what it adapted to and what it paid — the
+        # ESS-per-eval economics are the whole point of the sampler
+        summary["nuts"] = {"mass_matrix": mass_matrix, **nuts_info}
     if args.checkpoint_dir:
         summary["checkpoint_dir"] = args.checkpoint_dir
         summary["resumed_segments"] = resumed_segments

@@ -263,6 +263,7 @@ def mcmc_segment_identity(
     thin: int,
     identity,
     static=None,
+    sampler=None,
 ) -> Identity:
     """The checkpointed-chain run identity.
 
@@ -272,7 +273,16 @@ def mcmc_segment_identity(
     scheme included) is the PR-7 drift fix: the payload gains
     ``static`` + ``schema: 2``, a LOUD version bump that invalidates
     every pre-fix chain directory — by design, because those manifests
-    cannot say which scheme sampled them."""
+    cannot say which scheme sampled them.
+
+    ``sampler`` (a JSON payload naming the RESOLVED sampler — name plus
+    every knob that shapes its transition kernel, e.g. NUTS's
+    mass_matrix/target_accept/max_tree_depth/warmup) follows the same
+    omit-at-default pattern: ``None`` — the stretch default — leaves
+    every existing chain digest byte-stable, while a NUTS run keys its
+    whole sampler spec in, so flipping the sampler (or any NUTS knob)
+    between invocations invalidates resume LOUDLY instead of splicing
+    chains drawn by two different transition kernels."""
     payload: Dict[str, Any] = {
         "init": hashlib.sha256(
             np.ascontiguousarray(init_walkers).tobytes()
@@ -290,6 +300,8 @@ def mcmc_segment_identity(
     if static is not None:
         payload["schema"] = 2
         payload["static"] = static_payload(static)
+    if sampler is not None:
+        payload["sampler"] = sampler
     return Identity("mcmc_segment", (("json", payload),))
 
 

@@ -1,24 +1,45 @@
-"""Ensemble MCMC layer: native JAX affine-invariant sampling.
+"""Sampling layer: native JAX MCMC over the yields pipeline.
 
-emcee is not installable in this environment (no network), so the
-Goodman–Weare stretch move is implemented natively (SURVEY §2.3): walkers
-live in a single device array, both red-black half-updates are vmapped,
-chains run under `lax.scan`, and the walker axis shards across the mesh
-like any other batch axis. The physics likelihood is the vmapped yields
-pipeline mapped to (Ω_b h², Ω_DM h²) against the Planck 2018 measurements.
+Two transition kernels share the vmapped Planck likelihood
+(`likelihoods.py`):
+
+* the affine-invariant stretch move (`ensemble.py`, emcee's algorithm —
+  gradient-free, the bit-stable default), and
+* multinomial NUTS (`nuts.py`) riding the differentiable-posterior
+  layer (`grad.py`): the whole pipeline is JAX-differentiable end to
+  end, so gradient-guided trajectories replace the random walk —
+  orders of magnitude better effective samples per pipeline evaluation
+  (the `nuts_ess_per_eval` bench line measures exactly this).
+
+`diagnostics.py` provides the shared instruments (τ_int, split-R̂, and
+the rank-normalized bulk-ESS/R̂ the ESS-per-eval claims are computed
+with); `checkpoint.py` cuts either sampler into resumable fold_in-keyed
+segments with the sampler spec joined to the run identity.
 """
 from bdlz_tpu.sampling.checkpoint import CheckpointedRun, run_ensemble_checkpointed
 from bdlz_tpu.sampling.diagnostics import (
+    bulk_ess,
     effective_sample_size,
     integrated_autocorr_time,
+    rank_normalized_split_rhat,
     split_rhat,
 )
 from bdlz_tpu.sampling.ensemble import EnsembleState, run_ensemble, stretch_step
+from bdlz_tpu.sampling.grad import (
+    central_fd_grad,
+    gradient_parity,
+    make_logp_value_and_grad,
+    make_observable_jacobian,
+    make_ratio_and_grad,
+    planck_fisher_information,
+)
 from bdlz_tpu.sampling.likelihoods import (
     make_pipeline_logprob,
+    make_pipeline_observables,
     omegas_from_result,
     planck_gaussian_logp,
 )
+from bdlz_tpu.sampling.nuts import NUTSRun, run_nuts
 
 __all__ = [
     "run_ensemble",
@@ -26,10 +47,21 @@ __all__ = [
     "CheckpointedRun",
     "stretch_step",
     "EnsembleState",
+    "run_nuts",
+    "NUTSRun",
     "planck_gaussian_logp",
     "make_pipeline_logprob",
+    "make_pipeline_observables",
     "omegas_from_result",
+    "make_logp_value_and_grad",
+    "make_observable_jacobian",
+    "make_ratio_and_grad",
+    "planck_fisher_information",
+    "central_fd_grad",
+    "gradient_parity",
     "integrated_autocorr_time",
     "split_rhat",
     "effective_sample_size",
+    "bulk_ess",
+    "rank_normalized_split_rhat",
 ]

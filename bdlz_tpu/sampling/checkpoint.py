@@ -27,25 +27,49 @@ import numpy as np  # host-side use only; jitted paths go through the backend.py
 
 
 def _load_segment(seg_file):
-    """(chain, logp, state) from one segment file; raises if unreadable."""
+    """(chain, logp, state) from one segment file; raises if unreadable.
+
+    ``state`` is (walkers, logp, n_accept) for a stretch segment; NUTS
+    segments append their adapted (step_size, inv_mass) and cumulative
+    (acc_sum, n_logp_evals, n_divergent) — a stretch file's byte layout
+    is untouched (the extra arrays exist only when the sampler wrote
+    them).
+    """
     with np.load(seg_file) as data:
-        return (
-            data["chain"],
-            data["logp"],
-            (data["walkers"], data["state_logp"], data["n_accept"].item()),
-        )
+        state = [data["walkers"], data["state_logp"], data["n_accept"].item()]
+        if "nuts_step_size" in data.files:
+            state.append({
+                "step_size": float(data["nuts_step_size"]),
+                "inv_mass": data["nuts_inv_mass"],
+                "acc_sum": float(data["nuts_acc_sum"]),
+                "n_logp_evals": int(data["nuts_n_logp_evals"]),
+                "n_divergent": int(data["nuts_n_divergent"]),
+            })
+        return data["chain"], data["logp"], tuple(state)
 
 
 class CheckpointedRun(NamedTuple):
     chain: np.ndarray        # (n_steps, W, D) kept states, host numpy
     logp_chain: np.ndarray   # (n_steps, W)
-    acceptance: float        # overall accepted fraction
+    acceptance: float        # accepted fraction (stretch) / mean accept
+                             # probability (NUTS)
     segments: int
     resumed_segments: int
+    # ---- NUTS-only provenance (defaults keep stretch callers as-is).
+    # ``n_logp_evals`` counts every evaluation the checkpointed chain
+    # actually paid — leapfrog steps, ε searches, AND each segment's
+    # C initial re-evaluations (a segmented run recomputes logp/grad at
+    # its carried positions; the bill is real and is billed). ----
+    sampler: str = "stretch"
+    step_size: "float | None" = None
+    inv_mass: "np.ndarray | None" = None
+    n_logp_evals: int = 0
+    n_divergent: int = 0
 
 
 def _run_hash(init_walkers, seed: int, n_steps: int, checkpoint_every: int,
-              a: float, thin: int, identity, static=None) -> str:
+              a: float, thin: int, identity, static=None,
+              sampler=None) -> str:
     """Run identity through the shared provenance layer.
 
     ``identity`` fingerprints the posterior (init walkers depend only on
@@ -64,7 +88,7 @@ def _run_hash(init_walkers, seed: int, n_steps: int, checkpoint_every: int,
 
     return mcmc_segment_identity(
         init_walkers, seed, n_steps, checkpoint_every, a, thin, identity,
-        static=static,
+        static=static, sampler=sampler,
     ).digest(16)
 
 
@@ -81,6 +105,8 @@ def run_ensemble_checkpointed(
     event_log=None,
     identity=None,
     static=None,
+    sampler: str = "stretch",
+    sampler_opts=None,
 ) -> CheckpointedRun:
     """Run (or resume) a checkpointed ensemble chain in ``out_dir``.
 
@@ -100,12 +126,53 @@ def run_ensemble_checkpointed(
     layer, so a resolved-scheme change (e.g. a ``quad_panel_gl`` flip)
     invalidates resume instead of silently splicing chains sampled
     under two different quadratures.
+
+    ``sampler`` selects the transition kernel: ``"stretch"`` (default —
+    every existing chain directory keeps its identity hash, byte-stable)
+    or ``"nuts"`` (gradient-based No-U-Turn; ``sampler_opts`` may set
+    ``mass_matrix``/``target_accept``/``max_tree_depth``/``n_warmup``).
+    The RESOLVED sampler spec joins the run identity (omit-at-default),
+    so flipping the sampler — or any NUTS knob — invalidates resume
+    loudly, exactly like a quadrature flip.  A NUTS run warms up (ε
+    search, dual averaging, mass estimation) inside segment 0 and
+    persists the adapted (ε, mass) in every segment file; later
+    segments are pure continuations, so resume stays bitwise.  NUTS
+    chains are vmapped on the local device (``mesh`` is ignored — a few
+    gradient chains need no walker sharding; documented).
     """
     import jax
     import jax.numpy as jnp
 
     from bdlz_tpu.parallel.multihost import gather_to_host, is_coordinator
     from bdlz_tpu.sampling.ensemble import run_ensemble
+
+    if sampler not in ("stretch", "nuts"):
+        raise ValueError(f"sampler must be 'stretch' or 'nuts', got {sampler!r}")
+    if sampler == "nuts":
+        nuts_opts = dict(sampler_opts or {})
+        unknown = set(nuts_opts) - {
+            "mass_matrix", "target_accept", "max_tree_depth", "n_warmup"
+        }
+        if unknown:
+            raise ValueError(f"unknown NUTS sampler_opts {sorted(unknown)}")
+        nuts_opts.setdefault("mass_matrix", "diag")
+        nuts_opts.setdefault("target_accept", 0.8)
+        nuts_opts.setdefault("max_tree_depth", 8)
+        nuts_opts.setdefault("n_warmup", 300)
+        sampler_payload = {
+            "name": "nuts",
+            "mass_matrix": str(nuts_opts["mass_matrix"]),
+            "target_accept": float(nuts_opts["target_accept"]),
+            "max_tree_depth": int(nuts_opts["max_tree_depth"]),
+            "n_warmup": int(nuts_opts["n_warmup"]),
+        }
+    else:
+        if sampler_opts:
+            raise ValueError(
+                "sampler_opts only apply to sampler='nuts' (the stretch "
+                "move's only knob is 'a')"
+            )
+        sampler_payload = None
 
     coordinator = is_coordinator()
 
@@ -120,7 +187,7 @@ def run_ensemble_checkpointed(
     os.makedirs(out_dir, exist_ok=True)
     manifest_path = os.path.join(out_dir, "manifest.json")
     h = _run_hash(init_walkers, seed, n_steps, checkpoint_every, a, thin,
-                  identity, static=static)
+                  identity, static=static, sampler=sampler_payload)
 
     # Resume plan: the COORDINATOR reads the manifest, validates the
     # longest loadable segment prefix, and broadcasts the count (same
@@ -202,6 +269,8 @@ def run_ensemble_checkpointed(
 
     base_key = jax.random.PRNGKey(seed)
 
+    _nuts_kernel: dict = {}
+    nuts_state = None
     if state is None:
         walkers = jnp.asarray(init_walkers)
         # leave logp0 to run_ensemble: it evaluates after sharding the
@@ -213,20 +282,85 @@ def run_ensemble_checkpointed(
         walkers = jnp.asarray(state[0])
         logp0 = jnp.asarray(state[1])
         n_accept = int(state[2])
+        if len(state) > 3:
+            nuts_state = state[3]
+        elif sampler == "nuts" and resumed:
+            # the identity hash keys the sampler spec, so a resumable
+            # prefix always carries the NUTS state; a file without it is
+            # corrupt, not a different sampler's
+            raise RuntimeError(
+                "checkpoint segments match the NUTS run identity but "
+                "carry no NUTS state; the directory is corrupt"
+            )
 
     for k in range(resumed, n_segs):
         keep_lo = k * seg_keep
         keep_hi = min((k + 1) * seg_keep, n_keep_total)
         steps_k = (keep_hi - keep_lo) * thin
+        kept_k = keep_hi - keep_lo
         seg_key = jax.random.fold_in(base_key, k)
-        run = run_ensemble(
-            seg_key, logp_fn, walkers, n_steps=steps_k, a=a, thin=thin,
-            mesh=mesh, init_logp=logp0,
-        )
-        walkers = run.final.walkers
-        logp0 = run.final.logp
-        seg_accept = int(run.final.n_accept)
-        n_accept += seg_accept
+        nuts_extra = {}
+        if sampler == "nuts":
+            from bdlz_tpu.sampling.nuts import make_nuts_draw, run_nuts
+
+            if "step" not in _nuts_kernel:
+                # ONE compiled transition for every segment (ε and the
+                # mass arrays are arguments of the jitted step — see
+                # make_nuts_draw; per-segment rebuilds would recompile
+                # the identical program)
+                _nuts_kernel["step"] = make_nuts_draw(
+                    logp_fn, str(nuts_opts["mass_matrix"]),
+                    int(nuts_opts["max_tree_depth"]),
+                )
+            common = dict(
+                target_accept=float(nuts_opts["target_accept"]),
+                mass_matrix=str(nuts_opts["mass_matrix"]),
+                max_tree_depth=int(nuts_opts["max_tree_depth"]),
+                thin=thin,
+                _step=_nuts_kernel["step"],
+            )
+            if nuts_state is None:
+                # segment 0 owns the warmup; the adapted (ε, mass) ride
+                # every segment file so later segments continue purely
+                run = run_nuts(
+                    seg_key, logp_fn, walkers, n_steps=steps_k,
+                    n_warmup=int(nuts_opts["n_warmup"]), **common,
+                )
+                acc_sum = n_evals = n_div = 0
+            else:
+                run = run_nuts(
+                    seg_key, logp_fn, walkers, n_steps=steps_k,
+                    n_warmup=0, step_size=nuts_state["step_size"],
+                    inv_mass=nuts_state["inv_mass"], **common,
+                )
+                acc_sum = nuts_state["acc_sum"]
+                n_evals = nuts_state["n_logp_evals"]
+                n_div = nuts_state["n_divergent"]
+            walkers, logp0 = run.final
+            seg_accept = run.acceptance
+            nuts_state = {
+                "step_size": run.step_size,
+                "inv_mass": np.asarray(run.inv_mass),
+                "acc_sum": float(acc_sum) + run.acceptance * kept_k,
+                "n_logp_evals": int(n_evals) + run.n_logp_evals,
+                "n_divergent": int(n_div) + run.n_divergent,
+            }
+            nuts_extra = {
+                "nuts_step_size": np.float64(nuts_state["step_size"]),
+                "nuts_inv_mass": nuts_state["inv_mass"],
+                "nuts_acc_sum": np.float64(nuts_state["acc_sum"]),
+                "nuts_n_logp_evals": np.int64(nuts_state["n_logp_evals"]),
+                "nuts_n_divergent": np.int64(nuts_state["n_divergent"]),
+            }
+        else:
+            run = run_ensemble(
+                seg_key, logp_fn, walkers, n_steps=steps_k, a=a, thin=thin,
+                mesh=mesh, init_logp=logp0,
+            )
+            walkers = run.final.walkers
+            logp0 = run.final.logp
+            seg_accept = int(run.final.n_accept)
+            n_accept += seg_accept
         # In multi-process runs the chain and sampler state are GLOBAL
         # arrays (walkers sharded across the mesh) — a bare np.asarray
         # raises there; gather_to_host replicates them on every host and
@@ -252,6 +386,7 @@ def run_ensemble_checkpointed(
                 chain=seg_chain, logp=seg_logp,
                 walkers=host_walkers, state_logp=host_logp0,
                 n_accept=np.int64(n_accept),
+                **nuts_extra,
             )
         manifest["done"] = sorted(set(int(i) for i in manifest["done"]) | {k})
         if coordinator:
@@ -262,11 +397,29 @@ def run_ensemble_checkpointed(
         if event_log is not None:
             event_log.emit(
                 "mcmc_segment_done", segment=k, steps=steps_k,
-                acceptance=seg_accept / (W * steps_k),
+                acceptance=(
+                    seg_accept if sampler == "nuts"
+                    else seg_accept / (W * steps_k)
+                ),
             )
 
     chain = np.concatenate(chain_parts)
     logp_chain = np.concatenate(logp_parts)
+    if sampler == "nuts":
+        return CheckpointedRun(
+            chain=chain,
+            logp_chain=logp_chain,
+            acceptance=(
+                nuts_state["acc_sum"] / n_keep_total if nuts_state else 0.0
+            ),
+            segments=n_segs,
+            resumed_segments=resumed,
+            sampler="nuts",
+            step_size=nuts_state["step_size"] if nuts_state else None,
+            inv_mass=nuts_state["inv_mass"] if nuts_state else None,
+            n_logp_evals=nuts_state["n_logp_evals"] if nuts_state else 0,
+            n_divergent=nuts_state["n_divergent"] if nuts_state else 0,
+        )
     return CheckpointedRun(
         chain=chain,
         logp_chain=logp_chain,

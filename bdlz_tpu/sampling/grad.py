@@ -1,0 +1,266 @@
+"""Differentiable-posterior layer: gradients, Jacobians, Fisher fields.
+
+The whole Planck-likelihood hot path is JAX-differentiable end to end —
+the matrix-exponential/eigh LZ kernels (arXiv:1004.2914 is exactly the
+autodiff-friendly formulation ``lz/kernel.py`` implements), the
+panel-GL/trapezoid y-quadrature on a parameter-dependent node grid, the
+tabulated KJMA lookup, and the emulator's log-space interpolation.  This
+module is where that fact becomes infrastructure:
+
+* :func:`make_logp_value_and_grad` — jitted ``θ → (logp, ∇logp)`` of any
+  logp from :func:`~bdlz_tpu.sampling.likelihoods.make_pipeline_logprob`
+  (exact OR emulator-backed) — the NUTS sampler's engine room;
+* :func:`make_observable_jacobian` — vmapped ``θ → (Ω, J=∂Ω/∂θ)``
+  through the exact pipeline, and :func:`planck_fisher_information` —
+  the Gauss–Newton Fisher matrices ``F = Jᵀ Σ⁻¹ J`` of the Planck
+  Gaussian (exact for a Gaussian likelihood: no data residual enters);
+* :func:`make_ratio_and_grad` — vmapped ``d(Ω_DM/Ω_b)/dθ``, the
+  ``grad_sweep`` bench kernel;
+* :func:`make_field_log10_jacobian` — per-point ``∂log10(ρ_B, ρ_DM)/∂u``
+  in emulator axis coordinates, the second-order refinement signal the
+  Fisher-aware emulator build steers on (``emulator/build.py``);
+* :func:`central_fd_grad` / :func:`gradient_parity` — the
+  finite-difference parity harness the acceptance gate runs
+  (``tests/test_grad.py``: rel err ≤ 1e-5 on exact and emulator logp).
+
+Seam audit (the refactor that unlocks the rest — every host-orchestrated
+trick on the sampling path classified; the table is rendered in
+``docs/perf_notes.md``):
+
+======================  ===========  =====================================
+seam                    status       rule
+======================  ===========  =====================================
+y-grid (linspace over   exact        endpoints are smooth functions of
+parameter-dependent                  (T_p, β/H, window) — grads flow
+bounds) + trapezoid/                 through nodes AND weights
+panel-GL contraction
+KJMA F(y) table lookup  piecewise    cubic-Lagrange in y: exact wrt every
+                                     sampled θ; the table VALUES are
+                                     constants wrt I_p → sampling I_p is
+                                     REFUSED loudly (never a silent zero)
+P(v_w) / P(v_w, Γ_φ)    piecewise    cubic interpolation — analytic grad
+tables, λ₁ law                       wrt v_w/Γ inside the table domain;
+(``_replace(P=...)``)                the domain clamp zeroes the gradient
+                                     AT the edge (size tables past bounds)
+host-pinned P (v_w not  constant     by construction: v_w is not sampled,
+sampled)                             so ∂P/∂θ = 0 is the true gradient
+emulator multidomain    piecewise    ``select_domains``' where-select
+where-select routing                 propagates the CONTAINING domain's
+                                     gradient; the seam band is −inf
+flat-prior bounds box   boundary     −inf outside; the gradient is NaN at
+                                     the boundary itself — parity is
+                                     asserted strictly inside
+lane repacking / F(y)-  refused      host compaction is not on the logp
+table ESDIRK engine                  path (the likelihood is quadrature-
+                                     only); no custom_vjp pretends it is
+======================  ===========  =====================================
+
+No seam on the sampling path needs a ``custom_vjp``: every host trick is
+either off-path (refused), a true constant, or an in-graph rebind.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np  # host-side use only; jitted paths go through the backend.py xp seam (bdlz-lint R1 audit)
+
+from bdlz_tpu.backend import ensure_x64
+from bdlz_tpu.constants import (
+    PLANCK_OMEGA_B_H2_SIGMA,
+    PLANCK_OMEGA_DM_H2_SIGMA,
+)
+
+ensure_x64()
+
+Array = Any
+
+
+def make_logp_value_and_grad(logp_fn: Callable, jit: bool = True) -> Callable:
+    """Jitted ``θ (D,) → (logp, ∇logp (D,))`` of a sampling-layer logp.
+
+    Works on both posteriors :func:`make_pipeline_logprob` builds — the
+    exact pipeline and the emulator fast mode (the log-space interp is
+    piecewise-smooth; the multidomain where-select routes each θ's
+    gradient through its containing domain).  NaN gradients occur only
+    AT the −inf prior boundary (audited above); inside the box the
+    gradient is exact to roundoff (FD-parity-pinned).
+    """
+    vg = jax.value_and_grad(logp_fn)
+    return jax.jit(vg) if jit else vg
+
+
+def central_fd_grad(
+    fn: Callable, theta, rel_step: float = 1e-6
+) -> np.ndarray:
+    """Host-side central finite differences of a scalar ``fn`` at θ.
+
+    The parity harness's reference: per-coordinate step
+    ``h = rel_step · max(|θ_i|, 1)``, O(h²) central rule.  Deliberately
+    dumb and NumPy-typed — it must share no code with the autodiff path
+    it checks.
+    """
+    theta = np.asarray(theta, dtype=np.float64)
+    out = np.empty_like(theta)
+    for i in range(theta.shape[0]):
+        h = rel_step * max(abs(float(theta[i])), 1.0)
+        up = theta.copy()
+        up[i] += h
+        dn = theta.copy()
+        dn[i] -= h
+        out[i] = (float(fn(jnp.asarray(up))) - float(fn(jnp.asarray(dn)))) / (
+            2.0 * h
+        )
+    return out
+
+
+def gradient_parity(
+    logp_fn: Callable, theta, rel_step: float = 1e-6
+) -> Dict[str, Any]:
+    """``jax.grad`` vs central finite differences at one θ.
+
+    Returns ``{value, grad, fd, max_rel_err}`` with the relative error
+    per coordinate measured against ``max(|fd_i|, |grad_i|, 1e-300)``.
+    The acceptance harness asserts ``max_rel_err ≤ 1e-5`` at points
+    strictly inside the prior bounds (AT the boundary the prior is −inf
+    and both sides are undefined — audited, not hidden).
+    """
+    value, grad = make_logp_value_and_grad(logp_fn, jit=False)(
+        jnp.asarray(theta, dtype=jnp.float64)
+    )
+    grad = np.asarray(grad, dtype=np.float64)
+    fd = central_fd_grad(logp_fn, theta, rel_step=rel_step)
+    denom = np.maximum(np.maximum(np.abs(fd), np.abs(grad)), 1e-300)
+    rel = np.abs(grad - fd) / denom
+    return {
+        "value": float(value),
+        "grad": grad,
+        "fd": fd,
+        "max_rel_err": float(rel.max()),
+    }
+
+
+def make_observable_jacobian(observables_fn: Callable) -> Callable:
+    """Vmapped+jitted ``θ (B, D) → (Ω (B, 2), J (B, 2, D))``.
+
+    ``observables_fn`` is one point's ``θ → (Ω_b h², Ω_DM h²)`` from
+    :func:`~bdlz_tpu.sampling.likelihoods.make_pipeline_observables`;
+    one reverse-mode pass per output row gives the full Jacobian — the
+    per-point gradient field the tentpole exposes (Fisher information,
+    refinement signals, the ``grad_sweep`` bench).
+    """
+
+    def one(theta):
+        omega = jnp.stack(observables_fn(theta))
+        jac = jax.jacrev(lambda t: jnp.stack(observables_fn(t)))(theta)
+        return omega, jac
+
+    return jax.jit(jax.vmap(one))
+
+
+def planck_fisher_information(jac: Array) -> Array:
+    """Gauss–Newton Fisher matrices ``F = Jᵀ Σ⁻¹ J`` (B, D, D).
+
+    For the Gaussian Planck likelihood this IS the Fisher information
+    (the Hessian's residual term has zero expectation and the Gaussian's
+    is exactly zero in expectation): Σ is the diagonal of the two Planck
+    2018 measurement variances, ``jac`` is (B, 2, D) from
+    :func:`make_observable_jacobian`.  Eigenvectors name the locally
+    best- and worst-constrained parameter directions; the trace is the
+    scalar sensitivity field the Fisher-aware refinement weights by.
+    """
+    jac = jnp.asarray(jac)
+    sigma_inv = jnp.asarray([
+        1.0 / PLANCK_OMEGA_B_H2_SIGMA**2,
+        1.0 / PLANCK_OMEGA_DM_H2_SIGMA**2,
+    ])
+    return jnp.einsum("bfi,f,bfj->bij", jac, sigma_inv, jac)
+
+
+def make_ratio_and_grad(observables_fn: Callable) -> Callable:
+    """Vmapped+jitted ``θ (B, D) → (Ω_DM/Ω_b (B,), d(Ω_DM/Ω_b)/dθ (B, D))``.
+
+    The ``grad_sweep`` bench kernel: the paper's headline observable
+    (the DM-to-baryon ratio ≈ 5.357 the reference compares, PDF §7) and
+    its parameter gradient in one reverse-mode pass per point.
+    """
+
+    def ratio(theta):
+        ob, od = observables_fn(theta)
+        return od / ob
+
+    return jax.jit(jax.vmap(jax.value_and_grad(ratio)))
+
+
+def make_field_log10_jacobian(
+    base,
+    static,
+    table,
+    axis_names: Sequence[str],
+    axis_scales: Sequence[str],
+    n_y: int = 2000,
+) -> Callable:
+    """Vmapped ``x (B, d) → ∂log10(ρ_B, ρ_DM)/∂u  (B, 2, d)`` — the
+    exact-pipeline gradient field in EMULATOR AXIS COORDINATES.
+
+    ``x`` is in config-schema axis units (the emulator's query space);
+    derivatives are chain-ruled into each axis's interpolation
+    coordinate ``u`` (:func:`emulator.grid.axis_coord` — ``log10(x)``
+    for log axes, ``x`` for linear), because that is the coordinate the
+    build's interval estimates and the interpolant's own gradient live
+    in.  This is the second-order refinement signal of the Fisher-aware
+    emulator build (``refine_signal="fisher"``): comparing it against
+    the interpolant's gradient attributes a probe's error to the axis
+    whose resolution actually causes it, where the legacy ``|f''|``
+    criterion could only look at an axis-local stencil.
+
+    Two-channel only, loudly: a chain/thermal scenario derives P per
+    point HOST-SIDE (``scenario_probabilities_for_points`` — bounce
+    transport outside the graph), so its gradient wrt v_w does not
+    exist in-graph; refusing here is the audit's no-silent-zero rule.
+    """
+    from bdlz_tpu.models.yields_pipeline import point_yields_fast
+    from bdlz_tpu.parallel.sweep import AXIS_MAP
+    from bdlz_tpu.sampling.likelihoods import _make_theta_binder
+
+    lz_mode = getattr(static, "lz_mode", "two_channel")
+    if lz_mode != "two_channel":
+        raise ValueError(
+            f"lz_mode={lz_mode!r} derives P host-side per point — its "
+            "gradient wrt the axes does not exist in-graph, and a silent "
+            "zero would mis-steer the Fisher refinement; use the "
+            "curvature signal for scenario builds"
+        )
+    for k in axis_names:
+        if k == "I_p":
+            raise ValueError(
+                "I_p gradients are undefined on the tabulated path (the "
+                "F-table's values are constants wrt I_p); use the "
+                "curvature signal for I_p boxes"
+            )
+        if k not in AXIS_MAP:
+            raise ValueError(f"unknown axis {k!r}; valid: {sorted(AXIS_MAP)}")
+    from bdlz_tpu.config import point_params_from_config
+
+    pp0 = point_params_from_config(base, base.P_chi_to_B or 0.0)
+    bind = _make_theta_binder(pp0, tuple(axis_names), ())
+    log_axes = jnp.asarray(
+        [1.0 if s == "log" else 0.0 for s in axis_scales]
+    )
+    _LN10 = float(np.log(10.0))
+
+    def log_fields(x):
+        res = point_yields_fast(bind(x), static, table, jnp, n_y=n_y)
+        return jnp.stack([
+            jnp.log10(res.rho_B_kg_m3), jnp.log10(res.rho_DM_kg_m3)
+        ])
+
+    def one(x):
+        jac = jax.jacrev(log_fields)(x)          # d log10 f / d x
+        # chain rule into the interpolation coordinate: du = dx/(x ln10)
+        # on log axes, dx on linear ones
+        du = jnp.where(log_axes > 0, x * _LN10, 1.0)
+        return jac * du[None, :]
+
+    return jax.jit(jax.vmap(one))

@@ -104,6 +104,52 @@ def make_pipeline_logprob(
     ``bounds``); mutually exclusive with the ``lz_*`` P derivations.
     The default ``emulator=None`` leaves the exact path byte-identical.
     """
+    n_lz = _check_param_spec(param_keys, lz_lambda1, lz_P_table, lz_P_table2d)
+    bounds = dict(bounds or {})
+    pp0 = point_params_from_config(base, base.P_chi_to_B or 0.0)
+
+    if emulator is not None:
+        return _make_emulator_logprob(
+            base, static, emulator, param_keys, bounds, log_params,
+            n_lz=n_lz,
+        )
+
+    bounds_lo, bounds_hi = bounds_arrays(param_keys, bounds)
+    bind = _make_theta_binder(
+        pp0, param_keys, log_params,
+        lz_lambda1=lz_lambda1, lz_P_table=lz_P_table,
+        lz_P_table2d=lz_P_table2d,
+    )
+
+    def logp(theta):
+        # flat prior over the bounds box, as ONE vectorized membership
+        # test (the old per-coordinate Python loop unrolled D where-ops
+        # into the jitted graph; a single all() over the bounds arrays
+        # is bitwise-identical — 0.0 or -inf either way — and pinned)
+        inside = jnp.all(
+            jnp.logical_and(theta >= bounds_lo, theta <= bounds_hi)
+        )
+        lp = jnp.where(inside, jnp.zeros(()), -jnp.inf)
+        pp = bind(theta)
+        res = point_yields_fast(pp, static, table, jnp, n_y=n_y)
+        ob, od = omegas_from_result(res)
+        lp = lp + planck_gaussian_logp(ob, od)
+        return jnp.where(jnp.isfinite(lp), lp, -jnp.inf)
+
+    return logp
+
+
+def _check_param_spec(
+    param_keys: Sequence[str],
+    lz_lambda1,
+    lz_P_table,
+    lz_P_table2d,
+) -> int:
+    """THE constructor-time refusals of the sampling layer, shared by
+    :func:`make_pipeline_logprob` and :func:`make_pipeline_observables`
+    (one home — a rule added to one builder cannot silently drift out
+    of the other).  Returns the number of armed lz_* P derivations.
+    """
     n_lz = sum(x is not None for x in (lz_lambda1, lz_P_table, lz_P_table2d))
     if n_lz > 1:
         raise ValueError(
@@ -133,29 +179,59 @@ def make_pipeline_logprob(
         raise ValueError(
             "I_p cannot be a sampled parameter on the tabulated fast path: "
             "the KJMA F-table is built for one I_p (see run_sweep's "
-            "use_table guard); pin I_p or sample with the direct kernel"
+            "use_table guard), and its values are CONSTANTS wrt I_p under "
+            "autodiff (the gradient would be silently wrong — "
+            "docs/perf_notes.md); pin I_p or sample with the direct kernel"
         )
-    bounds = dict(bounds or {})
-    pp0 = point_params_from_config(base, base.P_chi_to_B or 0.0)
+    return n_lz
 
-    if emulator is not None:
-        return _make_emulator_logprob(
-            base, static, emulator, param_keys, bounds, log_params,
-            n_lz=n_lz,
-        )
 
-    def logp(theta):
+def bounds_arrays(
+    param_keys: Sequence[str], bounds: Mapping[str, Tuple[float, float]]
+) -> Tuple["jnp.ndarray", "jnp.ndarray"]:
+    """(lo, hi) prior-bound vectors over ``param_keys`` (±inf = unbounded).
+
+    THE vectorized form of the flat-prior box: both logp variants test
+    ``all(lo <= theta <= hi)`` against these instead of unrolling one
+    where-op per coordinate into the jitted graph (bitwise-identical —
+    the prior term is 0.0 or −inf either way — pinned in
+    ``tests/test_sampling.py``).
+    """
+    lo = jnp.asarray([
+        bounds[k][0] if k in bounds else -jnp.inf for k in param_keys
+    ], dtype=jnp.float64)
+    hi = jnp.asarray([
+        bounds[k][1] if k in bounds else jnp.inf for k in param_keys
+    ], dtype=jnp.float64)
+    return lo, hi
+
+
+def _make_theta_binder(
+    pp0,
+    param_keys: Sequence[str],
+    log_params: Sequence[str],
+    lz_lambda1=None,
+    lz_P_table=None,
+    lz_P_table2d=None,
+) -> Callable:
+    """theta (D,) -> :class:`PointParams`, shared by the exact logp and
+    the gradient layer (:mod:`bdlz_tpu.sampling.grad`).
+
+    Trace-safe and differentiable end to end: log-sampled entries map
+    through ``10**v``, the baryon mass converts GeV→kg, and the LZ seam
+    (analytic λ₁ law, P(v_w) cubic table, or P(v_w, Γ_φ) 2-D table)
+    rebinds ``P`` as a smooth function of the sampled coordinates — the
+    ``_replace(P=...)`` override is an in-graph rebind, not a
+    stop-gradient (audited in ``docs/perf_notes.md``).
+    """
+
+    def bind(theta):
         values = {}
         gamma_phi = None
-        lp = jnp.zeros(())
         for i, k in enumerate(param_keys):
             v = theta[i]
             if k in log_params:
                 v = 10.0 ** v
-            if k in bounds:
-                lo, hi = bounds[k]
-                inside = jnp.logical_and(theta[i] >= lo, theta[i] <= hi)
-                lp = jnp.where(inside, lp, -jnp.inf)
             if k == "lz_gamma_phi":
                 gamma_phi = v  # feeds the P table, not PointParams
                 continue
@@ -176,13 +252,47 @@ def make_pipeline_logprob(
             pp = pp._replace(
                 P=eval_P_table_2d(pp.v_w, gamma_phi, lz_P_table2d, jnp)
             )
-        pp = PointParams(*(jnp.asarray(f) for f in pp))
-        res = point_yields_fast(pp, static, table, jnp, n_y=n_y)
-        ob, od = omegas_from_result(res)
-        lp = lp + planck_gaussian_logp(ob, od)
-        return jnp.where(jnp.isfinite(lp), lp, -jnp.inf)
+        return PointParams(*(jnp.asarray(f) for f in pp))
 
-    return logp
+    return bind
+
+
+def make_pipeline_observables(
+    base: Config,
+    static: StaticChoices,
+    table,
+    param_keys: Sequence[str] = ("m_chi_GeV", "P_chi_to_B"),
+    log_params: Sequence[str] = (),
+    n_y: int = 2000,
+    lz_lambda1: float | None = None,
+    lz_P_table=None,
+    lz_P_table2d=None,
+) -> Callable:
+    """theta (D,) -> (Ω_b h², Ω_DM h²) through the EXACT pipeline.
+
+    The observable map behind :func:`make_pipeline_logprob` without the
+    prior/likelihood wrapper — the differentiation surface of the
+    gradient layer (:mod:`bdlz_tpu.sampling.grad`): its Jacobian is what
+    the Planck Gaussian's Fisher information J^T Σ⁻¹ J contracts, and
+    d(Ω_DM/Ω_b)/dθ rides the same closure on the ``grad_sweep`` bench
+    line.  Same parameter semantics and constructor-time refusals as the
+    logp builder (unknown keys, sampled I_p on the tabulated path, LZ
+    seam conflicts) — a θ the logp would reject cannot be silently
+    differentiated either.
+    """
+    _check_param_spec(param_keys, lz_lambda1, lz_P_table, lz_P_table2d)
+    pp0 = point_params_from_config(base, base.P_chi_to_B or 0.0)
+    bind = _make_theta_binder(
+        pp0, param_keys, log_params,
+        lz_lambda1=lz_lambda1, lz_P_table=lz_P_table,
+        lz_P_table2d=lz_P_table2d,
+    )
+
+    def observables(theta):
+        res = point_yields_fast(bind(theta), static, table, jnp, n_y=n_y)
+        return omegas_from_result(res)
+
+    return observables
 
 
 def _make_emulator_logprob(
@@ -292,17 +402,20 @@ def _make_emulator_logprob(
             in_domain_one(tvec, nodes_j, jnp),
         )
 
+    bounds_lo, bounds_hi = bounds_arrays(param_keys, bounds)
+
     def logp(theta):
-        lp = jnp.zeros(())
+        # the same vectorized flat-prior box as the exact-path logp
+        # (one all() over the bounds arrays instead of D where-ops)
+        inside_b = jnp.all(
+            jnp.logical_and(theta >= bounds_lo, theta <= bounds_hi)
+        )
+        lp = jnp.where(inside_b, jnp.zeros(()), -jnp.inf)
         sampled = {}
         for i, k in enumerate(param_keys):
             v = theta[i]
             if k in log_params:
                 v = 10.0 ** v
-            if k in bounds:
-                lo, hi = bounds[k]
-                inside_b = jnp.logical_and(theta[i] >= lo, theta[i] <= hi)
-                lp = jnp.where(inside_b, lp, -jnp.inf)
             sampled[k] = v
         tvec = jnp.stack([
             sampled[name] if name in key_pos else jnp.float64(pinned[name])

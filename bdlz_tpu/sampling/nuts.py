@@ -1,0 +1,715 @@
+"""No-U-Turn sampler (NUTS) with vmapped chains — gradient-based MCMC.
+
+The stretch sampler (`ensemble.py`) random-walks: its mixing time grows
+with dimension and with posterior anisotropy, and every effective sample
+costs many full pipeline evaluations.  The pipeline is differentiable
+end to end (`sampling/grad.py` — the audit), so this module implements
+the modern gradient sampler instead:
+
+* **multinomial NUTS** (Betancourt 2017 flavor of Hoffman & Gelman
+  2014): per draw, a leapfrog trajectory is doubled until the no-U-turn
+  criterion fires, and the next state is drawn from the whole trajectory
+  with weights ``exp(logp − kinetic)`` (biased-progressive across
+  doublings, multinomial within a subtree) — no slice variable, better
+  tail behavior;
+* **iterative tree building**: subtrees run under ``lax.while_loop``
+  with the O(log) checkpoint scheme for the sub-U-turn checks (even
+  leaf *i* stores its state at slot popcount(*i*); odd leaf *i* checks
+  against the slots of the 2^k-subtree left edges it closes), so the
+  whole draw is one XLA program — no host recursion;
+* **vmapped chains**: the per-chain draw is ``vmap``-ed exactly like the
+  ensemble's walkers; a ``lax.scan`` advances all chains per step.
+  Chains share one step size/mass matrix (pooled adaptation — standard
+  multi-chain warmup);
+* **dual-averaging step-size adaptation** (Nesterov/Hoffman-Gelman) to
+  a ``target_accept`` rate, with a doubling/halving search for the
+  initial ε;
+* **diag or dense mass matrix**, estimated from pooled warmup samples
+  with Stan's shrinkage rule — dense is what aligns the thin curved
+  Planck ridge with the momentum distribution.
+
+Every draw counts its leapfrog steps (= logp+gradient evaluations): the
+``nuts_ess_per_eval`` bench line divides measured bulk ESS by exactly
+this counter, warmup included — convergence per FLOP is the claim, so
+the denominator hides nothing.
+
+Checkpoint/resume contract: a run is a pure function of (key, init
+state, ε, mass); ``sampling/checkpoint.py`` cuts it into fold_in-keyed
+segments exactly like the stretch sampler, persisting (positions, logp,
+ε, mass, counters) per segment, so a resumed NUTS chain is bitwise the
+uninterrupted one.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np  # host-side use only; jitted paths go through the backend.py xp seam (bdlz-lint R1 audit)
+
+from bdlz_tpu.backend import ensure_x64
+
+ensure_x64()
+
+Array = Any
+
+#: Energy-error threshold marking a leapfrog leaf divergent (Stan's
+#: default): past this the integrator has left the level set and the
+#: subtree must not extend further.
+DELTA_MAX = 1000.0
+
+VALID_MASS_MATRIX = ("diag", "dense")
+
+
+class NUTSRun(NamedTuple):
+    """One (possibly multi-chain) NUTS run's kept draws + adaptation."""
+
+    chain: Array          # (n_keep, C, D)
+    logp_chain: Array     # (n_keep, C)
+    acceptance: float     # mean accept-prob statistic over kept draws
+    step_size: float      # the ε the kept draws ran at
+    inv_mass: Array       # (D,) diag or (D, D) dense inverse mass
+    mass_matrix: str      # "diag" | "dense"
+    n_leapfrog: int       # leapfrog steps = logp+grad evals, warmup incl.
+    n_logp_evals: int     # n_leapfrog + per-phase initializations
+    n_divergent: int      # divergent draws in the KEPT phase
+    mean_tree_depth: float
+    final: Tuple[Array, Array]  # (positions (C, D), logp (C,)) at the end
+
+
+def _mass_ops(mass_matrix: str, inv_mass, chol_mass):
+    """(velocity, kinetic, momentum-draw) closures for one mass setting."""
+    if mass_matrix == "diag":
+        inv_mass = jnp.asarray(inv_mass)
+
+        def vel(r):
+            return inv_mass * r
+
+        def kinetic(r):
+            return 0.5 * jnp.sum(r * r * inv_mass)
+
+        def draw_r(key, shape):
+            return jax.random.normal(key, shape) * chol_mass
+
+    else:
+        inv_mass = jnp.asarray(inv_mass)
+
+        def vel(r):
+            return inv_mass @ r
+
+        def kinetic(r):
+            return 0.5 * jnp.dot(r, inv_mass @ r)
+
+        def draw_r(key, shape):
+            return jax.random.normal(key, shape) @ chol_mass.T
+
+    return vel, kinetic, draw_r
+
+
+def _popcount(n, nbits: int):
+    """Set-bit count of a small non-negative int32 (static unroll)."""
+    c = jnp.zeros((), dtype=jnp.int32)
+    for b in range(nbits):
+        c = c + ((n >> b) & 1)
+    return c
+
+
+class _Tree(NamedTuple):
+    """The whole-trajectory state of one draw (one chain)."""
+
+    z_left: Array
+    r_left: Array
+    grad_left: Array
+    z_right: Array
+    r_right: Array
+    grad_right: Array
+    z_prop: Array
+    logp_prop: Array
+    grad_prop: Array
+    log_sum_w: Array
+    sum_accept: Array
+    n_leapfrog: Array     # int32
+    depth: Array          # int32
+    turning: Array        # bool
+    diverging: Array      # bool
+    key: Array
+
+
+def make_nuts_draw(
+    logp_fn: Callable,
+    mass_matrix: str,
+    max_tree_depth: int = 8,
+) -> Callable:
+    """Build the jitted multi-chain NUTS transition.
+
+    Returns ``step(keys (C,), z (C,D), logp (C,), grad (C,D), eps,
+    inv_mass, chol_mass) -> (z', logp', grad', stats)`` with ``stats =
+    (accept_prob, depth, n_leapfrog, divergent)`` per chain — the
+    per-chain draw vmapped and jitted ONCE.  The step size AND the mass
+    arrays are dynamic ARGUMENTS (only the diag/dense structure and the
+    depth cap are baked in), so every warmup window, the sampling
+    phase, and every checkpoint segment of a run share one compiled
+    program — a pipeline-logp XLA compile is seconds, and the old
+    closure-captured-mass design paid it per phase.  ``logp``/``grad``
+    carry the previous draw's evaluation at ``z`` (the proposal's own
+    leaf evaluation), so a chain step costs exactly its leapfrog count.
+    """
+    value_and_grad = jax.value_and_grad(logp_fn)
+    md = int(max_tree_depth)
+    nbits = md + 2
+    if mass_matrix == "diag":
+        def vel(r, im):
+            return im * r
+
+        def kinetic(r, im):
+            return 0.5 * jnp.sum(r * r * im)
+
+        def draw_r(key, shape, cm):
+            return jax.random.normal(key, shape) * cm
+    else:
+        def vel(r, im):
+            return im @ r
+
+        def kinetic(r, im):
+            return 0.5 * jnp.dot(r, im @ r)
+
+        def draw_r(key, shape, cm):
+            return jax.random.normal(key, shape) @ cm.T
+
+    def uturn(z_a, r_a, z_b, r_b, im):
+        """No-U-turn test between trajectory-ordered states a -> b."""
+        dz = z_b - z_a
+        return jnp.logical_or(
+            jnp.dot(dz, vel(r_a, im)) < 0.0,
+            jnp.dot(dz, vel(r_b, im)) < 0.0,
+        )
+
+    def leapfrog(z, r, grad, eps, im):
+        r_half = r + 0.5 * eps * grad
+        z_new = z + eps * vel(r_half, im)
+        logp_new, grad_new = value_and_grad(z_new)
+        r_new = r_half + 0.5 * eps * grad_new
+        return z_new, r_new, logp_new, grad_new
+
+    def build_subtree(key, z0, r0, grad0, depth, direction, eps, joint0,
+                      im):
+        """2^depth leapfrog steps from (z0, r0) in ``direction``.
+
+        Iterative with the popcount checkpoint scheme: even leaf ``i``
+        stores (z, r) at slot popcount(i); odd leaf ``i`` closes the
+        2^k-subtrees whose left edges sit at slots
+        [popcount(i)−t, popcount(i)−1] (t = trailing ones of i) and
+        checks the U-turn criterion against each.  Early exit on
+        turning or divergence.
+        """
+        n_leaves = jnp.left_shift(jnp.int32(1), depth)
+        D = z0.shape[0]
+        ckpt_z = jnp.zeros((md + 1, D))
+        ckpt_r = jnp.zeros((md + 1, D))
+
+        def cond(c):
+            (i, _z, _r, _g, _zp, _lp, _gp, _lsw, _sa, _key,
+             _cz, _cr, turning, diverging) = c
+            return jnp.logical_and(
+                i < n_leaves,
+                jnp.logical_not(jnp.logical_or(turning, diverging)),
+            )
+
+        def body(c):
+            (i, z, r, grad, z_prop, logp_prop, grad_prop, lsw, sum_acc,
+             key, cz, cr, turning, diverging) = c
+            key, k_sel = jax.random.split(key)
+            z, r, logp, grad = leapfrog(z, r, grad, direction * eps, im)
+            joint = logp - kinetic(r, im)
+            joint = jnp.where(jnp.isfinite(joint), joint, -jnp.inf)
+            w = joint - joint0
+            diverging = w < -DELTA_MAX
+            # progressive multinomial sampling within the subtree
+            lsw_new = jnp.logaddexp(lsw, w)
+            take = (
+                jnp.log(jax.random.uniform(k_sel)) < w - lsw_new
+            )
+            z_prop = jnp.where(take, z, z_prop)
+            logp_prop = jnp.where(take, logp, logp_prop)
+            grad_prop = jnp.where(take, grad, grad_prop)
+            sum_acc = sum_acc + jnp.minimum(1.0, jnp.exp(w))
+            # checkpoint bookkeeping (see docstring)
+            pc = _popcount(i, nbits)
+            even = (i & 1) == 0
+            slot = jnp.clip(pc, 0, md)
+            cz = jnp.where(even, cz.at[slot].set(z), cz)
+            cr = jnp.where(even, cr.at[slot].set(r), cr)
+            t_ones = _popcount(i & ~(i + 1), nbits)
+            lo = pc - t_ones
+            hi = pc - 1
+            turn_any = jnp.zeros((), dtype=bool)
+            for s in range(md + 1):
+                in_range = jnp.logical_and(s >= lo, s <= hi)
+                # the criterion needs TRAJECTORY order (increasing
+                # integration time): in a backward subtree (direction
+                # -1) iteration order is time-REVERSED, so the
+                # displacement must be flipped — without this the check
+                # is sign-inverted for every backward subtree (fires on
+                # straight flow, misses real U-turns; regression-pinned
+                # on a free particle in tests/test_nuts.py)
+                dz = direction * (z - cz[s])
+                turn_s = jnp.logical_or(
+                    jnp.dot(dz, vel(cr[s], im)) < 0.0,
+                    jnp.dot(dz, vel(r, im)) < 0.0,
+                )
+                turn_any = jnp.logical_or(
+                    turn_any, jnp.logical_and(in_range, turn_s)
+                )
+            turning = jnp.logical_and(jnp.logical_not(even), turn_any)
+            return (i + 1, z, r, grad, z_prop, logp_prop, grad_prop,
+                    lsw_new, sum_acc, key, cz, cr, turning, diverging)
+
+        init = (jnp.int32(0), z0, r0, grad0, z0, jnp.asarray(-jnp.inf),
+                grad0, jnp.asarray(-jnp.inf), jnp.zeros(()), key,
+                ckpt_z, ckpt_r, jnp.zeros((), bool), jnp.zeros((), bool))
+        (i, z, r, grad, z_prop, logp_prop, grad_prop, lsw, sum_acc,
+         _key, _cz, _cr, turning, diverging) = jax.lax.while_loop(
+            cond, body, init
+        )
+        return (z, r, grad, z_prop, logp_prop, grad_prop, lsw, sum_acc,
+                i, turning, diverging)
+
+    def draw(key, z, logp, grad, eps, inv_mass, chol_mass):
+        k_mom, k_tree = jax.random.split(key)
+        r0 = draw_r(k_mom, z.shape, chol_mass)
+        joint0 = logp - kinetic(r0, inv_mass)
+
+        tree = _Tree(
+            z_left=z, r_left=r0, grad_left=grad,
+            z_right=z, r_right=r0, grad_right=grad,
+            z_prop=z, logp_prop=logp, grad_prop=grad,
+            log_sum_w=jnp.zeros(()), sum_accept=jnp.zeros(()),
+            n_leapfrog=jnp.int32(0), depth=jnp.int32(0),
+            turning=jnp.zeros((), bool), diverging=jnp.zeros((), bool),
+            key=k_tree,
+        )
+
+        def cond(t: _Tree):
+            return jnp.logical_and(
+                t.depth < md,
+                jnp.logical_not(jnp.logical_or(t.turning, t.diverging)),
+            )
+
+        def body(t: _Tree):
+            key, k_dir, k_sub, k_acc = jax.random.split(t.key, 4)
+            go_right = jax.random.bernoulli(k_dir)
+            direction = jnp.where(go_right, 1.0, -1.0)
+            z_edge = jnp.where(go_right, t.z_right, t.z_left)
+            r_edge = jnp.where(go_right, t.r_right, t.r_left)
+            g_edge = jnp.where(go_right, t.grad_right, t.grad_left)
+            (z_end, r_end, g_end, z_p, lp_p, g_p, lsw_sub, sum_acc_sub,
+             n_sub, turn_sub, div_sub) = build_subtree(
+                k_sub, z_edge, r_edge, g_edge, t.depth, direction, eps,
+                joint0, inv_mass,
+            )
+            ok = jnp.logical_not(jnp.logical_or(turn_sub, div_sub))
+            # biased progressive sampling across the doubling: favor the
+            # new half with prob min(1, W_new/W_old)
+            take = jnp.logical_and(
+                ok,
+                jnp.log(jax.random.uniform(k_acc))
+                < lsw_sub - t.log_sum_w,
+            )
+            z_prop = jnp.where(take, z_p, t.z_prop)
+            logp_prop = jnp.where(take, lp_p, t.logp_prop)
+            grad_prop = jnp.where(take, g_p, t.grad_prop)
+            # a turned/diverged subtree is rejected wholesale: weights
+            # and edges stay, only its leapfrog/accept stats count
+            log_sum_w = jnp.where(
+                ok, jnp.logaddexp(t.log_sum_w, lsw_sub), t.log_sum_w
+            )
+            z_left = jnp.where(go_right, t.z_left, z_end)
+            r_left = jnp.where(go_right, t.r_left, r_end)
+            g_left = jnp.where(go_right, t.grad_left, g_end)
+            z_right = jnp.where(go_right, z_end, t.z_right)
+            r_right = jnp.where(go_right, r_end, t.r_right)
+            g_right = jnp.where(go_right, g_end, t.grad_right)
+            edges_ok = jnp.logical_not(jnp.logical_or(turn_sub, div_sub))
+            z_left = jnp.where(edges_ok, z_left, t.z_left)
+            r_left = jnp.where(edges_ok, r_left, t.r_left)
+            g_left = jnp.where(edges_ok, g_left, t.grad_left)
+            z_right = jnp.where(edges_ok, z_right, t.z_right)
+            r_right = jnp.where(edges_ok, r_right, t.r_right)
+            g_right = jnp.where(edges_ok, g_right, t.grad_right)
+            turning = jnp.logical_or(
+                turn_sub,
+                uturn(z_left, r_left, z_right, r_right, inv_mass),
+            )
+            return _Tree(
+                z_left=z_left, r_left=r_left, grad_left=g_left,
+                z_right=z_right, r_right=r_right, grad_right=g_right,
+                z_prop=z_prop, logp_prop=logp_prop, grad_prop=grad_prop,
+                log_sum_w=log_sum_w,
+                sum_accept=t.sum_accept + sum_acc_sub,
+                n_leapfrog=t.n_leapfrog + n_sub,
+                depth=t.depth + 1,
+                turning=turning,
+                diverging=jnp.logical_or(t.diverging, div_sub),
+                key=key,
+            )
+
+        t = jax.lax.while_loop(cond, body, tree)
+        accept_prob = t.sum_accept / jnp.maximum(t.n_leapfrog, 1)
+        stats = (accept_prob, t.depth, t.n_leapfrog, t.diverging)
+        return t.z_prop, t.logp_prop, t.grad_prop, stats
+
+    return jax.jit(jax.vmap(draw, in_axes=(0, 0, 0, 0, None, None, None)))
+
+
+# ---------------------------------------------------------------------------
+# dual-averaging step-size adaptation (Hoffman & Gelman 2014, §3.2.1)
+# ---------------------------------------------------------------------------
+
+class _DAState(NamedTuple):
+    log_eps: Array
+    log_eps_avg: Array
+    h_avg: Array
+    mu: Array
+    t: Array
+
+
+def _da_init(eps0: float) -> _DAState:
+    return _DAState(
+        log_eps=jnp.log(jnp.asarray(eps0)),
+        log_eps_avg=jnp.log(jnp.asarray(eps0)),
+        h_avg=jnp.zeros(()),
+        mu=jnp.log(10.0 * jnp.asarray(eps0)),
+        t=jnp.zeros(()),
+    )
+
+
+def _da_update(
+    da: _DAState, accept: Array, target: float,
+    gamma: float = 0.05, t0: float = 10.0, kappa: float = 0.75,
+) -> _DAState:
+    t = da.t + 1.0
+    eta_h = 1.0 / (t + t0)
+    h_avg = (1.0 - eta_h) * da.h_avg + eta_h * (target - accept)
+    log_eps = da.mu - jnp.sqrt(t) / gamma * h_avg
+    eta = t ** (-kappa)
+    log_eps_avg = eta * log_eps + (1.0 - eta) * da.log_eps_avg
+    return _DAState(log_eps=log_eps, log_eps_avg=log_eps_avg,
+                    h_avg=h_avg, mu=da.mu, t=t)
+
+
+def _find_reasonable_eps(
+    value_and_grad, mass_matrix, inv_mass, chol_mass, key, z, logp, grad,
+) -> Tuple[float, int]:
+    """Hoffman–Gelman Algorithm 4: double/halve ε until the one-step
+    acceptance crosses 1/2.  Host loop (bounded), returns (ε, evals).
+    ``value_and_grad`` is the caller's ONE jitted single-point
+    evaluator — both searches of a warmup share its compile."""
+    vel, kinetic, _ = _mass_ops(mass_matrix, inv_mass, chol_mass)
+
+    def one_step(eps, r0):
+        r_half = r0 + 0.5 * eps * grad
+        z_new = z + eps * vel(r_half)
+        logp_new, grad_new = value_and_grad(z_new)
+        r_new = r_half + 0.5 * eps * grad_new
+        return float(logp_new - kinetic(r_new))
+
+    _, _, draw_r = _mass_ops(mass_matrix, inv_mass, chol_mass)
+    r0 = draw_r(key, z.shape)
+    joint0 = float(logp - kinetic(r0))
+    eps = 1.0
+    evals = 1
+    dlogp = one_step(eps, r0) - joint0
+    if not np.isfinite(dlogp):
+        dlogp = -np.inf
+    a = 1.0 if dlogp > np.log(0.5) else -1.0
+    while a * dlogp > -a * np.log(2.0):
+        eps = eps * (2.0 ** a)
+        if eps > 1e6 or eps < 1e-12:
+            break
+        dlogp = one_step(eps, r0) - joint0
+        if not np.isfinite(dlogp):
+            dlogp = -np.inf
+        evals += 1
+    return float(np.clip(eps, 1e-12, 1e6)), evals
+
+
+def _estimate_inv_mass(
+    samples: np.ndarray, mass_matrix: str
+) -> Tuple[np.ndarray, np.ndarray]:
+    """(inv_mass, chol_mass) from pooled warmup samples (n, D).
+
+    Stan's shrinkage: the sample (co)variance is pulled toward a small
+    identity with weight 5/(n+5), which keeps few-sample estimates
+    invertible and conservative.  The inverse mass IS the posterior
+    (co)variance estimate; ``chol_mass`` factors the mass matrix
+    M = inv_mass⁻¹ for momentum draws.
+    """
+    n = samples.shape[0]
+    w = n / (n + 5.0)
+    if mass_matrix == "diag":
+        var = np.var(samples, axis=0, ddof=1 if n > 1 else 0)
+        inv_mass = w * var + (1.0 - w) * 1e-3
+        chol_mass = 1.0 / np.sqrt(inv_mass)
+        return inv_mass, chol_mass
+    cov = np.cov(samples, rowvar=False, ddof=1 if n > 1 else 0)
+    cov = np.atleast_2d(cov)
+    inv_mass = w * cov + (1.0 - w) * 1e-3 * np.eye(cov.shape[0])
+    chol_mass = np.linalg.cholesky(np.linalg.inv(inv_mass))
+    return inv_mass, chol_mass
+
+
+# ---------------------------------------------------------------------------
+# the multi-chain driver
+# ---------------------------------------------------------------------------
+
+class _PhaseStats(NamedTuple):
+    accept_mean: float
+    n_leapfrog: int
+    depth_mean: float
+    n_divergent: int
+
+
+def _run_phase(
+    step, key, z, logp, grad, n_steps: int, eps_or_da, target_accept,
+    adapt: bool, inv_mass, chol_mass, thin: int = 1, collect: bool = True,
+):
+    """Advance all chains ``n_steps`` through the ONE jitted step.
+
+    A host loop, deliberately: per-step dispatch is microseconds while
+    a recompiled phase program is seconds — every window/phase/segment
+    reuses the same compiled ``step`` (mass and ε are arguments).  With
+    ``adapt`` the dual-averaging state advances on the POOLED
+    (cross-chain mean) accept statistic — one shared ε, the standard
+    multi-chain warmup.  Returns kept (every ``thin``-th) positions and
+    logp (host-stacked) plus summed stats.
+    """
+    C = z.shape[0]
+    im = jnp.asarray(inv_mass)
+    cm = jnp.asarray(chol_mass)
+    da_or_eps = eps_or_da
+    chain, logp_chain = [], []
+    acc_sum = depth_sum = 0.0
+    n_leap = n_div = 0
+    n_keep = n_steps // thin
+    keys = jax.random.split(key, n_keep)
+    for key_t in keys:
+        for k in jax.random.split(key_t, thin):
+            eps = (
+                float(np.exp(da_or_eps.log_eps)) if adapt
+                else float(da_or_eps)
+            )
+            ckeys = jax.random.split(k, C)
+            z, logp, grad, (acc, depth, leap, div) = step(
+                ckeys, z, logp, grad, eps, im, cm
+            )
+            acc_step = float(np.mean(np.asarray(acc)))
+            if adapt:
+                da_or_eps = _da_update(da_or_eps, acc_step, target_accept)
+            acc_sum += acc_step
+            depth_sum += float(np.mean(np.asarray(depth)))
+            n_leap += int(np.sum(np.asarray(leap)))
+            n_div += int(np.sum(np.asarray(div)))
+        if collect:
+            chain.append(np.asarray(z))
+            logp_chain.append(np.asarray(logp))
+    stats = _PhaseStats(
+        accept_mean=acc_sum / max(n_steps, 1),
+        n_leapfrog=n_leap,
+        depth_mean=depth_sum / max(n_steps, 1),
+        n_divergent=n_div,
+    )
+    return (
+        z, logp, grad, da_or_eps,
+        np.stack(chain) if chain else None,
+        np.stack(logp_chain) if logp_chain else None,
+        stats,
+    )
+
+
+def run_nuts(
+    key,
+    logp_fn: Callable,
+    init,
+    n_steps: int,
+    *,
+    n_warmup: Optional[int] = None,
+    target_accept: float = 0.8,
+    mass_matrix: str = "diag",
+    max_tree_depth: int = 8,
+    step_size: Optional[float] = None,
+    inv_mass=None,
+    thin: int = 1,
+    _step: Optional[Callable] = None,
+) -> NUTSRun:
+    """Run multinomial NUTS from ``init`` (C, D) for ``n_steps`` draws.
+
+    ``_step`` (internal) lets the checkpoint layer hand every segment
+    the SAME compiled transition from :func:`make_nuts_draw` — without
+    it each segment would recompile the identical program.
+
+    With ``step_size=None`` the run warms up first (``n_warmup`` draws,
+    default 300): initial-ε search → dual averaging → pooled mass
+    estimation (diag variances or dense covariance per
+    ``mass_matrix``) → ε re-search and final dual averaging.  A tiny
+    warmup (< 40 draws — too few to estimate a metric) adapts the step
+    size only, on the unit metric.  Warmup draws are never returned;
+    their leapfrog evaluations ARE counted
+    (``n_leapfrog``/``n_logp_evals``) — ESS-per-eval claims include the
+    adaptation bill.
+
+    With explicit ``step_size`` AND ``inv_mass`` the run is a pure
+    continuation (no adaptation; ``n_warmup`` must be unset/0): the
+    checkpoint layer resumes segments through this path, and two runs
+    with the same arguments produce the same chain bitwise.
+    """
+    if mass_matrix not in VALID_MASS_MATRIX:
+        raise ValueError(
+            f"mass_matrix={mass_matrix!r} is not one of {VALID_MASS_MATRIX}"
+        )
+    if not 0.0 < float(target_accept) < 1.0:
+        raise ValueError(
+            f"target_accept must be in (0, 1), got {target_accept!r}"
+        )
+    if n_steps % thin:
+        raise ValueError("n_steps must be divisible by thin")
+    init = jnp.asarray(init, dtype=jnp.float64)
+    if init.ndim != 2:
+        raise ValueError(f"init must be (n_chains, D), got {init.shape}")
+    C, D = init.shape
+    if (step_size is None) != (inv_mass is None):
+        raise ValueError(
+            "pass both step_size and inv_mass (a resumed run) or neither "
+            "(a fresh, adapted run)"
+        )
+    resume = step_size is not None
+    if resume and n_warmup:
+        raise ValueError(
+            "n_warmup must be 0 when resuming with an explicit "
+            "step_size/inv_mass (adaptation already happened)"
+        )
+    n_warmup = 300 if (n_warmup is None and not resume) else int(n_warmup or 0)
+
+    value_and_grad = jax.jit(jax.vmap(jax.value_and_grad(logp_fn)))
+    # one jitted SINGLE-point evaluator shared by both ε searches (a
+    # pipeline logp compile is seconds — pay it at most once per run)
+    vag_one = jax.jit(jax.value_and_grad(logp_fn))
+    # the initial evaluation happens HERE even on a resumed segment
+    # (recomputing logp/grad at the carried positions is deterministic,
+    # so the resumed and uninterrupted segmented runs recompute
+    # identically and resume stays bitwise)
+    logp, grad = value_and_grad(init)
+    n_evals = C
+    if not bool(np.all(np.isfinite(np.asarray(logp)))):
+        raise ValueError(
+            "logp is not finite at the initial chain positions; start "
+            "chains strictly inside the prior bounds"
+        )
+    z = init
+
+    # ONE compiled transition for everything below: warmup windows, the
+    # sampling phase, and (via the checkpoint layer's ``_step``) every
+    # later segment of a checkpointed chain — ε and the mass arrays are
+    # arguments, not closure constants
+    step = _step if _step is not None else make_nuts_draw(
+        logp_fn, mass_matrix, max_tree_depth
+    )
+
+    total_leapfrog = 0
+    if resume:
+        inv_mass = np.asarray(inv_mass, dtype=np.float64)
+        if mass_matrix == "diag":
+            chol_mass = 1.0 / np.sqrt(inv_mass)
+        else:
+            chol_mass = np.linalg.cholesky(np.linalg.inv(inv_mass))
+        eps = float(step_size)
+    elif n_warmup < 40:
+        # ---- tiny warmup: step-size-only adaptation.  Too few draws
+        # to estimate a metric (Stan's windowed scheme needs ~40+), so
+        # the unit metric stays and only dual averaging runs.
+        if mass_matrix == "diag":
+            inv_mass = np.ones(D)
+            chol_mass = np.ones(D)
+        else:
+            inv_mass = np.eye(D)
+            chol_mass = np.eye(D)
+        k_eps, k_p1 = jax.random.split(jax.random.fold_in(key, 0xADA), 2)
+        eps0, ev = _find_reasonable_eps(
+            vag_one, mass_matrix, inv_mass, chol_mass, k_eps,
+            z[0], logp[0], grad[0],
+        )
+        n_evals += ev
+        z, logp, grad, da, _c, _l, st = _run_phase(
+            step, k_p1, z, logp, grad, n_warmup, _da_init(eps0),
+            target_accept, adapt=True, inv_mass=inv_mass,
+            chol_mass=chol_mass, collect=False,
+        )
+        total_leapfrog += st.n_leapfrog
+        eps = float(np.exp(np.asarray(da.log_eps_avg)))
+    else:
+        # ---- warmup (three windows, Stan-lite) ----
+        if mass_matrix == "diag":
+            inv_mass = np.ones(D)
+            chol_mass = np.ones(D)
+        else:
+            inv_mass = np.eye(D)
+            chol_mass = np.eye(D)
+        n1 = max(10, int(0.15 * n_warmup))
+        n3 = max(10, int(0.10 * n_warmup))
+        n2 = max(n_warmup - n1 - n3, 10)
+        k_eps, k_p1, k_p2, k_eps2, k_p3 = jax.random.split(
+            jax.random.fold_in(key, 0xADA), 5
+        )
+        eps0, ev = _find_reasonable_eps(
+            vag_one, mass_matrix, inv_mass, chol_mass, k_eps,
+            z[0], logp[0], grad[0],
+        )
+        n_evals += ev
+        # window 1: step size only, unit metric
+        z, logp, grad, da, _c, _l, st = _run_phase(
+            step, k_p1, z, logp, grad, n1, _da_init(eps0),
+            target_accept, adapt=True, inv_mass=inv_mass,
+            chol_mass=chol_mass, collect=False,
+        )
+        total_leapfrog += st.n_leapfrog
+        # window 2: keep adapting ε, collect samples for the mass
+        z, logp, grad, da, warm_chain, _l, st = _run_phase(
+            step, k_p2, z, logp, grad, n2, da, target_accept,
+            adapt=True, inv_mass=inv_mass, chol_mass=chol_mass,
+        )
+        total_leapfrog += st.n_leapfrog
+        pooled = np.asarray(warm_chain).reshape(-1, D)
+        inv_mass, chol_mass = _estimate_inv_mass(pooled, mass_matrix)
+        # window 3: re-search ε under the new metric, final averaging
+        eps0, ev = _find_reasonable_eps(
+            vag_one, mass_matrix, inv_mass, chol_mass, k_eps2,
+            z[0], logp[0], grad[0],
+        )
+        n_evals += ev
+        z, logp, grad, da, _c, _l, st = _run_phase(
+            step, k_p3, z, logp, grad, n3, _da_init(eps0),
+            target_accept, adapt=True, inv_mass=inv_mass,
+            chol_mass=chol_mass, collect=False,
+        )
+        total_leapfrog += st.n_leapfrog
+        eps = float(np.exp(np.asarray(da.log_eps_avg)))
+
+    # ---- sampling ----
+    z, logp, grad, _eps, chain, logp_chain, stats = _run_phase(
+        step, jax.random.fold_in(key, 0x5A11), z, logp, grad,
+        int(n_steps), float(eps), target_accept, adapt=False,
+        inv_mass=inv_mass, chol_mass=chol_mass, thin=thin,
+    )
+    total_leapfrog += stats.n_leapfrog
+    return NUTSRun(
+        chain=chain,
+        logp_chain=logp_chain,
+        acceptance=float(stats.accept_mean),
+        step_size=float(eps),
+        inv_mass=np.asarray(inv_mass),
+        mass_matrix=mass_matrix,
+        n_leapfrog=int(total_leapfrog),
+        n_logp_evals=int(total_leapfrog + n_evals),
+        n_divergent=int(stats.n_divergent),
+        mean_tree_depth=float(stats.depth_mean),
+        final=(z, logp),
+    )

@@ -466,3 +466,111 @@ class TestEmulatorSeamKnobs:
             posterior_weight="planck",
         )
         assert ident2["posterior_weight"] == "planck"
+
+
+class TestSamplerKnobs:
+    """The MCMC sampler knobs (sampler/mass_matrix/target_accept) and
+    the emulator refine_signal knob: validated, with the PR's identity
+    contract — the sampler cannot stale sweep manifests or emulator
+    artifacts (SAMPLER_CONFIG_FIELDS exclusion), its single identity
+    home is the MCMC checkpoint identity; refine_signal's single home
+    is the artifact's own key, like posterior_weight."""
+
+    def test_validation(self):
+        from bdlz_tpu.config import ConfigError, config_from_dict, validate
+
+        validate(config_from_dict({"sampler": "nuts"}))
+        validate(config_from_dict({"mass_matrix": "dense"}))
+        validate(config_from_dict({"target_accept": 0.9}))
+        validate(config_from_dict({"refine_signal": "fisher"}))
+        with pytest.raises(ConfigError, match="sampler"):
+            validate(config_from_dict({"sampler": "hmc"}))
+        with pytest.raises(ConfigError, match="mass_matrix"):
+            validate(config_from_dict({"mass_matrix": "full"}))
+        with pytest.raises(ConfigError, match="target_accept"):
+            validate(config_from_dict({"target_accept": 1.5}))
+        with pytest.raises(ConfigError, match="target_accept"):
+            validate(config_from_dict({"target_accept": 0.0}))
+        with pytest.raises(ConfigError, match="refine_signal"):
+            validate(config_from_dict({"refine_signal": "hessian"}))
+
+    def test_sampler_excluded_from_config_and_artifact_identity(self):
+        from bdlz_tpu.config import (
+            SAMPLER_CONFIG_FIELDS,
+            config_from_dict,
+            config_identity_dict,
+            static_choices_from_config,
+        )
+        from bdlz_tpu.emulator import build_identity
+        from bdlz_tpu.parallel.sweep import grid_hash
+
+        base = {"P_chi_to_B": 0.149}
+        cfg = config_from_dict(base)
+        cfg_knobs = config_from_dict(dict(
+            base, sampler="nuts", mass_matrix="dense", target_accept=0.9,
+        ))
+        ident = config_identity_dict(cfg_knobs)
+        for k in SAMPLER_CONFIG_FIELDS:
+            assert k not in ident
+        # the headline pin: choosing NUTS stales no sweep manifest and
+        # no emulator artifact
+        axes = {"m_chi_GeV": [0.5, 1.0]}
+        assert grid_hash(cfg, axes, 2000) == grid_hash(cfg_knobs, axes, 2000)
+        st = static_choices_from_config(cfg)
+        st_k = static_choices_from_config(cfg_knobs)
+        assert build_identity(cfg, st, 2000, "tabulated") == build_identity(
+            cfg_knobs, st_k, 2000, "tabulated"
+        )
+
+    def test_sampler_home_is_checkpoint_identity(self):
+        """Omit-at-default: a None sampler payload leaves every existing
+        chain digest byte-stable; a NUTS payload (or any knob change
+        inside it) splits the digest — the loud-resume-invalidation
+        contract."""
+        import numpy as np
+
+        from bdlz_tpu.provenance import mcmc_segment_identity
+
+        init = np.zeros((4, 2))
+        legacy = mcmc_segment_identity(init, 0, 10, 5, 2.0, 1, {"c": 1})
+        stretch = mcmc_segment_identity(
+            init, 0, 10, 5, 2.0, 1, {"c": 1}, sampler=None
+        )
+        assert legacy.digest(16) == stretch.digest(16)
+        nuts = mcmc_segment_identity(
+            init, 0, 10, 5, 2.0, 1, {"c": 1},
+            sampler={"name": "nuts", "mass_matrix": "diag",
+                     "target_accept": 0.8, "max_tree_depth": 8,
+                     "n_warmup": 300},
+        )
+        assert nuts.digest(16) != legacy.digest(16)
+        nuts2 = mcmc_segment_identity(
+            init, 0, 10, 5, 2.0, 1, {"c": 1},
+            sampler={"name": "nuts", "mass_matrix": "dense",
+                     "target_accept": 0.8, "max_tree_depth": 8,
+                     "n_warmup": 300},
+        )
+        assert nuts2.digest(16) != nuts.digest(16)
+
+    def test_refine_signal_home_is_artifact_identity(self):
+        from bdlz_tpu.config import (
+            config_from_dict,
+            static_choices_from_config,
+        )
+        from bdlz_tpu.emulator import build_identity
+
+        cfg = config_from_dict({"refine_signal": "fisher"})
+        static = static_choices_from_config(cfg)
+        ident = build_identity(cfg, static, 2000, "tabulated")
+        assert ident["refine_signal"] == "fisher"
+        assert "refine_signal" not in ident["base"]
+        plain = config_from_dict({})
+        ident0 = build_identity(
+            plain, static_choices_from_config(plain), 2000, "tabulated"
+        )
+        assert "refine_signal" not in ident0
+        ident2 = build_identity(
+            plain, static_choices_from_config(plain), 2000, "tabulated",
+            refine_signal="fisher",
+        )
+        assert ident2["refine_signal"] == "fisher"

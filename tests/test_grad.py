@@ -1,0 +1,387 @@
+"""Gradient-layer tests (sampling/grad.py): the tentpole's acceptance
+harness — jax.grad vs central finite differences on the exact AND
+emulator-backed Planck log-posteriors (rel err ≤ 1e-5 strictly inside
+the prior bounds), the chain/thermal lz_mode table paths, the Fisher
+information fields, and the audit's loud refusals."""
+import numpy as np
+import pytest
+
+from bdlz_tpu.config import config_from_dict, static_choices_from_config
+
+BENCH_OVER = {
+    "regime": "nonthermal",
+    "P_chi_to_B": 0.14925839040304145,
+    "source_shape_sigma_y": 9.0,
+    "incident_flux_scale": 1.07e-9,
+    "Y_chi_init": 4.90e-10,
+}
+
+#: The tentpole's acceptance tolerance (ISSUE: jax.grad vs central FD).
+PARITY_TOL = 1e-5
+
+
+def _table(n=4096):
+    import jax.numpy as jnp
+
+    from bdlz_tpu.ops.kjma_table import make_f_table
+
+    base = config_from_dict(dict(BENCH_OVER))
+    return base, static_choices_from_config(base), make_f_table(
+        base.I_p, jnp, n=n
+    )
+
+
+def _profile():
+    from bdlz_tpu.lz.profile import BounceProfile
+
+    xi = np.linspace(-2.0, 2.0, 201)
+    return BounceProfile(xi=xi, delta=2.0 * xi, mix=np.full_like(xi, 0.3))
+
+
+class TestGradientParity:
+    """Central finite differences vs jax.grad at deterministic points
+    strictly inside the prior bounds — the satellite harness."""
+
+    def test_exact_logp_parity(self):
+        from bdlz_tpu.sampling import gradient_parity, make_pipeline_logprob
+
+        base, static, table = _table()
+        logp = make_pipeline_logprob(
+            base, static, table,
+            param_keys=("m_chi_GeV", "P_chi_to_B"),
+            bounds={"m_chi_GeV": (0.05, 20.0), "P_chi_to_B": (1e-4, 1.0)},
+            n_y=2000,
+        )
+        rep = gradient_parity(logp, np.array([0.97, 0.15]))
+        assert np.isfinite(rep["value"])
+        assert rep["max_rel_err"] <= PARITY_TOL, rep
+
+    def test_exact_logp_parity_log_params_and_more_axes(self):
+        from bdlz_tpu.sampling import gradient_parity, make_pipeline_logprob
+
+        base, static, table = _table()
+        logp = make_pipeline_logprob(
+            base, static, table,
+            param_keys=("m_chi_GeV", "v_w", "source_shape_sigma_y"),
+            bounds={"m_chi_GeV": (np.log10(0.5), np.log10(2.0))},
+            log_params=("m_chi_GeV",),
+            n_y=2000,
+        )
+        rep = gradient_parity(logp, np.array([np.log10(0.97), 0.31, 8.7]))
+        assert rep["max_rel_err"] <= PARITY_TOL, rep
+
+    def test_panel_gl_scheme_parity(self):
+        """The snapped-panel Gauss-Legendre y-quadrature (the sweep fast
+        path) is differentiable too — node positions AND weights carry
+        gradients."""
+        from bdlz_tpu.sampling import gradient_parity, make_pipeline_logprob
+
+        base, static, table = _table()
+        logp = make_pipeline_logprob(
+            base, static._replace(quad_panel_gl=True), table,
+            param_keys=("m_chi_GeV", "P_chi_to_B"),
+            bounds={"m_chi_GeV": (0.05, 20.0), "P_chi_to_B": (1e-4, 1.0)},
+            n_y=2000,
+        )
+        rep = gradient_parity(logp, np.array([0.97, 0.15]))
+        assert rep["max_rel_err"] <= PARITY_TOL, rep
+
+    def test_emulator_logp_parity(self, tiny_emulator):
+        """The emulator fast mode: log-space interp is piecewise-smooth;
+        parity holds away from cell boundaries (FD's own discretization
+        straddling a knot is an FD artifact, so the probe point is
+        chosen inside a cell — the audit documents the boundary)."""
+        from bdlz_tpu.sampling import gradient_parity, make_pipeline_logprob
+
+        base, _out_dir, artifact, _report = tiny_emulator
+        static = static_choices_from_config(base)
+        _b, _s, table = _table()
+        logp = make_pipeline_logprob(
+            base, static, table,
+            param_keys=("m_chi_GeV", "v_w"),
+            bounds={"m_chi_GeV": (0.92, 1.08), "v_w": (0.26, 0.34)},
+            emulator=artifact,
+        )
+        rep = gradient_parity(logp, np.array([0.97, 0.31]), rel_step=1e-7)
+        assert rep["max_rel_err"] <= PARITY_TOL, rep
+
+    def test_chain_mode_table_parity(self):
+        """The N-level chain scenario's sampled-v_w path: P(v_w) from
+        the band-traversing PTableN column, interpolated in-jit — the
+        mcmc_cli lz_mode='chain' seam."""
+        from bdlz_tpu.lz.sweep_bridge import PTable, make_P_table_n
+        from bdlz_tpu.sampling import gradient_parity, make_pipeline_logprob
+
+        import jax.numpy as jnp
+
+        base, static, table = _table()
+        tn = make_P_table_n(_profile(), 3, 0.1, 0.6, n=256, xp=jnp)
+        pt = PTable(u0=tn.u0, inv_du=tn.inv_du, values=tn.values[:, -1],
+                    v_lo=tn.v_lo, v_hi=tn.v_hi, method="chain")
+        logp = make_pipeline_logprob(
+            base, static, table, param_keys=("v_w",),
+            bounds={"v_w": (0.12, 0.58)}, lz_P_table=pt, n_y=2000,
+        )
+        rep = gradient_parity(logp, np.array([0.31]), rel_step=1e-7)
+        assert rep["max_rel_err"] <= PARITY_TOL, rep
+
+    def test_thermal_mode_table_parity(self):
+        """The finite-T bath scenario's sampled-v_w path: Γ_φ derived at
+        the pinned T_p, then the dephased P(v_w) table — the mcmc_cli
+        lz_mode='thermal' seam."""
+        from bdlz_tpu.lz.sweep_bridge import make_P_of_vw_table
+        from bdlz_tpu.lz.thermal import thermal_gamma_phi, thermal_method_for
+        from bdlz_tpu.sampling import gradient_parity, make_pipeline_logprob
+
+        import jax.numpy as jnp
+
+        base, static, table = _table()
+        method, gam = thermal_method_for(
+            thermal_gamma_phi(base.T_p_GeV, 0.05, 1.0)
+        )
+        pt = make_P_of_vw_table(
+            _profile(), method, 0.1, 0.6, n=256, gamma_phi=gam, xp=jnp,
+        )
+        logp = make_pipeline_logprob(
+            base, static, table, param_keys=("v_w",),
+            bounds={"v_w": (0.12, 0.58)}, lz_P_table=pt, n_y=2000,
+        )
+        rep = gradient_parity(logp, np.array([0.31]), rel_step=1e-7)
+        assert rep["max_rel_err"] <= PARITY_TOL, rep
+
+    def test_lz_lambda1_parity(self):
+        from bdlz_tpu.sampling import gradient_parity, make_pipeline_logprob
+
+        base, static, table = _table()
+        logp = make_pipeline_logprob(
+            base, static, table, param_keys=("v_w",),
+            bounds={"v_w": (0.05, 0.9)}, lz_lambda1=0.004, n_y=2000,
+        )
+        rep = gradient_parity(logp, np.array([0.31]))
+        assert rep["max_rel_err"] <= PARITY_TOL, rep
+
+
+class TestFisherFields:
+    def test_observable_jacobian_and_fisher(self):
+        """J = ∂(Ω_b, Ω_DM)/∂θ via one reverse pass per field; the
+        Planck Fisher F = JᵀΣ⁻¹J is symmetric PSD and matches the
+        hand-contraction."""
+        import jax.numpy as jnp
+
+        from bdlz_tpu.constants import (
+            PLANCK_OMEGA_B_H2_SIGMA,
+            PLANCK_OMEGA_DM_H2_SIGMA,
+        )
+        from bdlz_tpu.sampling import (
+            make_observable_jacobian,
+            make_pipeline_observables,
+            planck_fisher_information,
+        )
+
+        base, static, table = _table()
+        obs = make_pipeline_observables(
+            base, static, table, param_keys=("m_chi_GeV", "v_w"),
+            n_y=2000,
+        )
+        thetas = jnp.asarray([[0.97, 0.31], [1.5, 0.4]])
+        omegas, jac = make_observable_jacobian(obs)(thetas)
+        assert omegas.shape == (2, 2) and jac.shape == (2, 2, 2)
+        assert np.all(np.isfinite(np.asarray(jac)))
+        F = np.asarray(planck_fisher_information(jac))
+        assert F.shape == (2, 2, 2)
+        s = np.array([PLANCK_OMEGA_B_H2_SIGMA, PLANCK_OMEGA_DM_H2_SIGMA])
+        J = np.asarray(jac[0])
+        want = J.T @ np.diag(1.0 / s**2) @ J
+        assert np.allclose(F[0], want, rtol=1e-12)
+        assert np.allclose(F[0], F[0].T)
+        assert np.all(np.linalg.eigvalsh(F[0]) >= -1e-6 * F[0].max())
+
+    def test_ratio_and_grad_matches_fd(self):
+        import jax.numpy as jnp
+
+        from bdlz_tpu.sampling import (
+            central_fd_grad,
+            make_pipeline_observables,
+            make_ratio_and_grad,
+        )
+
+        base, static, table = _table()
+        obs = make_pipeline_observables(
+            base, static, table, param_keys=("m_chi_GeV", "v_w"), n_y=2000,
+        )
+        fn = make_ratio_and_grad(obs)
+        theta = np.array([0.97, 0.31])
+        vals, grads = fn(jnp.asarray(theta)[None, :])
+
+        def ratio(t):
+            ob, od = obs(t)
+            return od / ob
+
+        fd = central_fd_grad(ratio, theta)
+        rel = np.abs(np.asarray(grads[0]) - fd) / np.maximum(np.abs(fd), 1e-300)
+        assert rel.max() <= PARITY_TOL
+
+    def test_field_log10_jacobian_matches_fd_in_axis_coords(self):
+        import jax.numpy as jnp
+
+        from bdlz_tpu.sampling.grad import make_field_log10_jacobian
+
+        base, static, table = _table()
+        fj = make_field_log10_jacobian(
+            base, static, table, ("m_chi_GeV", "v_w"), ("log", "lin"),
+            n_y=2000,
+        )
+        x = np.array([0.97, 0.31])
+        jac = np.asarray(fj(jnp.asarray(x)[None, :]))[0]   # (2 fields, 2)
+
+        from bdlz_tpu.models.yields_pipeline import point_yields_fast
+        from bdlz_tpu.config import point_params_from_config
+
+        def log_fields(xv):
+            pp = point_params_from_config(base, base.P_chi_to_B)
+            pp = pp._replace(m_chi_GeV=xv[0], v_w=xv[1])
+            import jax.numpy as jnp2
+
+            pp = type(pp)(*(jnp2.asarray(f) for f in pp))
+            res = point_yields_fast(pp, static, table, jnp2, n_y=2000)
+            return np.array([
+                np.log10(float(res.rho_B_kg_m3)),
+                np.log10(float(res.rho_DM_kg_m3)),
+            ])
+
+        eps = 1e-6
+        for k, scale in enumerate(("log", "lin")):
+            up = x.copy()
+            dn = x.copy()
+            h = eps * abs(x[k])
+            up[k] += h
+            dn[k] -= h
+            fd = (log_fields(up) - log_fields(dn)) / (2 * h)
+            # chain rule into the axis coordinate (log10 x for log axes)
+            du = x[k] * np.log(10.0) if scale == "log" else 1.0
+            fd = fd * du
+            rel = np.abs(jac[:, k] - fd) / np.maximum(np.abs(fd), 1e-12)
+            assert rel.max() <= 1e-4, (k, jac[:, k], fd)
+
+
+class TestAuditRefusals:
+    """The no-silent-zero-gradient contract: every genuinely
+    non-differentiable seam refuses loudly at construction."""
+
+    def test_I_p_refused_on_observables(self):
+        from bdlz_tpu.sampling import make_pipeline_observables
+
+        base, static, table = _table()
+        with pytest.raises(ValueError, match="I_p"):
+            make_pipeline_observables(base, static, table, param_keys=("I_p",))
+
+    def test_field_jacobian_refuses_scenario_modes(self):
+        from bdlz_tpu.sampling.grad import make_field_log10_jacobian
+
+        base, static, table = _table()
+        chain_static = static._replace(lz_mode="chain", lz_n_levels=3)
+        with pytest.raises(ValueError, match="host-side"):
+            make_field_log10_jacobian(
+                base, chain_static, table, ("v_w",), ("lin",)
+            )
+
+    def test_field_jacobian_refuses_I_p_axis(self):
+        from bdlz_tpu.sampling.grad import make_field_log10_jacobian
+
+        base, static, table = _table()
+        with pytest.raises(ValueError, match="I_p"):
+            make_field_log10_jacobian(
+                base, static, table, ("I_p",), ("lin",)
+            )
+
+
+class TestBoundsVectorization:
+    """The per-coordinate Python bounds loop became ONE jnp.where over
+    the bounds arrays — pinned bitwise against a reference loop
+    implementation, inside and outside the box."""
+
+    def _loop_reference(self, base, static, table, param_keys, bounds,
+                        log_params, n_y):
+        """The pre-vectorization semantics, re-derived independently."""
+        import jax.numpy as jnp
+
+        from bdlz_tpu.config import point_params_from_config
+        from bdlz_tpu.models.yields_pipeline import point_yields_fast
+        from bdlz_tpu.parallel.sweep import AXIS_MAP
+        from bdlz_tpu.sampling import omegas_from_result, planck_gaussian_logp
+
+        pp0 = point_params_from_config(base, base.P_chi_to_B or 0.0)
+
+        def logp(theta):
+            values = {}
+            lp = jnp.zeros(())
+            for i, k in enumerate(param_keys):
+                v = theta[i]
+                if k in log_params:
+                    v = 10.0 ** v
+                if k in bounds:
+                    lo, hi = bounds[k]
+                    inside = jnp.logical_and(theta[i] >= lo, theta[i] <= hi)
+                    lp = jnp.where(inside, lp, -jnp.inf)
+                values[AXIS_MAP[k]] = v
+            pp = pp0._replace(**values)
+            pp = type(pp)(*(jnp.asarray(f) for f in pp))
+            res = point_yields_fast(pp, static, table, jnp, n_y=n_y)
+            ob, od = omegas_from_result(res)
+            lp = lp + planck_gaussian_logp(ob, od)
+            return jnp.where(jnp.isfinite(lp), lp, -jnp.inf)
+
+        return logp
+
+    def test_bitwise_parity_with_loop(self):
+        import jax
+        import jax.numpy as jnp
+
+        from bdlz_tpu.sampling import make_pipeline_logprob
+
+        base, static, table = _table()
+        keys = ("m_chi_GeV", "P_chi_to_B", "v_w")
+        bounds = {"m_chi_GeV": (0.5, 2.0), "P_chi_to_B": (0.01, 0.9)}
+        new = make_pipeline_logprob(
+            base, static, table, param_keys=keys, bounds=bounds, n_y=2000,
+        )
+        ref = self._loop_reference(
+            base, static, table, keys, bounds, (), 2000,
+        )
+        thetas = np.array([
+            [0.97, 0.15, 0.3],     # inside
+            [0.4, 0.15, 0.3],      # m below lo
+            [0.97, 0.95, 0.3],     # P above hi
+            [0.5, 0.9, 0.3],       # exactly on both bounds (inclusive)
+            [2.1, 0.001, 0.3],     # both outside
+        ])
+        got = np.asarray(jax.vmap(new)(jnp.asarray(thetas)))
+        want = np.asarray(jax.vmap(ref)(jnp.asarray(thetas)))
+        assert np.array_equal(got, want), (got, want)
+        assert np.isfinite(got[0]) and np.isfinite(got[3])
+        assert got[1] == -np.inf and got[2] == -np.inf and got[4] == -np.inf
+
+    def test_emulator_bitwise_parity_with_loop(self, tiny_emulator):
+        """Same pin for the emulator fast mode's copy of the loop."""
+        import jax
+        import jax.numpy as jnp
+
+        from bdlz_tpu.sampling import make_pipeline_logprob
+
+        base, _out, artifact, _rep = tiny_emulator
+        static = static_choices_from_config(base)
+        _b, _s, table = _table()
+        bounds = {"m_chi_GeV": (0.92, 1.08), "v_w": (0.26, 0.34)}
+        logp = make_pipeline_logprob(
+            base, static, table, param_keys=("m_chi_GeV", "v_w"),
+            bounds=bounds, emulator=artifact,
+        )
+        thetas = np.array([
+            [0.97, 0.31],    # inside
+            [0.90, 0.31],    # below m bound but inside the artifact box
+            [0.97, 0.36],    # v_w above bound AND outside the box
+            [1.08, 0.26],    # exactly on bounds (inclusive)
+        ])
+        got = np.asarray(jax.vmap(logp)(jnp.asarray(thetas)))
+        assert np.isfinite(got[0]) and np.isfinite(got[3])
+        assert got[1] == -np.inf and got[2] == -np.inf
