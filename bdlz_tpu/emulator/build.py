@@ -151,6 +151,7 @@ def _exact_fields(
     base, axes: Mapping[str, np.ndarray], static, *, product: bool,
     mesh, chunk_size: int, n_y: int, impl: str,
     fault_plan=None, retry=None, cache=None, lz_profile=None,
+    elastic=None,
 ) -> Tuple[Dict[str, np.ndarray], int]:
     """Exact pipeline over a product grid via the production sweep engine.
 
@@ -162,16 +163,60 @@ def _exact_fields(
     surface with holes must be rebuilt over a domain where the pipeline
     works (probes, by contrast, are droppable and tolerate quarantine;
     see ``build_emulator``).
+
+    ``elastic`` (a worker count, or a kwarg dict forwarded to
+    :func:`~bdlz_tpu.parallel.scheduler.run_sweep_elastic`) routes the
+    grid through the elastic fleet instead of single-host ``run_sweep``
+    and folds chunks STREAMING as workers commit them — the build does
+    not wait for the sweep's final gather, and a worker lost mid-grid
+    costs one lease TTL, not the build.  Elastic results are bitwise-
+    equal to the serial engine, so both paths fill the same surface.
     """
     from bdlz_tpu.parallel.sweep import run_sweep
 
     assert product, "zipped probe evaluation goes through make_exact_evaluator"
-    res = run_sweep(
-        base, dict(axes), static, mesh=mesh, chunk_size=chunk_size,
-        n_y=n_y, out_dir=None, keep_outputs=True, impl=impl,
-        fault_plan=fault_plan, retry=retry, cache=cache,
-        lz_profile=lz_profile,
-    )
+    if elastic:
+        from bdlz_tpu.parallel.scheduler import run_sweep_elastic
+
+        if lz_profile is not None:
+            raise EmulatorBuildError(
+                "elastic build cannot ship per-point bounce profiles; "
+                "drop elastic=... or lz_profile=..."
+            )
+        if cache is None:
+            raise EmulatorBuildError(
+                "elastic build needs a shared store for the lease/commit "
+                "plane; pass cache=... (a store root or Store)"
+            )
+        opts = (
+            dict(elastic) if isinstance(elastic, Mapping)
+            else {"n_workers": int(elastic)}
+        )
+        n_total = int(np.prod([len(np.asarray(v)) for v in axes.values()]))
+        flat: Dict[str, np.ndarray] = {}
+
+        def _consume(ci, lo, hi, ent):
+            # streaming fold: each chunk lands the moment its commit is
+            # observed, into preallocated columns (NaN = not yet landed)
+            for f in ent:
+                if f in ("failed", "quarantined", "n_retries"):
+                    continue
+                if f not in flat:
+                    flat[f] = np.full(n_total, np.nan)
+                flat[f][lo:hi] = np.asarray(ent[f])
+
+        res = run_sweep_elastic(
+            base, dict(axes), static, store=cache, chunk_size=chunk_size,
+            n_y=n_y, impl=impl, fault_plan=fault_plan, retry=retry,
+            on_chunk=_consume, keep_outputs=False, **opts,
+        )
+    else:
+        res = run_sweep(
+            base, dict(axes), static, mesh=mesh, chunk_size=chunk_size,
+            n_y=n_y, out_dir=None, keep_outputs=True, impl=impl,
+            fault_plan=fault_plan, retry=retry, cache=cache,
+            lz_profile=lz_profile,
+        )
     n_pts = res.n_points
     if res.n_failed:
         bad = np.argwhere(np.asarray(res.failed_mask))[:, 0]
@@ -185,6 +230,8 @@ def _exact_fields(
             f"flat index {int(bad[0])}); shrink the box or fix the "
             "configuration"
         )
+    if elastic:
+        return flat, n_pts
     return dict(res.outputs), n_pts
 
 
@@ -743,6 +790,7 @@ def build_emulator(
     posterior_weight: Optional[str] = None,
     refine_signal: Optional[str] = None,
     lz_profile=None,
+    elastic=None,
 ) -> Tuple[EmulatorArtifact, BuildReport]:
     """Build (and optionally save) an error-controlled yield-surface emulator.
 
@@ -766,6 +814,14 @@ def build_emulator(
     to gather with a bit-identical surface (the ``sweep_cache`` bench
     line measures exactly this), and an overlapping rebuild reuses
     whatever hyperplane slices an earlier build already paid for.
+
+    ``elastic`` (a worker count or a kwarg dict for
+    :func:`~bdlz_tpu.parallel.scheduler.run_sweep_elastic`; needs
+    ``cache``) runs every product-grid population on the elastic
+    work-stealing fleet and folds chunks into the surface streaming as
+    they commit — bitwise the same table, but a lost worker costs one
+    lease TTL instead of the build.  Probe rounds (zipped evaluation)
+    and seam-split sub-builds stay on the serial engine.
 
     ``seam_split`` (tri-state, ``Config.seam_split`` when None): a box
     crossing the T = m/3 flux-seam band is split at the band into one
@@ -877,6 +933,12 @@ def build_emulator(
         base, spec, seam_split, rtol=float(rtol), safety=float(safety),
     )
     if band is not None:
+        if elastic:
+            print(
+                "[emulator] seam-split builds run the serial sweep "
+                "engine per sub-domain; ignoring elastic=...",
+                file=sys.stderr,
+            )
         return build_seam_split_emulator(
             base, spec, static, band=band, out_dir=out_dir,
             event_log=event_log, rtol=rtol, safety=safety,
@@ -977,7 +1039,7 @@ def build_emulator(
         base, {k: a for k, a in zip(axis_names, nodes)}, static,
         product=True, mesh=mesh, chunk_size=chunk_size, n_y=n_y, impl=impl,
         fault_plan=faults, retry=retry_policy, cache=store,
-        lz_profile=lz_profile,
+        lz_profile=lz_profile, elastic=elastic,
     )
     values = {f: np.asarray(flat[f]).reshape(grid_shape()) for f in FIELDS}
     _check_positive(values)
@@ -1178,7 +1240,7 @@ def build_emulator(
                 base, axes_eval, static, product=True, mesh=mesh,
                 chunk_size=chunk_size, n_y=n_y, impl=impl,
                 fault_plan=faults, retry=retry_policy, cache=store,
-                lz_profile=lz_profile,
+                lz_profile=lz_profile, elastic=elastic,
             )
             n_exact += n_new
             slab_shape = tuple(

@@ -58,6 +58,30 @@ Sites and what their keys mean:
     Slow collections: :meth:`FaultPlan.delay_s` reports seconds a call
     site should add through its *injectable* clock/sleep seam (kind
     ``slow``); tier-1 never really sleeps.
+``store_read``
+    The provenance store's READ side (:meth:`Store.get_npz` /
+    :meth:`Store.get_array`, armed via :meth:`Store.arm_faults`);
+    ``key`` = per-store read call counter (None = first read).  Kind
+    ``torn`` truncates the entry file just before the load — the
+    reader's ``_drop_corrupt`` path must evict it and report a miss so
+    the caller recomputes (the elastic fold re-queues the chunk).
+``lease``
+    The elastic scheduler's lease plane (``parallel/scheduler.py``);
+    ``key`` = chunk index.  Kinds ``raise``/``transient`` fail the
+    claim attempt (a flaky store RPC — the worker moves on and the
+    chunk stays claimable) and ``torn`` truncates the lease record
+    after a successful claim — readers treat a torn record as free, so
+    the chunk is deliberately double-claimed and the publish-then-commit
+    protocol must resolve it.
+``worker_crash``
+    The elastic worker's compute step (``parallel/worker.py``); ``key``
+    = chunk index.  Kinds ``raise``/``transient`` (budgeted by
+    ``times``) kill the WORKER at compute start — the lease it held
+    dangles until TTL expiry re-queues the chunk, and the dead worker
+    lands on the lease's distinct-failures list (fleet-wide quarantine
+    after ``quarantine_after`` distinct workers).  Operational churn
+    only: these sites never join any result identity, because churn
+    must not change bits.
 
 Resolution (:meth:`FaultPlan.resolve`) follows the tri-state knob
 pattern: ``Config.fault_injection`` ``None`` enables injection iff a
@@ -74,7 +98,8 @@ from typing import Any, Dict, List, NamedTuple, Optional
 
 VALID_SITES = (
     "step", "chunk_write", "probe", "serve_exact", "clock",
-    "replica_dispatch", "registry_fetch",
+    "replica_dispatch", "registry_fetch", "store_read", "lease",
+    "worker_crash",
 )
 VALID_KINDS = ("raise", "transient", "poison", "nan", "torn", "slow",
                "corrupt")

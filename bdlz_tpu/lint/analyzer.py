@@ -143,6 +143,19 @@ STATIC_PARAM_NAMES = {
     "sampler",
     "mass_matrix",
     "target_accept",
+    # elastic-scheduler knobs (parallel/scheduler.py, parallel/worker.py,
+    # docs/robustness.md): lease TTLs, fleet sizes, churn plans, and the
+    # driver's tick are host-side orchestration of WHO computes a chunk
+    # — never what a kernel computes (operational churn is forbidden
+    # from joining any result identity).  Same specific-names-only rule
+    # as above.
+    "lease_ttl_s",
+    "quarantine_after",
+    "n_workers",
+    "churn_plan",
+    "churn_schedule",
+    "tick_s",
+    "poll_s",
     "n_y",
     "nz",
     "n_mu",
@@ -166,6 +179,13 @@ HOT_DIRS = ("physics", "lz", "solvers", "ops")
 
 #: Modules allowed to call jax.config.update (R5).
 CONFIG_OWNERS = ("backend.py", "conftest.py")
+
+#: Modules allowed to CALL time.sleep directly (R7).  Everything else
+#: must take an injectable sleep seam (``sleep=time.sleep`` as a
+#: default-arg REFERENCE is fine — only Call nodes are flagged) so the
+#: elastic scheduler and tier-1 churn tests can drive time
+#: deterministically instead of blocking the suite.
+SLEEP_OWNERS = ("retry.py",)
 
 _SUPPRESS_RE = re.compile(r"bdlz-lint:\s*disable=([A-Za-z0-9_,\s]+)")
 
@@ -633,9 +653,9 @@ class _RulePass(ast.NodeVisitor):
     def visit_Call(self, node: ast.Call) -> None:
         chain = _attr_chain(node.func)
 
-        # R5 — global config writes
-        if chain is not None and self.mod.basename not in CONFIG_OWNERS:
-            canon = None
+        # R5/R7 share the import-alias canonicalization of the callee
+        canon = None
+        if chain is not None:
             root = chain[0]
             if root in self.mod.import_alias:
                 canon = ".".join([self.mod.import_alias[root]] + chain[1:])
@@ -644,12 +664,26 @@ class _RulePass(ast.NodeVisitor):
                 canon = ".".join(
                     [f"{module}.{attr}" if attr else module] + chain[1:]
                 )
-            if canon == "jax.config.update":
-                self._emit(
-                    "R5",
-                    node,
-                    "jax.config.update() outside backend.py/conftest.py",
-                )
+
+        # R5 — global config writes
+        if (
+            canon == "jax.config.update"
+            and self.mod.basename not in CONFIG_OWNERS
+        ):
+            self._emit(
+                "R5",
+                node,
+                "jax.config.update() outside backend.py/conftest.py",
+            )
+
+        # R7 — bare waits outside the retry seam (only CALLS: passing
+        # time.sleep as a default-arg reference is the sanctioned seam)
+        if canon == "time.sleep" and self.mod.basename not in SLEEP_OWNERS:
+            self._emit(
+                "R7",
+                node,
+                "time.sleep() called outside utils/retry.py",
+            )
 
         in_traced = self._in_traced()
 

@@ -58,6 +58,15 @@ _RULE_LIST = (
         "declared static or every distinct value recompiles; consider "
         "donate_argnums for large input buffers",
     ),
+    Rule(
+        "R7",
+        "bare time.sleep call outside utils/retry.py",
+        "waiting has one owner: route delays through an injectable "
+        "sleep seam (RetryPolicy.sleep, a sleep=... parameter) so "
+        "tests and the elastic scheduler can drive time "
+        "deterministically; a sleep=time.sleep default-arg REFERENCE "
+        "is the sanctioned pattern",
+    ),
 )
 
 RULES = {r.id: r for r in _RULE_LIST}

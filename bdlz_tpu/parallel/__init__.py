@@ -19,9 +19,21 @@ Multi-host growth is the standard JAX recipe: ``jax.distributed.initialize``
 """
 from bdlz_tpu.parallel.mesh import batch_sharding, make_mesh, replicated_sharding
 from bdlz_tpu.parallel.multihost import (
+    elect_coordinator,
     init_multihost,
     process_local_bounds,
     shard_global_chunk,
+)
+from bdlz_tpu.parallel.scheduler import (
+    CommitMismatchError,
+    ElasticError,
+    ElasticPlan,
+    LeasePlane,
+    ManualClock,
+    WallClock,
+    plan_elastic_sweep,
+    publish_chunk,
+    run_sweep_elastic,
 )
 from bdlz_tpu.parallel.sweep import (
     SweepResult,
@@ -29,11 +41,13 @@ from bdlz_tpu.parallel.sweep import (
     run_sweep,
     sweep_step,
 )
+from bdlz_tpu.parallel.worker import Worker, WorkerCrashError, run_worker_loop
 
 __all__ = [
     "init_multihost",
     "process_local_bounds",
     "shard_global_chunk",
+    "elect_coordinator",
     "make_mesh",
     "batch_sharding",
     "replicated_sharding",
@@ -41,4 +55,16 @@ __all__ = [
     "sweep_step",
     "run_sweep",
     "SweepResult",
+    "ElasticError",
+    "CommitMismatchError",
+    "ElasticPlan",
+    "LeasePlane",
+    "ManualClock",
+    "WallClock",
+    "plan_elastic_sweep",
+    "publish_chunk",
+    "run_sweep_elastic",
+    "Worker",
+    "WorkerCrashError",
+    "run_worker_loop",
 ]

@@ -233,3 +233,53 @@ def broadcast_from_coordinator(arr):
     from jax.experimental import multihost_utils
 
     return np.asarray(multihost_utils.broadcast_one_to_all(np.asarray(arr)))
+
+
+def elect_coordinator(
+    store, job: str, candidate: str, ttl_s: float = 60.0, clock=None
+) -> bool:
+    """Store-based coordinator election for the ELASTIC sweep plane.
+
+    Unlike :func:`is_coordinator` (multi-controller JAX: process 0 by
+    construction), an elastic fleet has no shared process group — any
+    role may start first, on any host.  The election is a TTL'd lease
+    on the job's ``<job>_coord`` record in the shared store: the first
+    candidate to win the EXCLUSIVE create is coordinator; a later
+    candidate steals the seat only once the lease expired (a dead
+    coordinator must not orphan the fold forever) or when it already
+    holds it (re-election extends the lease).  Returns True when
+    ``candidate`` holds the seat.  ``clock`` is injectable for tests;
+    wall-clock by default — coordinator liveness must be comparable
+    across processes.
+    """
+    import time
+
+    from bdlz_tpu.provenance.registry import (
+        create_lease,
+        read_lease,
+        write_lease,
+    )
+
+    if clock is None:
+        clock = time.time
+    coord_job = f"{job}_coord"
+    now = float(clock())
+    rec = {
+        "schema": 1,
+        "job": job,
+        "role": "coordinator",
+        "worker": str(candidate),
+        "expires_at": now + float(ttl_s),
+        "failures": [],
+    }
+    if create_lease(store, coord_job, 0, rec):
+        return True
+    cur = read_lease(store, coord_job, 0)
+    if (
+        cur is None  # torn record: the store evicted it — seat is free
+        or float(cur.get("expires_at", 0.0)) <= now
+        or cur.get("worker") == str(candidate)
+    ):
+        write_lease(store, coord_job, 0, rec)
+        return True
+    return False

@@ -1,0 +1,856 @@
+"""Elastic work-stealing sweep scheduler (ROADMAP item 5).
+
+The chunked sweep engine (``parallel/sweep.py``) distributes work
+STATICALLY: one job, one loop, pmap-style sharding — a lost host stalls
+the whole sweep.  This module adds the elastic control plane on top of
+the primitives the repo already has: the content-addressed chunk store
+(every chunk result is addressable by its resolved identity + slice
+bytes, ``chunk_cache_key``) and the shared healing semantics
+(``heal_range``: retry → bisect → quarantine).  Because the LZ yield
+kernel is deterministic per point, ANY worker can recompute ANY chunk
+and land on the same bits — elasticity costs availability only, never
+correctness.
+
+Three cooperating planes, all through one shared :class:`Store`:
+
+* **lease plane** (:class:`LeasePlane`, records via
+  ``provenance.registry``): one small JSON record per ``(job, chunk)``.
+  A fresh chunk is claimed by EXCLUSIVE create (``os.link`` — atomic,
+  loser sees EEXIST); a lease carries ``expires_at`` and is heartbeat-
+  extended while its worker computes.  An expired lease is stolen (or
+  coordinator-requeued) with a generation bump, and the expired holder
+  lands on the record's distinct ``failures`` list — a chunk that
+  kills ``quarantine_after`` DISTINCT workers is quarantined
+  fleet-wide, not retried forever.  A torn lease record reads as free
+  (the store evicts it): the worst case is a double-computation the
+  commit protocol resolves.
+* **publish-then-commit** (:func:`publish_chunk`): workers publish
+  results through the existing atomic, durable ``Store.put_npz`` under
+  the chunk's content key.  First commit wins; a later commit of the
+  same chunk (double-claim after lease tear/expiry) VERIFIES bitwise
+  identity against the committed entry and raises
+  :class:`CommitMismatchError` loudly on any drift — a silent mismatch
+  would mean the determinism contract itself is broken.  Torn entries
+  (write or read side) are detected by the store and recomputed.
+* **fold plane** (:func:`run_sweep_elastic`): the coordinator folds
+  committed chunks into the preallocated result arrays AS THEY LAND
+  (``on_chunk`` streaming hook — the emulator build consumes it), so
+  there is no end-of-sweep barrier; the merged result is bitwise-equal
+  to a single-host ``run_sweep`` of the same spec (``mesh=None``).
+
+Determinism over config serialization: every role derives the full
+:class:`ElasticPlan` from the SAME ``(base, axes, static, knobs)``
+inputs through the exact resolution order ``run_sweep`` uses — the
+``--multihost`` "one identical invocation per host" pattern.  The job
+record in the store carries only cross-validation fields (schema, grid
+hash, chunk count, impl); drift raises :class:`ElasticError` instead of
+silently splicing results from different numerics.
+
+Operational churn (``churn_plan``: fault sites ``worker_crash`` /
+``lease`` / ``store_read``) is deliberately SEPARATE from the
+identity-joined ``fault_plan`` (site ``step``): churn must never change
+bits, so it never joins any key.  All waiting goes through injectable
+clocks/sleeps (bdlz-lint R7) — tier-1 churn tests never block.
+
+``lz_profile`` sweeps are not supported in elastic mode (the per-point
+P derivation would need the profile shipped to every worker); use
+``run_sweep``.
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np  # host-side orchestration only (bdlz-lint R1 audit)
+
+from bdlz_tpu.config import Config, StaticChoices
+
+
+class ElasticError(RuntimeError):
+    """Elastic-scheduler protocol failure (job drift, no store, deadlock)."""
+
+
+class CommitMismatchError(ElasticError):
+    """A re-commit of an already-committed chunk produced DIFFERENT bits.
+
+    The whole elastic design rests on per-point determinism; two honest
+    workers disagreeing on a chunk's bytes means a broken engine, a
+    corrupted store, or divergent resolution — never something to paper
+    over, so this raises instead of picking a winner."""
+
+
+class ManualClock:
+    """Deterministic injectable clock for the in-process driver/tests:
+    time only moves when :meth:`advance` is called, so lease TTLs expire
+    exactly at scripted round boundaries and tier-1 never sleeps."""
+
+    def __init__(self, start: float = 0.0):
+        self._now = float(start)
+
+    def __call__(self) -> float:
+        return self._now
+
+    def advance(self, seconds: float) -> float:
+        self._now += float(seconds)
+        return self._now
+
+
+class WallClock:
+    """Real-time clock for driving :func:`run_sweep_elastic` alongside
+    EXTERNAL worker processes (``sweep_cli --elastic coordinator``):
+    ``now`` is wall time and :meth:`advance` actually waits, so the
+    driver's lease arithmetic agrees with workers using ``time.time``.
+    Both seams are injectable — ``sleep=time.sleep`` here is a default-
+    arg REFERENCE, the sanctioned bdlz-lint R7 pattern."""
+
+    def __init__(self, time_fn=time.time, sleep=time.sleep):
+        self._time = time_fn
+        self._sleep = sleep
+
+    def __call__(self) -> float:
+        return float(self._time())
+
+    def advance(self, seconds: float) -> float:
+        self._sleep(float(seconds))
+        return float(self._time())
+
+
+@dataclass
+class ElasticPlan:
+    """The fully resolved sweep spec every elastic role derives
+    identically from ``(base, axes, static, knobs)`` — see
+    :func:`plan_elastic_sweep`.  ``job`` is the grid hash: the store
+    namespace leases and the job record live under."""
+
+    job: str
+    base: Config
+    axes: Dict[str, Any]
+    static: StaticChoices          # quad tri-state already resolved
+    faults: Any                    # identity-joined FaultPlan (or None)
+    retry_policy: Any
+    pp_all: Any                    # full flattened grid (PointParams)
+    n_total: int
+    chunk_size: int
+    n_chunks: int
+    n_y: int
+    impl: str
+    use_table: bool
+    table_np: Any
+    table_nodes: int
+    quad_on: bool
+    quad_nodes: Optional[int]
+    esdirk_knobs: Optional[dict]
+    interpret: bool
+    fuse_exp: bool
+    pallas_reduce: Any
+    fields: Tuple[str, ...]
+    chunk_keys: List[str] = field(repr=False, default_factory=list)
+
+    def chunk_bounds(self, ci: int) -> Tuple[int, int]:
+        lo = int(ci) * self.chunk_size
+        return lo, min(lo + self.chunk_size, self.n_total)
+
+    def entry_name(self, ci: int) -> str:
+        """The chunk's CONTENT-ADDRESSED store name — the same namespace
+        ``run_sweep``'s chunk cache uses, so elastic results warm the
+        ordinary cache and vice versa (no key drift, pinned in tests)."""
+        return f"sweep_chunk/{self.chunk_keys[ci]}.npz"
+
+    def job_record(self) -> Dict[str, Any]:
+        return {
+            "schema": 1,
+            "hash": self.job,
+            "n_total": int(self.n_total),
+            "chunk_size": int(self.chunk_size),
+            "n_chunks": int(self.n_chunks),
+            "n_y": int(self.n_y),
+            "impl": self.impl,
+        }
+
+
+def plan_elastic_sweep(
+    base: Config,
+    axes,
+    static: StaticChoices,
+    *,
+    chunk_size: int = 4096,
+    n_y: int = 8000,
+    impl: str = "tabulated",
+    table_nodes: int = 16384,
+    interpret: bool = False,
+    fuse_exp: bool = False,
+    fault_plan=None,
+    retry=None,
+) -> ElasticPlan:
+    """Resolve the elastic sweep spec — the SAME resolution order
+    ``run_sweep`` runs for ``mesh=None``, factored so coordinator and
+    every worker derive identical engines, identical chunk boundaries,
+    and identical content keys from identical inputs (determinism is
+    the transport; the store only cross-validates).  Any drift here IS
+    bit drift, so changes must stay in lockstep with ``run_sweep``."""
+    import sys
+
+    import jax
+
+    from bdlz_tpu.config import needs_ode_path
+    from bdlz_tpu.faults import FaultPlan
+    from bdlz_tpu.models.yields_pipeline import YieldsResult
+    from bdlz_tpu.parallel.sweep import (
+        _clamp_chunk_to_memory,
+        _resolved_quad_nodes,
+        build_grid,
+        chunk_cache_key,
+        engine_identity_extra,
+        grid_hash,
+        resolve_pallas_tier,
+    )
+    from bdlz_tpu.utils.retry import resolve_engine_retry
+    from bdlz_tpu.validation import resolve_quad_panel_gl
+
+    faults = FaultPlan.resolve(fault_plan, base)
+    retry_policy = resolve_engine_retry(retry, base, static)
+
+    if getattr(static, "lz_mode", "two_channel") != "two_channel":
+        raise ElasticError(
+            f"lz_mode={static.lz_mode!r} needs a bounce profile per point; "
+            "elastic mode does not ship profiles — use run_sweep"
+        )
+    pp_all = build_grid(base, axes)
+    n_total = len(np.asarray(pp_all.m_chi_GeV))
+
+    # engine forcing, exactly as run_sweep (mesh=None: no lockstep route)
+    needs_ode = (
+        needs_ode_path(base)
+        or any(
+            np.any(np.asarray(axes[k], dtype=np.float64) != 0.0)
+            for k in ("sigma_v_chi_GeV_m2", "Gamma_wash_over_H")
+            if k in axes
+        )
+    )
+    requested_impl = impl
+    reason = None
+    if needs_ode and impl != "esdirk_lockstep":
+        impl = "esdirk"
+        reason = "stiff regime: sigma_v/washout/depletion active"
+    use_table = "I_p" not in axes
+    if not use_table and impl in ("tabulated", "pallas"):
+        impl = "direct"
+        reason = "I_p swept: per-I_p table unavailable"
+    if impl != requested_impl:
+        print(
+            f"[elastic] impl {requested_impl!r} is invalid for this "
+            f"configuration; using {impl!r} ({reason})",
+            file=sys.stderr,
+        )
+        if fuse_exp:
+            raise ValueError(
+                "fuse_exp requires the pallas engine, but this configuration "
+                f"forces impl={impl!r}"
+            )
+
+    # quadrature tri-state, then the memory clamp at the resolved scheme
+    table_np = None
+    if impl == "tabulated" and static.quad_panel_gl is None:
+        from bdlz_tpu.ops.kjma_table import make_f_table as _mft_np
+
+        table_np = _mft_np(float(base.I_p), np, n=table_nodes)
+    quad_on, _ = resolve_quad_panel_gl(
+        pp_all, static, impl, n_y, table=table_np, label="elastic",
+    )
+    static = static._replace(quad_panel_gl=quad_on)
+    quad_nodes = _resolved_quad_nodes(static, impl)
+    chunk_size = _clamp_chunk_to_memory(
+        chunk_size, n_y, None, impl, quad_nodes=quad_nodes,
+        double_buffer=impl != "esdirk",
+    )
+
+    pallas_reduce = None
+    if impl == "pallas" and not interpret and jax.devices()[0].platform != "cpu":
+        # single-worker resolution of the kernel tier (no fleet
+        # agreement round: workers are mesh=None single-process, and
+        # the resolved tier joins the chunk keys below — a worker that
+        # resolved differently could not even address the same entries)
+        tier, tier_msg = resolve_pallas_tier(
+            static.chi_stats, n_y, fuse_exp=fuse_exp,
+            table_nodes=table_nodes,
+        )
+        if tier is None:
+            raise ElasticError(f"pallas preflight failed: {tier_msg}")
+        pallas_reduce = tier
+
+    esdirk_knobs = None
+    if impl == "esdirk":
+        from bdlz_tpu.solvers.batching import resolve_engine_knobs
+
+        esdirk_knobs = resolve_engine_knobs(static, np.asarray(pp_all.I_p))
+
+    hash_extra = engine_identity_extra(
+        static, impl, esdirk_knobs=esdirk_knobs, faults=faults,
+        fuse_exp=fuse_exp, pallas_reduce=pallas_reduce,
+    ) or None
+    job = grid_hash(base, axes, n_y, impl, extra=hash_extra)
+    n_chunks = (n_total + chunk_size - 1) // chunk_size
+
+    armed = faults is not None
+    chunk_extra = {
+        k: v for k, v in (hash_extra or {}).items()
+        if k in ("quad", "esdirk", "pallas", "fault_plan")
+    }
+    if impl == "pallas" and interpret:
+        chunk_extra["pallas"] = {
+            **chunk_extra.get("pallas", {}), "interpret": True,
+        }
+    chunk_keys = [
+        chunk_cache_key(
+            base, static, pp_all,
+            ci * chunk_size, min((ci + 1) * chunk_size, n_total),
+            n_y=n_y, impl=impl, table_nodes=table_nodes,
+            extra=chunk_extra,
+            fault_ctx=(
+                ("step", ci, ci * chunk_size,
+                 min((ci + 1) * chunk_size, n_total))
+                if armed else None
+            ),
+        )
+        for ci in range(n_chunks)
+    ]
+
+    return ElasticPlan(
+        job=job,
+        base=base,
+        axes=dict(axes),
+        static=static,
+        faults=faults,
+        retry_policy=retry_policy,
+        pp_all=pp_all,
+        n_total=n_total,
+        chunk_size=chunk_size,
+        n_chunks=n_chunks,
+        n_y=n_y,
+        impl=impl,
+        use_table=use_table,
+        table_np=table_np,
+        table_nodes=table_nodes,
+        quad_on=bool(quad_on),
+        quad_nodes=quad_nodes,
+        esdirk_knobs=esdirk_knobs,
+        interpret=interpret,
+        fuse_exp=fuse_exp,
+        pallas_reduce=pallas_reduce,
+        fields=tuple(YieldsResult._fields),
+        chunk_keys=chunk_keys,
+    )
+
+
+def ensure_job_record(store, plan: ElasticPlan) -> Dict[str, Any]:
+    """Publish (or cross-validate against) the job record
+    ``elastic/<job>.json`` — the store's ONLY spec-level state.  Every
+    role re-derives the full plan deterministically; the record exists
+    so a worker launched with drifted inputs fails LOUDLY here instead
+    of computing chunks nobody can fold.  A torn record is rewritten
+    (the store evicted it as a miss)."""
+    name = f"elastic/{plan.job}.json"
+    want = plan.job_record()
+    have = store.get_json(name)
+    if have is None:
+        store.put_json(name, want)
+        return want
+    if have != want:
+        raise ElasticError(
+            f"elastic job record {name} does not match this invocation's "
+            f"resolved plan (store: {have}, local: {want}); every role "
+            "must run the identical (config, axes, static, knobs)"
+        )
+    return have
+
+
+# ---- lease plane --------------------------------------------------------
+
+_LEASE_FREE = "queued"
+
+
+class LeasePlane:
+    """Lease policy over the registry's record CRUD: claim / heartbeat /
+    complete / fail / requeue, with TTL expiry, distinct-failure
+    tracking, and fleet-wide quarantine.  ``clock`` is injectable
+    (default ``time.time`` — lease expiry must be comparable ACROSS
+    processes); ``faults`` is the operational churn plan (site
+    ``lease``), never identity-joined."""
+
+    def __init__(
+        self,
+        store,
+        job: str,
+        n_chunks: int,
+        *,
+        ttl_s: float = 60.0,
+        quarantine_after: int = 3,
+        clock: Callable[[], float] = time.time,
+        faults=None,
+    ):
+        self.store = store
+        self.job = job
+        self.n_chunks = int(n_chunks)
+        self.ttl_s = float(ttl_s)
+        self.quarantine_after = int(quarantine_after)
+        self.clock = clock
+        self.faults = faults
+
+    # -- record access ----------------------------------------------------
+
+    def read(self, ci: int) -> Optional[Dict[str, Any]]:
+        from bdlz_tpu.provenance.registry import read_lease
+
+        return read_lease(self.store, self.job, ci)
+
+    def _write(self, ci: int, rec: Dict[str, Any]) -> None:
+        from bdlz_tpu.provenance.registry import write_lease
+
+        write_lease(self.store, self.job, ci, rec)
+
+    def _record(self, ci, worker, state, generation, failures):
+        return {
+            "schema": 1,
+            "job": self.job,
+            "chunk": int(ci),
+            "state": state,
+            "worker": worker,
+            "generation": int(generation),
+            "expires_at": float(self.clock()) + self.ttl_s,
+            "failures": list(failures),
+        }
+
+    # -- policy -----------------------------------------------------------
+
+    def claim(self, ci: int, worker: str) -> bool:
+        """Try to lease chunk ``ci`` for ``worker``; True when won.
+
+        Fresh chunk → EXCLUSIVE create (the only racy step; ``os.link``
+        arbitrates).  Expired lease or queued chunk → steal with a
+        generation bump; an expired holder lands on the distinct
+        ``failures`` list first, and a chunk whose failure list reaches
+        ``quarantine_after`` is quarantined fleet-wide instead.  Done /
+        quarantined / live-leased chunks are not claimable.  Injected
+        ``lease`` faults (churn plan): raise/transient fail the claim
+        like a flaky store RPC; ``torn`` tears the record AFTER a won
+        claim, deliberately forcing a later double-claim the commit
+        protocol must resolve."""
+        from bdlz_tpu.faults import FaultError
+        from bdlz_tpu.provenance.registry import create_lease, lease_entry_name
+
+        if self.faults is not None:
+            try:
+                self.faults.fire("lease", ci)
+            except FaultError:
+                return False  # flaky claim RPC: chunk stays claimable
+        rec = self.read(ci)
+        if rec is None:
+            fresh = self._record(ci, worker, "leased", 0, [])
+            if not create_lease(self.store, self.job, ci, fresh):
+                return False  # lost the create race
+            self._claim_tear(ci, lease_entry_name(self.job, ci))
+            return True
+        state = rec.get("state")
+        if state in ("done", "quarantined"):
+            return False
+        failures = [str(w) for w in rec.get("failures", [])]
+        if state == "leased":
+            if float(rec.get("expires_at", 0.0)) > float(self.clock()):
+                return False  # live lease
+            # expired: the holder failed this chunk (distinct workers)
+            holder = rec.get("worker")
+            if holder is not None and holder not in failures:
+                failures.append(str(holder))
+        if len(failures) >= self.quarantine_after:
+            quar = self._record(
+                ci, None, "quarantined", rec.get("generation", 0) + 1,
+                failures,
+            )
+            self._write(ci, quar)
+            return False
+        steal = self._record(
+            ci, worker, "leased", rec.get("generation", 0) + 1, failures,
+        )
+        self._write(ci, steal)
+        self._claim_tear(ci, lease_entry_name(self.job, ci))
+        return True
+
+    def _claim_tear(self, ci: int, entry: str) -> None:
+        if self.faults is not None:
+            self.faults.corrupt_file("lease", ci, self.store.path_for(entry))
+
+    def heartbeat(self, ci: int, worker: str) -> bool:
+        """Extend ``worker``'s live lease on ``ci``; False when the lease
+        is gone/stolen/torn (the worker keeps computing — the commit
+        protocol, not the heartbeat, owns correctness)."""
+        rec = self.read(ci)
+        if (
+            rec is None
+            or rec.get("state") != "leased"
+            or rec.get("worker") != worker
+        ):
+            return False
+        rec["expires_at"] = float(self.clock()) + self.ttl_s
+        self._write(ci, rec)
+        return True
+
+    def complete(self, ci: int, worker: str, entry: Optional[str] = None) -> None:
+        """Mark ``ci`` done (after a successful commit).  ``entry``
+        overrides the fold-time store name for results that must NOT
+        live under the content-addressed cache name (real-world
+        quarantines)."""
+        rec = self.read(ci) or self._record(ci, worker, "leased", 0, [])
+        done = self._record(
+            ci, worker, "done", rec.get("generation", 0),
+            rec.get("failures", []),
+        )
+        if entry is not None:
+            done["entry"] = entry
+        self._write(ci, done)
+
+    def fail(self, ci: int, worker: str, err: Any = None) -> None:
+        """Record a per-worker failure and requeue (or quarantine at the
+        distinct-failures threshold)."""
+        rec = self.read(ci) or self._record(ci, worker, "leased", 0, [])
+        failures = [str(w) for w in rec.get("failures", [])]
+        if worker not in failures:
+            failures.append(str(worker))
+        state = (
+            "quarantined" if len(failures) >= self.quarantine_after
+            else _LEASE_FREE
+        )
+        nxt = self._record(
+            ci, None, state, rec.get("generation", 0) + 1, failures,
+        )
+        if err is not None:
+            nxt["error"] = repr(err)
+        self._write(ci, nxt)
+
+    def requeue(self, ci: int) -> None:
+        """Force ``ci`` claimable again (fold found its entry torn)."""
+        rec = self.read(ci) or self._record(ci, None, _LEASE_FREE, 0, [])
+        nxt = self._record(
+            ci, None, _LEASE_FREE, rec.get("generation", 0) + 1,
+            rec.get("failures", []),
+        )
+        self._write(ci, nxt)
+
+    def requeue_expired(self) -> List[int]:
+        """Coordinator tick: every expired lease → requeue (holder onto
+        the distinct-failures list; threshold → fleet quarantine).
+        Worker loss therefore costs only the in-flight chunks, and only
+        until their TTL."""
+        now = float(self.clock())
+        out: List[int] = []
+        for ci in range(self.n_chunks):
+            rec = self.read(ci)
+            if (
+                rec is None
+                or rec.get("state") != "leased"
+                or float(rec.get("expires_at", 0.0)) > now
+            ):
+                continue
+            self.fail(ci, rec.get("worker"), err="lease expired")
+            out.append(ci)
+        return out
+
+    def state(self, ci: int) -> str:
+        rec = self.read(ci)
+        return _LEASE_FREE if rec is None else str(rec.get("state"))
+
+
+# ---- publish-then-commit ------------------------------------------------
+
+def _bitwise_equal(a: np.ndarray, b: np.ndarray) -> bool:
+    a, b = np.asarray(a), np.asarray(b)
+    return (
+        a.shape == b.shape and a.dtype == b.dtype
+        and a.tobytes() == b.tobytes()
+    )
+
+
+def publish_chunk(
+    store,
+    plan: ElasticPlan,
+    ci: int,
+    host: Dict[str, np.ndarray],
+    *,
+    n_retries: int = 0,
+    qmask: Optional[np.ndarray] = None,
+    name: Optional[str] = None,
+) -> bool:
+    """Commit chunk ``ci``'s results: first commit wins; a later commit
+    (double-claim) VERIFIES bitwise identity field-by-field against the
+    committed entry and raises :class:`CommitMismatchError` on drift.
+    ``n_retries`` is deliberately NOT verified — how many times a worker
+    retried is operational history, not result identity, and two honest
+    workers legitimately differ there.  Returns True when this call's
+    bytes became the committed entry."""
+    from bdlz_tpu.parallel.sweep import chunk_entry_arrays, chunk_entry_ok
+
+    entry = name if name is not None else plan.entry_name(ci)
+    lo, hi = plan.chunk_bounds(ci)
+    fresh = chunk_entry_arrays(host, n_retries=n_retries, qmask=qmask)
+    existing = store.get_npz(entry)
+    if chunk_entry_ok(existing, hi - lo):
+        for f in (*plan.fields, "failed"):
+            if not _bitwise_equal(existing[f], fresh[f]):
+                raise CommitMismatchError(
+                    f"chunk {ci} re-commit disagrees with the committed "
+                    f"entry on field {f!r} (entry {entry}): the chunk "
+                    "engine is non-deterministic or the store is corrupt"
+                )
+        q_old = existing.get("quarantined")
+        q_new = fresh.get("quarantined")
+        if (q_old is None) != (q_new is None) or (
+            q_old is not None and not _bitwise_equal(q_old, q_new)
+        ):
+            raise CommitMismatchError(
+                f"chunk {ci} re-commit disagrees with the committed entry "
+                f"on the quarantine mask (entry {entry})"
+            )
+        return False  # first commit already won, bits verified identical
+    store.put_npz(entry, fresh)
+    return True
+
+
+# ---- the in-process elastic driver --------------------------------------
+
+def run_sweep_elastic(
+    base: Config,
+    axes,
+    static: StaticChoices,
+    *,
+    store,
+    chunk_size: int = 4096,
+    n_y: int = 8000,
+    impl: str = "tabulated",
+    table_nodes: int = 16384,
+    interpret: bool = False,
+    fuse_exp: bool = False,
+    fault_plan=None,
+    retry=None,
+    n_workers: int = 2,
+    lease_ttl_s: float = 60.0,
+    quarantine_after: int = 3,
+    churn_plan=None,
+    churn_schedule: Optional[Sequence[Tuple[int, str]]] = None,
+    clock: Optional[ManualClock] = None,
+    tick_s: float = 1.0,
+    on_chunk: Optional[Callable[[int, int, int, Dict[str, np.ndarray]], Any]] = None,
+    max_rounds: Optional[int] = None,
+    keep_outputs: bool = True,
+    event_log=None,
+):
+    """Run a sweep on an elastic in-process worker fleet; returns a
+    :class:`~bdlz_tpu.parallel.sweep.SweepResult` whose output fields
+    are bitwise-equal to single-host ``run_sweep(mesh=None)``.
+
+    The driver is a DETERMINISTIC round loop (the multiprocess harness
+    in ``tests/`` runs the same protocol with real processes): each
+    round requeues expired leases, steps every live worker once
+    (claim → compute/heal → publish → commit → complete), folds every
+    newly committed chunk into the preallocated result arrays
+    (``on_chunk(ci, lo, hi, entry)`` observes each fold — the streaming
+    consumer seam), applies the scripted ``churn_schedule``
+    (``(round, "spawn"|"kill")`` — workers joining/leaving mid-sweep),
+    and advances the injectable ``clock`` by ``tick_s``.  A fold that
+    finds a torn/unreadable entry requeues the chunk (detect-and-
+    recompute); fleet-quarantined chunks fold as NaN + quarantine mask.
+    If every worker has died and claimable work remains, a replacement
+    worker is spawned — elasticity means the fleet recovers, it does
+    not deadlock.  ``max_rounds`` (default scales with the chunk count)
+    turns a genuinely stuck protocol into a loud :class:`ElasticError`.
+
+    ``churn_plan`` (sites ``worker_crash``/``lease``/``store_read``) is
+    operational-only — it never joins result identity.  ``fault_plan``
+    (site ``step``) is the identity-joined plan exactly as in
+    ``run_sweep``."""
+    from bdlz_tpu.faults import FaultPlan
+    from bdlz_tpu.parallel.sweep import SweepResult, chunk_entry_ok
+    from bdlz_tpu.parallel.worker import Worker
+    from bdlz_tpu.provenance import resolve_store
+
+    store = resolve_store(store, base, label="elastic")
+    if store is None:
+        raise ElasticError(
+            "elastic mode needs a trusted store (the lease/commit plane "
+            "lives there); pass store=/path or a Store"
+        )
+    churn = churn_plan
+    if isinstance(churn, str):
+        churn = FaultPlan.from_json(churn)
+    if churn is not None:
+        store.arm_faults(churn)  # site "store_read": torn reads
+
+    plan = plan_elastic_sweep(
+        base, axes, static, chunk_size=chunk_size, n_y=n_y, impl=impl,
+        table_nodes=table_nodes, interpret=interpret, fuse_exp=fuse_exp,
+        fault_plan=fault_plan, retry=retry,
+    )
+    ensure_job_record(store, plan)
+    if clock is None:
+        clock = ManualClock()
+    leases = LeasePlane(
+        store, plan.job, plan.n_chunks, ttl_s=lease_ttl_s,
+        quarantine_after=quarantine_after, clock=clock, faults=churn,
+    )
+
+    # one shared lazily-built engine: every in-process worker runs the
+    # identical jitted step (ONE compile per driver, like run_sweep)
+    engine_box: Dict[str, Any] = {}
+    t0 = time.time()
+
+    out = {f: np.full(plan.n_total, np.nan) for f in plan.fields}
+    failed = np.zeros(plan.n_total, dtype=bool)
+    quarantined = np.zeros(plan.n_total, dtype=bool)
+    folded = np.zeros(plan.n_chunks, dtype=bool)
+    n_retries = 0
+    cache_hits = 0
+
+    def _fold(ci: int, ent: Dict[str, np.ndarray]) -> None:
+        nonlocal n_retries
+        lo, hi = plan.chunk_bounds(ci)
+        for f in plan.fields:
+            out[f][lo:hi] = ent[f]
+        failed[lo:hi] = np.asarray(ent["failed"], dtype=bool)
+        if "quarantined" in ent:
+            quarantined[lo:hi] = np.asarray(ent["quarantined"], dtype=bool)
+        n_retries += int(ent.get("n_retries", 0))
+        folded[ci] = True
+        if on_chunk is not None:
+            on_chunk(ci, lo, hi, ent)
+        if event_log is not None:
+            event_log.emit(
+                "elastic_fold", chunk=ci,
+                n_failed=int(np.asarray(ent["failed"]).sum()),
+            )
+
+    def _fold_quarantined(ci: int) -> None:
+        lo, hi = plan.chunk_bounds(ci)
+        ent = {f: np.full(hi - lo, np.nan) for f in plan.fields}
+        ent["failed"] = np.ones(hi - lo, dtype=bool)
+        ent["quarantined"] = np.ones(hi - lo, dtype=bool)
+        _fold(ci, ent)
+        if event_log is not None:
+            event_log.emit("elastic_quarantine", chunk=ci, lo=lo, hi=hi)
+
+    # prescan: chunks already committed (a warm store — e.g. a prior
+    # run_sweep of the same spec) fold immediately and are marked done;
+    # a fully warm run never builds the engine (the run_sweep laziness
+    # contract, kept here)
+    for ci in range(plan.n_chunks):
+        lo, hi = plan.chunk_bounds(ci)
+        ent = store.get_npz(plan.entry_name(ci))
+        if chunk_entry_ok(ent, hi - lo):
+            leases.complete(ci, "prescan")
+            _fold(ci, ent)
+            cache_hits += 1
+
+    workers: List[Worker] = []
+    spawned = 0
+
+    def _spawn() -> Worker:
+        nonlocal spawned
+        w = Worker(
+            f"w{spawned}", plan, leases, store, engine_box=engine_box,
+            churn=churn, event_log=event_log,
+        )
+        spawned += 1
+        workers.append(w)
+        return w
+
+    for _ in range(max(int(n_workers), 1)):
+        _spawn()
+
+    schedule = sorted(
+        (int(r), str(action)) for r, action in (churn_schedule or [])
+    )
+    # every chunk can in the worst case be re-queued quarantine_after
+    # times and each requeue needs a TTL's worth of rounds to expire —
+    # anything beyond that bound is a protocol deadlock, not progress
+    ttl_rounds = max(int(np.ceil(lease_ttl_s / max(tick_s, 1e-9))), 1)
+    if max_rounds is None:
+        max_rounds = (
+            10 + plan.n_chunks * (quarantine_after + 1) * (ttl_rounds + 2)
+            + 2 * len(schedule)
+        )
+
+    round_i = 0
+    while not folded.all():
+        if round_i >= max_rounds:
+            raise ElasticError(
+                f"elastic sweep made no full progress after {round_i} "
+                f"rounds ({int(folded.sum())}/{plan.n_chunks} chunks "
+                "folded); protocol deadlock"
+            )
+        # scripted churn: workers joining/leaving mid-sweep
+        for r, action in schedule:
+            if r != round_i:
+                continue
+            if action == "spawn":
+                _spawn()
+            elif action == "kill" and workers:
+                workers.pop(0).kill()
+            else:
+                raise ElasticError(f"unknown churn action {action!r}")
+        leases.requeue_expired()
+        live = [w for w in workers if w.alive]
+        if not live and not folded.all():
+            # the whole fleet died with work outstanding: elasticity
+            # means replacements join, not that the sweep stalls
+            live = [_spawn()]
+            if event_log is not None:
+                event_log.emit("elastic_respawn", round=round_i)
+        for w in live:
+            w.step()
+        workers[:] = [w for w in workers if w.alive]
+        # fold pass: everything committed (or fleet-quarantined) lands
+        for ci in range(plan.n_chunks):
+            if folded[ci]:
+                continue
+            rec = leases.read(ci)
+            if rec is None:
+                continue
+            if rec.get("state") == "quarantined":
+                _fold_quarantined(ci)
+                continue
+            if rec.get("state") != "done":
+                continue
+            lo, hi = plan.chunk_bounds(ci)
+            entry = rec.get("entry") or plan.entry_name(ci)
+            ent = store.get_npz(entry)
+            if not chunk_entry_ok(ent, hi - lo):
+                # torn store read (or vanished entry): detect-and-
+                # recompute — the chunk goes back on the queue
+                leases.requeue(ci)
+                continue
+            _fold(ci, ent)
+        clock.advance(tick_s)
+        round_i += 1
+
+    seconds = time.time() - t0
+    if plan.impl in ("tabulated", "pallas", "direct"):
+        quad_impl = "panel_gl" if plan.quad_on else "trap"
+        n_quad = plan.quad_nodes if plan.quad_on else max(int(plan.n_y), 2000)
+    else:
+        quad_impl, n_quad = None, None
+    return SweepResult(
+        n_points=plan.n_total,
+        n_failed=int(failed.sum()),
+        seconds=seconds,
+        points_per_sec=plan.n_total / max(seconds, 1e-9),
+        out_dir=None,
+        chunks=plan.n_chunks,
+        resumed_chunks=0,
+        quad_impl=quad_impl,
+        n_quad_nodes=n_quad,
+        n_quarantined=int(quarantined.sum()),
+        n_retries=n_retries,
+        cache_hits=cache_hits,
+        cache_misses=plan.n_chunks - cache_hits,
+        outputs=dict(out) if keep_outputs else None,
+        failed_mask=failed,
+        quarantined_mask=quarantined,
+    )

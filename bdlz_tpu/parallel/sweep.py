@@ -761,6 +761,155 @@ def _pad_chunk(pp: PointParams, lo: int, hi: int, chunk: int) -> PointParams:
     return PointParams(*(cut(np.asarray(f)) for f in pp))
 
 
+def build_chunk_engine(
+    base: Config,
+    static: StaticChoices,
+    *,
+    mesh=None,
+    n_y: int,
+    use_table: bool,
+    impl: str,
+    interpret: bool = False,
+    fuse_exp: bool = False,
+    pallas_reduce=None,
+    table_np=None,
+    table_nodes: int = 16384,
+    esdirk_knobs=None,
+    esdirk_stats_sink=None,
+):
+    """Build the (jitted step, engine aux) pair for one chunk shape.
+
+    The engine-construction half of ``run_sweep``'s lazy ``_ensure_engine``
+    — factored out so elastic workers (``parallel/worker.py``) build the
+    IDENTICAL engine from the identical resolved knobs: any drift here is
+    bit drift between a serial sweep and its elastic replay.  All
+    identity-affecting resolution (pallas tier, esdirk knobs, quadrature)
+    must already have happened; this only ships tables and compiles.
+    ``table_np`` reuses a host-built F-table (same bytes, shipped) so the
+    quadrature audit and the engine share one table.
+    """
+    from bdlz_tpu.backend import ensure_x64
+
+    # x64 must be on BEFORE aux arrays ship: pre-x64 jnp.asarray silently
+    # truncates the f64 table to f32, so the first engine of a process
+    # would carry different bits than every later one (and than an
+    # elastic worker's) — the bitwise-replay contract forbids that
+    ensure_x64()
+    import jax.numpy as jnp
+
+    from bdlz_tpu.ops.kjma_table import make_f_table
+    from bdlz_tpu.physics.percolation import make_kjma_grid
+
+    if impl in ("direct", "esdirk", "esdirk_lockstep"):
+        aux = make_kjma_grid(jnp)
+    else:
+        if table_np is not None:
+            # reuse the audit's host-built table (same bytes, shipped)
+            from bdlz_tpu.ops.kjma_table import table_to_namespace
+
+            table = table_to_namespace(table_np, jnp)
+        else:
+            table = make_f_table(float(base.I_p), jnp, n=table_nodes)
+        if impl == "pallas":
+            from bdlz_tpu.ops.kjma_pallas import build_shifted_table
+
+            aux = (table, build_shifted_table(table))
+        else:
+            aux = table
+    step = make_sweep_step(
+        static, mesh=mesh, n_y=n_y, use_table=use_table, impl=impl,
+        interpret=interpret, fuse_exp=fuse_exp, reduce=pallas_reduce,
+        esdirk_stats_sink=esdirk_stats_sink,
+        esdirk_knobs=esdirk_knobs,
+    )
+    return step, aux
+
+
+def heal_budget(n: int, max_attempts: int) -> int:
+    """Attempt budget for healing one chunk of ``n`` points: enough to
+    retry and to bisect-isolate a handful of poison points (each
+    isolation costs ~log2(n) probes), but BOUNDED — a chunk where
+    *everything* fails persistently (config bug, dead device) must
+    wholesale-quarantine after O(log n) probes, not grind through O(n)
+    full-chunk re-executions that would turn a seconds-long crash into
+    hours.  Shared by ``run_sweep`` and the elastic worker so both pay
+    the same budget for the same chunk."""
+    attempts = max(int(max_attempts), 1)
+    return attempts * 4 * (1 + max(int(n) - 1, 1).bit_length())
+
+
+def heal_range(
+    ci: int,
+    lo: int,
+    hi: int,
+    first_err,
+    *,
+    attempt,
+    quarantine,
+    policy,
+    budget,
+    paid,
+    fields,
+    on_retry=None,
+):
+    """Generic retry → bisect → quarantine over [lo, hi) — THE healing
+    semantics (docs/robustness.md), shared by ``run_sweep`` and the
+    elastic worker so a chunk heals identically wherever it runs.
+
+    ``attempt(ci, a, b) -> (ok, host, err)`` is one evaluation over
+    [a, b) (``host`` is the final per-field dict on success);
+    ``quarantine(ci, a, b, err) -> (host, qmask)`` produces the NaN
+    fill + mask for an irreducible range.  Bounded retry with the
+    DETERMINISTIC backoff schedule (``backoff_delay`` keyed on
+    ``chunk<ci>:<lo>`` — identical on every process/worker); persistent
+    failure bisects (surviving halves kept) down to the irreducible
+    points.  ``budget`` is a 1-element list of remaining attempts shared
+    across the chunk's whole heal tree; exhaustion quarantines the range
+    wholesale.  ``paid`` is the chunk's own retry counter (a 1-element
+    list), incremented once per extra attempt — callers attribute
+    retries through its delta.  ``on_retry(ci, lo, hi, attempt, err)``
+    observes same-range retries (the event-log hook)."""
+    from bdlz_tpu.utils.retry import backoff_delay
+
+    err = first_err
+    attempts = max(int(policy.max_attempts), 1)
+    for att in range(1, attempts):
+        if budget[0] <= 0:
+            break
+        if on_retry is not None:
+            on_retry(ci, lo, hi, att, err)
+        policy.sleep(backoff_delay(policy, f"chunk{ci}:{lo}", att - 1))
+        paid[0] += 1
+        budget[0] -= 1
+        ok, host, err2 = attempt(ci, lo, hi)
+        if ok:
+            return host, np.zeros(hi - lo, dtype=bool)
+        err = err2 if err2 is not None else err
+    if hi - lo <= 1 or budget[0] <= 0:
+        return quarantine(ci, lo, hi, err)
+    mid = lo + (hi - lo) // 2
+    parts = []
+    for a, b in ((lo, mid), (mid, hi)):
+        if budget[0] <= 0:
+            parts.append(quarantine(ci, a, b, err))
+            continue
+        paid[0] += 1
+        budget[0] -= 1
+        ok, host, err_h = attempt(ci, a, b)
+        if ok:
+            parts.append((host, np.zeros(b - a, dtype=bool)))
+        else:
+            parts.append(heal_range(
+                ci, a, b, err_h, attempt=attempt, quarantine=quarantine,
+                policy=policy, budget=budget, paid=paid, fields=fields,
+                on_retry=on_retry,
+            ))
+    return (
+        {f: np.concatenate([p[0][f] for p in parts]) for f in fields},
+        np.concatenate([p[1] for p in parts]),
+    )
+
+
 def run_sweep(
     base: Config,
     axes: Mapping[str, Sequence[float]],
@@ -856,8 +1005,6 @@ def run_sweep(
     import jax.numpy as jnp
 
     from bdlz_tpu.models.yields_pipeline import YieldsResult
-    from bdlz_tpu.ops.kjma_table import make_f_table
-    from bdlz_tpu.physics.percolation import make_kjma_grid
 
     # Robustness resolution (docs/robustness.md): the fault plan defaults
     # OFF (explicit arg ▸ config ▸ BDLZ_FAULT_PLAN env) and the retry
@@ -865,7 +1012,7 @@ def run_sweep(
     # host-side functions of config/env, so every multi-controller
     # process resolves identically without a broadcast.
     from bdlz_tpu.faults import FaultPlan
-    from bdlz_tpu.utils.retry import backoff_delay, resolve_engine_retry
+    from bdlz_tpu.utils.retry import resolve_engine_retry
 
     faults = FaultPlan.resolve(fault_plan, base)
     retry_policy = resolve_engine_retry(retry, base, static)
@@ -1140,30 +1287,15 @@ def run_sweep(
     def _ensure_engine():
         if "step" in _engine:
             return _engine["step"], _engine["aux"]
-        if impl in ("direct", "esdirk", "esdirk_lockstep"):
-            aux = make_kjma_grid(jnp)
-        else:
-            if table_np is not None:
-                # reuse the audit's host-built table (same bytes, shipped)
-                from bdlz_tpu.ops.kjma_table import table_to_namespace
-
-                table = table_to_namespace(table_np, jnp)
-            else:
-                table = make_f_table(float(base.I_p), jnp, n=table_nodes)
-            if impl == "pallas":
-                from bdlz_tpu.ops.kjma_pallas import build_shifted_table
-
-                aux = (table, build_shifted_table(table))
-            else:
-                aux = table
-        _engine["aux"] = aux
-        _engine["step"] = make_sweep_step(
-            static, mesh=mesh, n_y=n_y, use_table=use_table, impl=impl,
-            interpret=interpret, fuse_exp=fuse_exp, reduce=pallas_reduce,
+        step, aux = build_chunk_engine(
+            base, static, mesh=mesh, n_y=n_y, use_table=use_table,
+            impl=impl, interpret=interpret, fuse_exp=fuse_exp,
+            pallas_reduce=pallas_reduce, table_np=table_np,
+            table_nodes=table_nodes, esdirk_knobs=esdirk_knobs,
             esdirk_stats_sink=_esdirk_stats_holder.append,
-            esdirk_knobs=esdirk_knobs,
         )
-        return _engine["step"], _engine["aux"]
+        _engine["step"], _engine["aux"] = step, aux
+        return step, aux
 
     from bdlz_tpu.parallel.multihost import (
         broadcast_from_coordinator,
@@ -1438,72 +1570,42 @@ def run_sweep(
         )
 
     def _heal_budget(n: int) -> int:
-        """Attempt budget for healing one chunk: enough to retry and to
-        bisect-isolate a handful of poison points (each isolation costs
-        ~log2(n) probes), but BOUNDED — a chunk where *everything* fails
-        persistently (config bug, dead device) must wholesale-quarantine
-        after O(log n) probes, not grind through O(n) full-chunk
-        re-executions that would turn a seconds-long crash into hours."""
-        attempts = max(int(retry_policy.max_attempts), 1)
-        return attempts * 4 * (1 + max(int(n) - 1, 1).bit_length())
+        return heal_budget(n, retry_policy.max_attempts)
+
+    def _attempt_healed(ci, lo_r, hi_r):
+        # the shared heal_range wants final bits from a successful
+        # attempt, so injected NaN points are applied inside the closure
+        ok, host, err = _attempt_range(ci, lo_r, hi_r)
+        if ok:
+            host = _apply_nan_faults(host, lo_r, hi_r)
+        return ok, host, err
+
+    def _on_retry(ci, lo_r, hi_r, attempt, err):
+        if event_log is not None:
+            event_log.emit(
+                "chunk_retry", chunk=ci, lo=lo_r, hi=hi_r,
+                attempt=attempt, error=repr(err),
+            )
 
     def _heal_range(ci, lo_r, hi_r, first_err, budget, paid):
-        """Bounded retry with deterministic backoff; persistent failure
-        bisects (surviving halves kept) down to the irreducible points,
-        which are quarantined into the failure mask.  ``budget`` is a
-        1-element list of remaining attempts shared across the chunk's
-        whole heal tree; exhaustion quarantines the range wholesale.
-        ``paid`` is the CHUNK's own retry counter (a 1-element list on
-        its loop entry): the cache stores it per entry, and attributing
-        through the global counter instead would let an overlapped
-        neighbor's collect-time healing leak into this chunk's delta."""
+        """The shared retry → bisect → quarantine (module-level
+        :func:`heal_range`) wired to this sweep's attempt/quarantine/
+        event closures.  ``paid`` is the CHUNK's own retry counter (a
+        1-element list on its loop entry): the cache stores it per
+        entry, and the global ``n_retries`` is advanced by its delta —
+        attributing through the global counter instead would let an
+        overlapped neighbor's collect-time healing leak into this
+        chunk's delta."""
         nonlocal n_retries
-        err = first_err
-        attempts = max(int(retry_policy.max_attempts), 1)
-        for attempt in range(1, attempts):
-            if budget[0] <= 0:
-                break
-            if event_log is not None:
-                event_log.emit(
-                    "chunk_retry", chunk=ci, lo=lo_r, hi=hi_r,
-                    attempt=attempt, error=repr(err),
-                )
-            retry_policy.sleep(
-                backoff_delay(retry_policy, f"chunk{ci}:{lo_r}", attempt - 1)
-            )
-            n_retries += 1
-            paid[0] += 1
-            budget[0] -= 1
-            ok, host, err2 = _attempt_range(ci, lo_r, hi_r)
-            if ok:
-                return (
-                    _apply_nan_faults(host, lo_r, hi_r),
-                    np.zeros(hi_r - lo_r, dtype=bool),
-                )
-            err = err2 if err2 is not None else err
-        if hi_r - lo_r <= 1 or budget[0] <= 0:
-            return _quarantine_range(ci, lo_r, hi_r, err)
-        mid = lo_r + (hi_r - lo_r) // 2
-        parts = []
-        for a, b in ((lo_r, mid), (mid, hi_r)):
-            if budget[0] <= 0:
-                parts.append(_quarantine_range(ci, a, b, err))
-                continue
-            n_retries += 1
-            paid[0] += 1
-            budget[0] -= 1
-            ok, host, err_h = _attempt_range(ci, a, b)
-            if ok:
-                parts.append((
-                    _apply_nan_faults(host, a, b),
-                    np.zeros(b - a, dtype=bool),
-                ))
-            else:
-                parts.append(_heal_range(ci, a, b, err_h, budget, paid))
-        return (
-            {f: np.concatenate([p[0][f] for p in parts]) for f in fields},
-            np.concatenate([p[1] for p in parts]),
+        before = paid[0]
+        out = heal_range(
+            ci, lo_r, hi_r, first_err,
+            attempt=_attempt_healed, quarantine=_quarantine_range,
+            policy=retry_policy, budget=budget, paid=paid,
+            fields=fields, on_retry=_on_retry,
         )
+        n_retries += paid[0] - before
+        return out
 
     def _collect() -> None:
         nonlocal inflight, n_failed, n_quarantined, n_retries

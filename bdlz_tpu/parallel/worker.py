@@ -1,0 +1,310 @@
+"""Elastic sweep worker: claim → compute/heal → publish → commit.
+
+One :class:`Worker` is one fleet member of the elastic scheduler
+(``parallel/scheduler.py``): it scans the lease plane for the
+lowest-index claimable chunk, computes it with the SAME jitted chunk
+engine ``run_sweep`` builds (``build_chunk_engine`` — identical resolved
+knobs in, identical bits out), heals per-chunk failures through the
+shared retry → bisect → quarantine path (``heal_range`` with the
+deterministic backoff schedule), publishes the result through the
+atomic, durable content-addressed store, and commits via
+``publish_chunk`` (first commit wins; re-commits verify bitwise).
+
+The in-process driver steps workers cooperatively (one chunk per
+``step()``) so churn tests are deterministic; :func:`run_worker_loop`
+is the external entry (``sweep_cli --elastic worker``) that runs the
+same protocol against wall-clock leases until the job drains.
+
+Injected ``worker_crash`` churn faults kill the worker at compute
+start — its lease dangles until TTL expiry requeues the chunk and
+records the dead worker on the distinct-failures list.  Crashes are
+operational churn: they never touch result bits.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional
+
+import numpy as np  # host-side orchestration only (bdlz-lint R1 audit)
+
+from bdlz_tpu.parallel.scheduler import ElasticPlan, LeasePlane, publish_chunk
+
+
+class WorkerCrashError(RuntimeError):
+    """An injected (or simulated) whole-worker death — the worker stops
+    mid-lease; recovery is the LEASE plane's job, not the worker's."""
+
+
+class Worker:
+    """One elastic fleet member (see module docstring).
+
+    ``engine_box`` is a shared dict: in-process fleets pass one box so
+    the jitted step compiles ONCE per driver; a real worker process
+    owns its own box.  ``churn`` is the operational fault plan (sites
+    ``worker_crash``/``lease``/``store_read``), distinct from the
+    identity-joined ``plan.faults`` (site ``step``)."""
+
+    def __init__(
+        self,
+        name: str,
+        plan: ElasticPlan,
+        leases: LeasePlane,
+        store,
+        *,
+        engine_box: Optional[Dict[str, Any]] = None,
+        churn=None,
+        event_log=None,
+    ):
+        self.name = str(name)
+        self.plan = plan
+        self.leases = leases
+        self.store = store
+        self.engine_box = engine_box if engine_box is not None else {}
+        self.churn = churn
+        self.event_log = event_log
+        self.alive = True
+        self.chunks_done = 0
+
+    # -- engine -----------------------------------------------------------
+
+    def _engine(self):
+        if "step" not in self.engine_box:
+            from bdlz_tpu.parallel.sweep import build_chunk_engine
+
+            p = self.plan
+            step, aux = build_chunk_engine(
+                p.base, p.static, mesh=None, n_y=p.n_y,
+                use_table=p.use_table, impl=p.impl, interpret=p.interpret,
+                fuse_exp=p.fuse_exp, pallas_reduce=p.pallas_reduce,
+                table_np=p.table_np, table_nodes=p.table_nodes,
+                esdirk_knobs=p.esdirk_knobs,
+            )
+            self.engine_box["step"] = step
+            self.engine_box["aux"] = aux
+        return self.engine_box["step"], self.engine_box["aux"]
+
+    # -- compute ----------------------------------------------------------
+
+    def _apply_nan(self, host, lo, hi):
+        faults = self.plan.faults
+        pts = faults.nan_points("step", lo, hi) if faults is not None else []
+        if pts:
+            for f in self.plan.fields:
+                arr = np.array(host[f])
+                for p in pts:
+                    arr[p - lo] = np.nan
+                host[f] = arr
+        return host
+
+    def _attempt(self, ci, lo, hi):
+        """One engine evaluation over [lo, hi), padded to the plan's ONE
+        chunk shape — the elastic twin of ``run_sweep``'s
+        ``_attempt_range`` (heartbeat added: a long compute must not
+        let the lease lapse under its own worker)."""
+        from bdlz_tpu.parallel.sweep import _pad_chunk
+
+        ok, host, err = 1, None, None
+        try:
+            self.leases.heartbeat(ci, self.name)
+            if self.plan.faults is not None:
+                self.plan.faults.fire("step", ci)
+                self.plan.faults.check_range("step", lo, hi)
+            ppc = _pad_chunk(self.plan.pp_all, lo, hi, self.plan.chunk_size)
+            step, aux = self._engine()
+            res = step(ppc, aux)
+            host = {
+                f: np.asarray(getattr(res, f))[: hi - lo]
+                for f in self.plan.fields
+            }
+        except Exception as exc:  # noqa: BLE001 — healing path decides
+            ok, err = 0, exc
+        return ok, host, err
+
+    def _attempt_healed(self, ci, lo, hi):
+        ok, host, err = self._attempt(ci, lo, hi)
+        if ok:
+            host = self._apply_nan(host, lo, hi)
+        return ok, host, err
+
+    def _quarantine(self, ci, lo, hi, err):
+        if self.event_log is not None:
+            self.event_log.emit(
+                "chunk_quarantine", chunk=ci, lo=lo, hi=hi,
+                n_points=hi - lo, error=repr(err), worker=self.name,
+            )
+        return (
+            {f: np.full(hi - lo, np.nan) for f in self.plan.fields},
+            np.ones(hi - lo, dtype=bool),
+        )
+
+    def _compute(self, ci):
+        """Compute/heal chunk ``ci``; returns (host, qmask, retries_paid).
+        Raises :class:`WorkerCrashError` when an injected ``worker_crash``
+        fault kills this worker at compute start."""
+        from bdlz_tpu.faults import FaultError
+        from bdlz_tpu.parallel.sweep import heal_budget, heal_range
+
+        if self.churn is not None:
+            try:
+                self.churn.fire("worker_crash", ci)
+            except FaultError as exc:
+                raise WorkerCrashError(str(exc)) from exc
+        lo, hi = self.plan.chunk_bounds(ci)
+        paid = [0]
+        ok, host, err = self._attempt(ci, lo, hi)
+        if ok:
+            return self._apply_nan(host, lo, hi), np.zeros(hi - lo, bool), 0
+        policy = self.plan.retry_policy
+        host, qmask = heal_range(
+            ci, lo, hi, err,
+            attempt=self._attempt_healed, quarantine=self._quarantine,
+            policy=policy, budget=[heal_budget(hi - lo, policy.max_attempts)],
+            paid=paid, fields=self.plan.fields,
+        )
+        return host, qmask, paid[0]
+
+    # -- the work loop body ----------------------------------------------
+
+    def step(self) -> bool:
+        """Claim and finish ONE chunk; True when work was done.  A crash
+        mid-compute leaves the lease dangling (TTL recovery); any other
+        unexpected error fails the lease explicitly so the chunk
+        requeues immediately."""
+        if not self.alive:
+            return False
+        ci = self._claim_next()
+        if ci is None:
+            return False
+        try:
+            host, qmask, paid = self._compute(ci)
+        except WorkerCrashError as exc:
+            self.alive = False  # lease dangles; TTL expiry requeues
+            if self.event_log is not None:
+                self.event_log.emit(
+                    "worker_crash", worker=self.name, chunk=ci,
+                    error=repr(exc),
+                )
+            return True
+        except Exception as exc:  # noqa: BLE001 — lease-plane requeue
+            self.leases.fail(ci, self.name, err=exc)
+            return True
+        entry = None
+        if qmask.any() and self.plan.faults is None:
+            # a REAL (plan-less) quarantine must never live under the
+            # content-addressed cache name — the next clean run must
+            # recompute, not replay NaNs (the run_sweep cache guard)
+            entry = f"elastic_scratch/{self.plan.job}_{int(ci):05d}.npz"
+        publish_chunk(
+            self.store, self.plan, ci, host,
+            n_retries=paid, qmask=qmask, name=entry,
+        )
+        self.leases.complete(ci, self.name, entry=entry)
+        self.chunks_done += 1
+        return True
+
+    def _claim_next(self) -> Optional[int]:
+        for ci in range(self.plan.n_chunks):
+            if self.leases.claim(ci, self.name):
+                return ci
+        return None
+
+    def kill(self) -> None:
+        """Scripted churn: this worker leaves the fleet NOW; whatever it
+        holds dangles until TTL expiry (exactly like a real host loss)."""
+        self.alive = False
+
+
+def run_worker_loop(
+    base,
+    axes,
+    static,
+    *,
+    store,
+    worker_id: str,
+    chunk_size: int = 4096,
+    n_y: int = 8000,
+    impl: str = "tabulated",
+    table_nodes: int = 16384,
+    interpret: bool = False,
+    fuse_exp: bool = False,
+    fault_plan=None,
+    retry=None,
+    lease_ttl_s: float = 60.0,
+    quarantine_after: int = 3,
+    churn_plan=None,
+    poll_s: float = 1.0,
+    max_idle_s: float = 600.0,
+    sleep=time.sleep,
+    clock=time.time,
+    event_log=None,
+) -> Dict[str, Any]:
+    """External worker entry (``sweep_cli --elastic worker``): derive the
+    plan from the SAME inputs as every other role, validate against the
+    job record, then claim/compute/commit until the job drains (every
+    chunk done or quarantined).  Waits between empty scans go through
+    the injectable ``sleep`` (bdlz-lint R7); ``max_idle_s`` with no
+    claimable work and an undrained job raises — a worker that can
+    neither help nor finish is misconfigured, not patient."""
+    from bdlz_tpu.faults import FaultPlan
+    from bdlz_tpu.parallel.scheduler import (
+        ElasticError,
+        LeasePlane,
+        ensure_job_record,
+        plan_elastic_sweep,
+    )
+    from bdlz_tpu.provenance import resolve_store
+
+    store = resolve_store(store, base, label="elastic-worker")
+    if store is None:
+        raise ElasticError(
+            "elastic worker needs a trusted store; pass store=/path"
+        )
+    churn = churn_plan
+    if isinstance(churn, str):
+        churn = FaultPlan.from_json(churn)
+    if churn is not None:
+        store.arm_faults(churn)
+    plan = plan_elastic_sweep(
+        base, axes, static, chunk_size=chunk_size, n_y=n_y, impl=impl,
+        table_nodes=table_nodes, interpret=interpret, fuse_exp=fuse_exp,
+        fault_plan=fault_plan, retry=retry,
+    )
+    ensure_job_record(store, plan)
+    leases = LeasePlane(
+        store, plan.job, plan.n_chunks, ttl_s=lease_ttl_s,
+        quarantine_after=quarantine_after, clock=clock, faults=churn,
+    )
+    worker = Worker(
+        worker_id, plan, leases, store, churn=churn, event_log=event_log,
+    )
+    idle_since = None
+    while worker.alive:
+        # any worker can requeue expired leases — external fleets need
+        # no coordinator for liveness, only for the fold
+        leases.requeue_expired()
+        if worker.step():
+            idle_since = None
+            continue
+        drained = all(
+            leases.state(ci) in ("done", "quarantined")
+            for ci in range(plan.n_chunks)
+        )
+        if drained:
+            break
+        now = float(clock())
+        if idle_since is None:
+            idle_since = now
+        elif now - idle_since >= float(max_idle_s):
+            raise ElasticError(
+                f"worker {worker_id} idle {max_idle_s}s with the job "
+                f"undrained (job {plan.job}); leases are stuck or the "
+                "fleet is misconfigured"
+            )
+        sleep(float(poll_s))
+    return {
+        "worker": worker_id,
+        "job": plan.job,
+        "alive": worker.alive,
+        "chunks_done": worker.chunks_done,
+        "n_chunks": plan.n_chunks,
+    }

@@ -22,8 +22,13 @@ from bdlz_tpu.provenance.identity import (
 )
 from bdlz_tpu.provenance.registry import (
     ARTIFACT_KIND,
+    LEASE_KIND,
+    create_lease,
     fetch_artifact,
+    lease_entry_name,
     publish_artifact,
+    read_lease,
+    write_lease,
 )
 from bdlz_tpu.provenance.store import (
     Store,
@@ -50,8 +55,13 @@ __all__ = [
     "sweep_chunk_identity",
     "sweep_identity",
     "ARTIFACT_KIND",
+    "LEASE_KIND",
     "fetch_artifact",
     "publish_artifact",
+    "lease_entry_name",
+    "read_lease",
+    "write_lease",
+    "create_lease",
     "Store",
     "StoreStats",
     "StoreUntrustedError",

@@ -145,3 +145,65 @@ def fetch_artifact(store: Store, content_hash: str, fault_plan=None):
         )
     store.stats.hits += 1
     return artifact
+
+
+# ---- lease records (the elastic scheduler's claim plane) ----------------
+#
+# One small JSON record per (job, chunk) under ``lease/`` in the shared
+# store.  The *policy* (TTLs, steal-on-expiry, distinct-failure
+# quarantine) lives in ``parallel/scheduler.py``; this layer provides
+# only the storage primitives, with the one property the policy cannot
+# build for itself: an EXCLUSIVE create (``os.link`` of a temp file —
+# atomic on POSIX, fails with EEXIST when another worker claimed first).
+# Overwrites (heartbeat, steal, complete) go through the store's atomic
+# durable JSON write; a lost overwrite race is safe because the commit
+# protocol (first ``put_npz`` wins, later commits verify bitwise) — not
+# the lease record — is what makes results correct.  A torn/corrupt
+# record reads as None (``Store.get_json`` drops it), which the policy
+# treats as a free chunk: the worst case is a double-computation the
+# commit protocol resolves.
+
+LEASE_KIND = "lease"
+
+
+def lease_entry_name(job: str, chunk: int) -> str:
+    """Store entry name of the lease record for ``(job, chunk)``."""
+    return f"{LEASE_KIND}/{job}_{int(chunk):05d}.json"
+
+
+def read_lease(store: Store, job: str, chunk: int):
+    """The lease record dict, or None when absent/torn (torn records are
+    evicted by the store and re-claimable — see module comment)."""
+    return store.get_json(lease_entry_name(job, chunk))
+
+
+def write_lease(store: Store, job: str, chunk: int, record) -> str:
+    """Atomically overwrite the lease record (heartbeat/steal/complete)."""
+    return store.put_json(lease_entry_name(job, chunk), record)
+
+
+def create_lease(store: Store, job: str, chunk: int, record) -> bool:
+    """Atomically create the lease record IFF absent; True when this
+    caller won the claim.  mkstemp + ``os.link`` (not ``os.replace``,
+    which would silently overwrite a racing winner): the link fails with
+    EEXIST when any other worker already holds the name."""
+    import json as jsonlib
+    import tempfile
+
+    path = store.path_for(lease_entry_name(job, chunk))
+    d = os.path.dirname(path)
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "w", encoding="utf-8") as f:
+            jsonlib.dump(record, f)
+        try:
+            os.link(tmp, path)
+        except FileExistsError:
+            return False
+        store.stats.writes += 1
+        return True
+    finally:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass

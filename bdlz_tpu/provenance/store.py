@@ -91,6 +91,28 @@ class Store:
             )
         self.root = root
         self.stats = StoreStats()
+        # deterministic read-side fault injection (site "store_read"),
+        # armed per-instance via arm_faults(); zero overhead when unarmed
+        self._faults = None
+        self._reads = 0
+
+    def arm_faults(self, plan) -> None:
+        """Arm a :class:`~bdlz_tpu.faults.FaultPlan` on this store's READ
+        side: ``get_npz``/``get_array`` fire ``store_read`` specs keyed by
+        a per-instance read call counter just before loading, so a torn
+        read is injected deterministically (the caller's detect-and-
+        recompute path — ``_drop_corrupt`` → miss — is what's under
+        test).  Pass ``None`` to disarm."""
+        self._faults = plan
+        self._reads = 0
+
+    def _read_fault(self, path: str) -> None:
+        if self._faults is None:
+            return
+        key = self._reads
+        self._reads += 1
+        self._faults.corrupt_file("store_read", key, path)
+        self._faults.corrupt_bytes("store_read", key, path)
 
     # ---- paths -------------------------------------------------------
 
@@ -138,6 +160,7 @@ class Store:
         if not os.path.exists(path):
             self.stats.misses += 1
             return None
+        self._read_fault(path)
         try:
             out = np.load(path)
         except Exception as exc:  # noqa: BLE001 — corrupt entry = miss
@@ -151,7 +174,9 @@ class Store:
         from bdlz_tpu.utils.io import atomic_save_npy
 
         path = self.path_for(name)
-        atomic_save_npy(path, np.asarray(arr))
+        # durable: a committed entry must survive host crash — the
+        # elastic lease protocol treats commit as done-forever
+        atomic_save_npy(path, np.asarray(arr), durable=True)
         self.stats.writes += 1
         return path
 
@@ -161,6 +186,7 @@ class Store:
         if not os.path.exists(path):
             self.stats.misses += 1
             return None
+        self._read_fault(path)
         try:
             with np.load(path) as data:
                 out = {k: np.asarray(data[k]) for k in data.files}
@@ -175,7 +201,7 @@ class Store:
         from bdlz_tpu.utils.io import atomic_savez
 
         path = self.path_for(name)
-        atomic_savez(path, **dict(arrays))
+        atomic_savez(path, durable=True, **dict(arrays))
         self.stats.writes += 1
         return path
 
@@ -198,7 +224,7 @@ class Store:
         from bdlz_tpu.utils.io import atomic_write_json
 
         path = self.path_for(name)
-        atomic_write_json(path, payload)
+        atomic_write_json(path, payload, durable=True)
         self.stats.writes += 1
         return path
 

@@ -36,6 +36,67 @@ def parse_axis(spec: str):
     return name.strip(), vals
 
 
+def _run_elastic(args, cfg, static, axes, event_log, interpret):
+    """Dispatch one ``--elastic`` role (see parallel/scheduler.py).
+
+    Every role derives the plan from the SAME ``--config``/``--axis``
+    flags — nothing spec-level is serialized between processes; the
+    store's job record only cross-validates.  Returns the fold-side
+    :class:`~bdlz_tpu.parallel.sweep.SweepResult` (local/coordinator),
+    or None for the worker role, which prints its own JSON summary."""
+    import os
+    import sys
+
+    from bdlz_tpu.parallel import (
+        WallClock,
+        elect_coordinator,
+        plan_elastic_sweep,
+        run_sweep_elastic,
+        run_worker_loop,
+    )
+    from bdlz_tpu.provenance import resolve_store
+
+    store = resolve_store(args.elastic_store, cfg, label="elastic-cli")
+    if store is None:
+        raise SystemExit(
+            f"--elastic-store {args.elastic_store!r} did not resolve to a "
+            "trusted store (check ownership/permissions)"
+        )
+    worker_id = args.worker_id or f"pid{os.getpid()}"
+    common = dict(
+        chunk_size=args.chunk, n_y=args.n_y, impl=args.impl,
+        interpret=interpret, fuse_exp=args.fuse_exp,
+    )
+    role = args.elastic
+    if role == "auto":
+        plan = plan_elastic_sweep(cfg, axes, static, **common)
+        won = elect_coordinator(
+            store, plan.job, worker_id, ttl_s=args.lease_ttl,
+        )
+        role = "coordinator" if won else "worker"
+        print(f"[elastic] {worker_id}: elected {role}", file=sys.stderr)
+    if role == "worker":
+        summary = run_worker_loop(
+            cfg, axes, static, store=store, worker_id=worker_id,
+            lease_ttl_s=args.lease_ttl,
+            quarantine_after=args.quarantine_after,
+            churn_plan=args.churn_plan, poll_s=args.poll,
+            event_log=event_log, **common,
+        )
+        print(json.dumps({"elastic": "worker", **summary}))
+        return None
+    # local: deterministic in-process fleet (ManualClock); coordinator:
+    # wall clock so lease arithmetic agrees with external workers
+    clock = None if role == "local" else WallClock()
+    return run_sweep_elastic(
+        cfg, axes, static, store=store, n_workers=args.elastic_workers,
+        lease_ttl_s=args.lease_ttl, quarantine_after=args.quarantine_after,
+        churn_plan=args.churn_plan, clock=clock,
+        tick_s=(1.0 if clock is None else args.poll),
+        event_log=event_log, **common,
+    )
+
+
 def main(argv=None) -> None:
     ap = argparse.ArgumentParser(description="bdlz_tpu parameter-sweep driver")
     ap.add_argument("--config", required=True, help="Base yields_config JSON")
@@ -111,9 +172,54 @@ def main(argv=None) -> None:
                     help="Initialize jax.distributed from JAX_COORDINATOR_ADDRESS/"
                          "JAX_NUM_PROCESSES/JAX_PROCESS_ID before building the mesh "
                          "(run one identical invocation per host)")
+    ap.add_argument("--elastic", default=None,
+                    choices=("local", "coordinator", "worker", "auto"),
+                    help="Elastic work-stealing mode (parallel/scheduler.py): "
+                         "local (in-process fleet, deterministic clock), "
+                         "coordinator (drive + fold against external workers, "
+                         "wall clock), worker (claim/compute/commit loop only; "
+                         "prints a worker summary), auto (lease-elect: first "
+                         "process to win the coordinator lease drives, the "
+                         "rest work).  Every role re-derives the plan from "
+                         "the same --config/--axis flags; drift fails loudly")
+    ap.add_argument("--elastic-store", default=None,
+                    help="Shared store root for the elastic lease/commit "
+                         "plane (required with --elastic)")
+    ap.add_argument("--elastic-workers", type=int, default=2,
+                    help="In-process fleet size for --elastic local/coordinator")
+    ap.add_argument("--worker-id", default=None,
+                    help="Stable worker name for --elastic worker/auto "
+                         "(default: pid-derived)")
+    ap.add_argument("--lease-ttl", type=float, default=60.0,
+                    help="Elastic lease TTL in seconds (expired leases are "
+                         "stolen/requeued)")
+    ap.add_argument("--quarantine-after", type=int, default=3,
+                    help="Fleet-quarantine a chunk after it failed on this "
+                         "many DISTINCT workers")
+    ap.add_argument("--churn-plan", default=None,
+                    help="Operational fault plan JSON/path (sites "
+                         "worker_crash/lease/store_read) — churn-test "
+                         "harness use; never joins result identity")
+    ap.add_argument("--poll", type=float, default=1.0,
+                    help="Elastic worker/coordinator poll interval (seconds)")
     args = ap.parse_args(argv)
     if args.fuse_exp and args.impl != "pallas":
         ap.error("--fuse-exp requires --impl pallas")
+    if args.elastic:
+        if not args.elastic_store:
+            ap.error("--elastic requires --elastic-store (the shared "
+                     "lease/commit plane)")
+        if args.multihost:
+            ap.error("--elastic and --multihost are mutually exclusive "
+                     "(elastic workers are single-process; scale is the fleet)")
+        if args.out:
+            ap.error("--elastic results are committed to the store; "
+                     "--out is the static engine's resume dir")
+        if args.profile_dir:
+            ap.error("--profile-dir is not supported with --elastic")
+        if args.lz_profile:
+            ap.error("--lz-profile sweeps are not supported with --elastic "
+                     "(profiles are not shipped to workers); drop --elastic")
     from bdlz_tpu.lz.options import lz_flags_error
 
     _gerr = lz_flags_error(args, default_method="local")
@@ -182,11 +288,16 @@ def main(argv=None) -> None:
     if not axes:
         raise SystemExit("at least one --axis is required")
 
-    n_dev = len(jax.devices())
-    sp = max(1, args.mesh_sp)
-    if n_dev % sp:
-        raise SystemExit(f"--mesh-sp {sp} does not divide device count {n_dev}")
-    mesh = make_mesh(shape=(n_dev // sp, sp))
+    if args.elastic:
+        mesh = None  # elastic workers are single-process; scale is the fleet
+    else:
+        n_dev = len(jax.devices())
+        sp = max(1, args.mesh_sp)
+        if n_dev % sp:
+            raise SystemExit(
+                f"--mesh-sp {sp} does not divide device count {n_dev}"
+            )
+        mesh = make_mesh(shape=(n_dev // sp, sp))
 
     event_log = None
     if args.events:
@@ -199,14 +310,19 @@ def main(argv=None) -> None:
         static = static._replace(quad_panel_gl=args.quad == "on")
 
     interpret = args.impl == "pallas" and jax.devices()[0].platform == "cpu"
-    res = run_sweep(
-        cfg, axes, static,
-        mesh=mesh, chunk_size=args.chunk, n_y=args.n_y, out_dir=args.out,
-        event_log=event_log, trace_dir=args.profile_dir,
-        impl=args.impl, interpret=interpret, fuse_exp=args.fuse_exp,
-        lz_profile=args.lz_profile, lz_method=args.lz_method,
-        lz_gamma_phi=args.lz_gamma_phi,
-    )
+    if args.elastic:
+        res = _run_elastic(args, cfg, static, axes, event_log, interpret)
+        if res is None:
+            return  # worker role: its summary is already printed
+    else:
+        res = run_sweep(
+            cfg, axes, static,
+            mesh=mesh, chunk_size=args.chunk, n_y=args.n_y, out_dir=args.out,
+            event_log=event_log, trace_dir=args.profile_dir,
+            impl=args.impl, interpret=interpret, fuse_exp=args.fuse_exp,
+            lz_profile=args.lz_profile, lz_method=args.lz_method,
+            lz_gamma_phi=args.lz_gamma_phi,
+        )
 
     if args.sanitize:
         from bdlz_tpu import sanitize
@@ -239,6 +355,7 @@ def main(argv=None) -> None:
         # omit-at-default, like the identity rule: two-channel summaries
         # stay byte-identical to pre-scenario output
         **({"lz_mode": cfg.lz_mode} if cfg.lz_mode != "two_channel" else {}),
+        **({"elastic": args.elastic} if args.elastic else {}),
         "n_points": res.n_points,
         "n_failed": res.n_failed,
         "n_quarantined": res.n_quarantined,

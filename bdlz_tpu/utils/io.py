@@ -17,7 +17,29 @@ from bdlz_tpu.config import REFERENCE_KEYS, Config, default_config
 from bdlz_tpu.models.yields_pipeline import YieldsResult
 
 
-def atomic_write_json(path: str, payload: Any, **dump_kwargs: Any) -> None:
+def _fsync_dir(path: str) -> None:
+    """fsync a DIRECTORY so a rename into it survives host crash.
+
+    ``os.replace`` makes a write atomic against concurrent readers, but
+    the rename itself lives in the directory's metadata — until that is
+    flushed, a power loss can roll the entry back to the old (or no)
+    file even though the caller was told the commit happened.  Best
+    effort: platforms/filesystems that refuse ``open(O_RDONLY)`` on a
+    directory keep the old (atomic-but-not-durable) behavior.
+    """
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def atomic_write_json(
+    path: str, payload: Any, durable: bool = False, **dump_kwargs: Any
+) -> None:
     """Write ``payload`` as JSON to ``path`` atomically (mkstemp + replace).
 
     THE manifest-write primitive for every resumable artifact in the repo
@@ -29,13 +51,26 @@ def atomic_write_json(path: str, payload: Any, **dump_kwargs: Any) -> None:
     atomic rename (the pattern proven in ``validation.py``'s reference
     cache); concurrent readers see either the old complete file or the
     new complete file, never half a write.
+
+    ``durable`` additionally fsyncs the temp file before the rename and
+    the parent directory after it, so the committed entry survives host
+    crash/power loss — the provenance store passes it because the
+    elastic lease protocol treats a committed chunk as *done forever*
+    (a commit that evaporates would strand the sweep's merge).  Default
+    off: manifest/chunk-file writers re-validate on resume, so they pay
+    only atomicity.
     """
     d = os.path.dirname(os.path.abspath(path))
     fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
     try:
         with os.fdopen(fd, "w", encoding="utf-8") as f:
             json.dump(payload, f, **dump_kwargs)
+            if durable:
+                f.flush()
+                os.fsync(f.fileno())
         os.replace(tmp, path)
+        if durable:
+            _fsync_dir(d)
     except BaseException:
         # never leave the temp file behind on a failed dump/rename
         try:
@@ -45,7 +80,7 @@ def atomic_write_json(path: str, payload: Any, **dump_kwargs: Any) -> None:
         raise
 
 
-def atomic_savez(path: str, **arrays: Any) -> None:
+def atomic_savez(path: str, durable: bool = False, **arrays: Any) -> None:
     """``np.savez`` with the mkstemp + ``os.replace`` atomicity of
     :func:`atomic_write_json`.
 
@@ -56,7 +91,9 @@ def atomic_savez(path: str, **arrays: Any) -> None:
     either the old complete file or the new complete file, never half a
     write.  The temp name must end in ``.npz`` or ``np.savez`` APPENDS
     the suffix and the rename misses (the lesson already learned in
-    ``emulator/artifact.py``).
+    ``emulator/artifact.py``).  ``durable`` adds the fsync pair of
+    :func:`atomic_write_json` (file before the rename, directory after)
+    so the entry survives host crash — the store's commit guarantee.
     """
     import numpy as np  # host-side IO only (bdlz-lint R1 audit)
 
@@ -67,7 +104,12 @@ def atomic_savez(path: str, **arrays: Any) -> None:
     try:
         with os.fdopen(fd, "wb") as f:
             np.savez(f, **arrays)
+            if durable:
+                f.flush()
+                os.fsync(f.fileno())
         os.replace(tmp, path)
+        if durable:
+            _fsync_dir(d)
     except BaseException:
         try:
             os.remove(tmp)
@@ -76,12 +118,13 @@ def atomic_savez(path: str, **arrays: Any) -> None:
         raise
 
 
-def atomic_save_npy(path: str, arr: Any) -> None:
+def atomic_save_npy(path: str, arr: Any, durable: bool = False) -> None:
     """``np.save`` with the mkstemp + ``os.replace`` atomicity of its
     siblings above — the single-array primitive behind the provenance
     store and the accuracy-gate reference cache.  Writing through the
     open file descriptor sidesteps ``np.save``'s append-``.npy`` suffix
-    rule, so the rename target is exactly ``path``.
+    rule, so the rename target is exactly ``path``.  ``durable`` adds
+    the fsync pair (file before the rename, directory after).
     """
     import numpy as np  # host-side IO only (bdlz-lint R1 audit)
 
@@ -90,7 +133,12 @@ def atomic_save_npy(path: str, arr: Any) -> None:
     try:
         with os.fdopen(fd, "wb") as f:
             np.save(f, arr)
+            if durable:
+                f.flush()
+                os.fsync(f.fileno())
         os.replace(tmp, path)
+        if durable:
+            _fsync_dir(d)
     except BaseException:
         try:
             os.remove(tmp)

@@ -905,6 +905,118 @@ def main(argv=None) -> None:
     except Exception as exc:  # noqa: BLE001 — secondary metric is best-effort
         print(f"[bench] chaos metric unavailable: {exc}", file=sys.stderr)
 
+    # --- secondary metric: sweep_churn (elastic fleet under churn) ----
+    # The elastic work-stealing scheduler (parallel/scheduler.py) on the
+    # chaos grid, under OPERATIONAL churn — a worker crash mid-chunk, a
+    # flaky lease claim, a torn store read, plus a scripted kill/spawn —
+    # against a serial single-host baseline of the same grid.  The line
+    # records healed elastic throughput, the churn counters, and the
+    # contract the whole subsystem exists for: every output field comes
+    # back BITWISE-equal to the serial engine despite the unreliable
+    # fleet.
+    def sweep_churn_metric():
+        import dataclasses
+        import shutil
+        import tempfile
+
+        from bdlz_tpu.faults import FaultPlan
+        from bdlz_tpu.parallel.scheduler import run_sweep_elastic
+        from bdlz_tpu.parallel.sweep import run_sweep
+        from bdlz_tpu.utils.retry import RetryPolicy
+
+        n_churn = int(os.environ.get(
+            "BDLZ_BENCH_CHURN_POINTS",
+            os.environ.get("BDLZ_BENCH_CHAOS_POINTS", 64),
+        ))
+        side_e = max(2, int(round(n_churn ** 0.5)))
+        axes_e = {
+            "m_chi_GeV": np.geomspace(0.3, 3.0, side_e),
+            "T_p_GeV": np.geomspace(60.0, 200.0, side_e),
+        }
+        n_e = side_e * side_e
+        chunk_e = max(2, (side_e // 2) * 2)
+        churn = FaultPlan.from_obj({"faults": [
+            {"site": "worker_crash", "kind": "transient", "chunk": 1,
+             "times": 1},
+            {"site": "lease", "kind": "transient", "chunk": 0, "times": 1},
+            {"site": "store_read", "kind": "torn", "call": 0},
+        ]})
+        retry = RetryPolicy(max_attempts=2, backoff_s=0.0,
+                            sleep=lambda s: None)
+        static_e = static_for("tabulated")
+        # churn is operational-only: the result-identity fault plane is
+        # OFF on both legs, so serial and elastic share chunk identity
+        base_clean = dataclasses.replace(base, fault_injection=False)
+        t1 = time.time()
+        res_serial = run_sweep(
+            base_clean, axes_e, static_e, mesh=None, chunk_size=chunk_e,
+            n_y=n_y,
+        )
+        serial_seconds = time.time() - t1
+        root = tempfile.mkdtemp(prefix="bdlz_bench_sweep_churn_")
+        try:
+            t2 = time.time()
+            res_churn = run_sweep_elastic(
+                base_clean, axes_e, static_e, store=root,
+                chunk_size=chunk_e, n_y=n_y, retry=retry, n_workers=2,
+                lease_ttl_s=5.0, churn_plan=churn,
+                churn_schedule=[(1, "kill"), (2, "spawn")],
+            )
+            churn_seconds = time.time() - t2
+        finally:
+            shutil.rmtree(root, ignore_errors=True)
+        bitwise = bool(
+            all(
+                np.array_equal(res_churn.outputs[f], res_serial.outputs[f])
+                for f in res_serial.outputs
+            )
+            and np.array_equal(res_churn.failed_mask, res_serial.failed_mask)
+            and np.array_equal(
+                res_churn.quarantined_mask, res_serial.quarantined_mask
+            )
+        )
+        churn_pps = round(n_e / churn_seconds, 2)
+        serial_pps = round(n_e / serial_seconds, 2)
+        payload = {
+            "metric": "sweep_churn_points_per_sec",
+            "value": churn_pps,
+            "unit": "param-points/sec (run_sweep_elastic, 2-worker "
+                    "in-process fleet under churn: worker crash + flaky "
+                    "lease + torn store read + scripted kill/spawn)",
+            "n_points": n_e,
+            "n_chunks": res_churn.chunks,
+            "n_failed": int(res_churn.n_failed),
+            "n_quarantined": int(res_churn.n_quarantined),
+            "n_retries": int(res_churn.n_retries),
+            "cache_hits": res_churn.cache_hits,
+            "cache_misses": res_churn.cache_misses,
+            "serial_points_per_sec": serial_pps,
+            "vs_serial": round(churn_pps / max(serial_pps, 1e-9), 3),
+            "bitwise_equal": bitwise,
+            "churn_plan": churn.describe(),
+            "lease_ttl_s": 5.0,
+            "n_workers": 2,
+            "quad_impl": "panel_gl" if static_e.quad_panel_gl else "trap",
+            "n_quad_nodes": (
+                n_quad_gl if static_e.quad_panel_gl else max(n_y, 2000)
+            ),
+            "platform": jax.devices()[0].platform,
+            "tpu_unavailable": tpu_unavailable,
+        }
+        emit(payload)
+        return {
+            k: payload[k] for k in (
+                "value", "vs_serial", "n_failed", "n_quarantined",
+                "n_retries", "bitwise_equal",
+            )
+        }
+
+    sweep_churn_summary = None
+    try:
+        sweep_churn_summary = run_leg("sweep_churn", sweep_churn_metric)
+    except Exception as exc:  # noqa: BLE001 — secondary metric is best-effort
+        print(f"[bench] sweep_churn metric unavailable: {exc}", file=sys.stderr)
+
     # --- secondary metric: the provenance sweep-chunk cache ------------
     # Builds a small emulator box COLD into a fresh content-addressed
     # store, then rebuilds it WARM against the same store
@@ -2116,6 +2228,10 @@ def main(argv=None) -> None:
                 # the chaos (fault-injected self-healing sweep) summary
                 # (null = leg failed; its secondary line has the detail)
                 "chaos": chaos_summary,
+                # the elastic work-stealing fleet under churn (crash +
+                # lease + torn-read; bitwise pin vs the serial engine;
+                # null = leg failed — its secondary line has the detail)
+                "sweep_churn": sweep_churn_summary,
                 # the provenance chunk-cache A/B (warm-vs-cold emulator
                 # box rebuild: speedup, hit rate, bitwise check; null =
                 # leg failed — its secondary line has the detail)

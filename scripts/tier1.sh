@@ -3,7 +3,11 @@
 # in that order, exiting nonzero when EITHER fails.  Every PR runs this same
 # entry point so "tier-1 green" means the same thing on every machine; the
 # pytest invocation below is byte-for-byte the ROADMAP.md "Tier-1 verify"
-# command (update both together).
+# command (update both together).  The -m 'not slow' filter is what keeps
+# the real-subprocess suites (tests/test_multihost.py two-process fleets,
+# tests/test_elastic_mp.py elastic worker churn) out of the gate; their
+# fast single-process protocol coverage (lease expiry, commit verify,
+# in-process churn) runs here via tests/test_elastic.py.
 set -u
 cd "$(dirname "$0")/.."
 

@@ -1,0 +1,35 @@
+"""Worker for the cross-process backoff-determinism test.
+
+Launched (at least) twice by ``tests/test_elastic.py::
+test_backoff_schedule_identical_across_processes`` as
+``python _mp_backoff_worker.py``.  Prints the full ``backoff_delay``
+schedule for a fixed grid of ``(seed, label, attempt)`` triples, one
+``repr(float)`` per line.  The elastic scheduler's claim/steal fairness
+(and the event-log replayability of a healed sweep) rests on every
+process deriving the IDENTICAL schedule from the same policy inputs —
+the parent asserts the two processes' stdout is byte-identical.
+
+Deliberately jax-free: the schedule is pure host arithmetic
+(SHA256-jittered exponential backoff, utils/retry.py) and must not
+depend on any backend state.
+"""
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+
+def main() -> None:
+    from bdlz_tpu.utils.retry import RetryPolicy, backoff_delay
+
+    for seed in (0, 1, 12345):
+        for label in ("chunk0:0", "chunk3:96", "probe:7", "weird label:\t"):
+            policy = RetryPolicy(
+                max_attempts=5, backoff_s=0.05, max_backoff_s=2.0, seed=seed,
+            )
+            for attempt in range(5):
+                print(repr(backoff_delay(policy, label, attempt)))
+
+
+if __name__ == "__main__":
+    main()

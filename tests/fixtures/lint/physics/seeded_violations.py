@@ -1,15 +1,20 @@
-"""bdlz-lint test fixture: exactly one seeded violation per rule R1-R6.
+"""bdlz-lint test fixture: exactly one seeded violation per rule R1-R7.
 
 Lives under a ``physics/`` directory on purpose — that puts it in scope
 for the directory-scoped rules (R3 hot paths, R4 magic floats). Never
 imported; parsed by the analyzer only (tests/test_lint.py).
 """
+import time
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 # R5: global config write outside backend.py/conftest.py
 jax.config.update("jax_enable_x64", True)
+
+# R7: bare time.sleep call outside utils/retry.py
+time.sleep(0.0)
 
 
 def hot_kernel(x, n_y):

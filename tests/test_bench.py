@@ -245,6 +245,7 @@ def test_bench_cpu_smoke(jax_compile_cache):
             "emulator_query_points_per_sec",
             "quad_gl_sweep_points_per_sec_per_chip",
             "chaos_sweep_points_per_sec_per_chip",
+            "sweep_churn_points_per_sec",
             "sweep_cache_warm_vs_cold",
             "seam_split_fallback_ratio",
             "serve_bench_queries_per_sec_per_chip",
@@ -299,6 +300,29 @@ def test_bench_cpu_smoke(jax_compile_cache):
         "n_quarantined": chaos["n_quarantined"],
         "n_retries": chaos["n_retries"],
         "bitwise_equal_unaffected": chaos["bitwise_equal_unaffected"],
+    }
+    # the sweep_churn line: the elastic work-stealing fleet under churn
+    # (worker crash + flaky lease + torn store read + scripted
+    # kill/spawn) heals everything — nothing failed, nothing
+    # quarantined — and the folded result is BITWISE-equal to the
+    # serial single-host engine, the contract the scheduler exists for
+    churn = next(s for s in secondary
+                 if s["metric"] == "sweep_churn_points_per_sec")
+    assert churn["value"] > 0
+    assert churn["bitwise_equal"] is True
+    assert churn["n_failed"] == 0
+    assert churn["n_quarantined"] == 0
+    assert churn["serial_points_per_sec"] > 0
+    assert churn["vs_serial"] > 0
+    assert churn["n_workers"] == 2
+    assert {"site", "kind"} <= set(churn["churn_plan"][0])
+    assert d["sweep_churn"] == {
+        "value": churn["value"],
+        "vs_serial": churn["vs_serial"],
+        "n_failed": churn["n_failed"],
+        "n_quarantined": churn["n_quarantined"],
+        "n_retries": churn["n_retries"],
+        "bitwise_equal": churn["bitwise_equal"],
     }
     # the sweep_cache line (docs/provenance.md): a warm rebuild of the
     # same emulator box through the content-addressed chunk cache must

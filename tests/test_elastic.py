@@ -1,0 +1,631 @@
+"""Elastic work-stealing sweep scheduler (parallel/scheduler.py + worker.py).
+
+The contract under test: an unreliable fleet — worker crashes, expired
+leases, torn store reads — produces output fields BITWISE equal to
+single-host ``run_sweep(mesh=None)``.  Protocol units (lease plane,
+publish-then-commit, coordinator election, cross-process backoff
+determinism) run without touching the engine; the engine-driving tests
+share one small grid and module-scoped results so tier-1 pays a handful
+of jit compiles, not one per assertion.
+
+Real-subprocess churn tests (external ``sweep_cli --elastic worker``
+fleets) live in ``tests/test_elastic_mp.py`` under ``@pytest.mark.slow``
+and are excluded from tier-1; the fast lease-expiry and single-process
+churn coverage here is the tier-1 face of the same protocol.
+"""
+import pathlib
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from bdlz_tpu.config import config_from_dict, static_choices_from_config
+from bdlz_tpu.faults import FaultPlan
+from bdlz_tpu.parallel.scheduler import (
+    CommitMismatchError,
+    ElasticError,
+    LeasePlane,
+    ManualClock,
+    WallClock,
+    ensure_job_record,
+    plan_elastic_sweep,
+    publish_chunk,
+    run_sweep_elastic,
+)
+from bdlz_tpu.parallel.sweep import run_sweep
+from bdlz_tpu.parallel.worker import run_worker_loop
+from bdlz_tpu.provenance import Store, lease_entry_name, read_lease
+from bdlz_tpu.utils.retry import RetryPolicy
+
+AXES = {"m_chi_GeV": [0.5, 1.0, 2.0], "T_p_GeV": [80.0, 150.0]}
+CHUNK = 2
+N_Y = 200
+
+
+def _retry():
+    return RetryPolicy(max_attempts=2, backoff_s=0.0, sleep=lambda s: None)
+
+
+@pytest.fixture(scope="module")
+def base_cfg():
+    return config_from_dict({
+        "regime": "nonthermal",
+        "P_chi_to_B": 0.14925839040304145,
+        "source_shape_sigma_y": 9.0,
+        "incident_flux_scale": 1.07e-9,
+        "Y_chi_init": 4.90e-10,
+    })
+
+
+@pytest.fixture(scope="module")
+def static(base_cfg):
+    return static_choices_from_config(base_cfg)
+
+
+@pytest.fixture(scope="module")
+def plan(base_cfg, static):
+    return plan_elastic_sweep(
+        base_cfg, AXES, static, chunk_size=CHUNK, n_y=N_Y, retry=_retry(),
+    )
+
+
+@pytest.fixture(scope="module")
+def serial(base_cfg, static):
+    """Single-host baseline every elastic run must match bitwise."""
+    return run_sweep(
+        base_cfg, AXES, static, mesh=None, chunk_size=CHUNK, n_y=N_Y,
+        retry=_retry(),
+    )
+
+
+@pytest.fixture(scope="module")
+def elastic_clean(base_cfg, static, tmp_path_factory):
+    """One clean elastic run, shared: (result, on_chunk events, store)."""
+    store = Store(str(tmp_path_factory.mktemp("elastic_clean")))
+    events = []
+    res = run_sweep_elastic(
+        base_cfg, AXES, static, store=store, chunk_size=CHUNK, n_y=N_Y,
+        retry=_retry(), n_workers=2,
+        on_chunk=lambda ci, lo, hi, ent: events.append(
+            (ci, lo, hi, {k: np.array(v) for k, v in ent.items()})
+        ),
+    )
+    return res, events, store
+
+
+def assert_bitwise(a, b, label):
+    a, b = np.asarray(a), np.asarray(b)
+    assert a.dtype == b.dtype and a.shape == b.shape, label
+    assert a.tobytes() == b.tobytes(), (
+        f"{label}: elastic result drifted from the serial engine "
+        f"(max abs diff {np.nanmax(np.abs(a - b))!r})"
+    )
+
+
+# ---- plan / job record --------------------------------------------------
+
+
+class TestPlan:
+    def test_plan_is_deterministic(self, base_cfg, static, plan):
+        again = plan_elastic_sweep(
+            base_cfg, AXES, static, chunk_size=CHUNK, n_y=N_Y,
+            retry=_retry(),
+        )
+        assert again.job == plan.job
+        assert again.n_total == plan.n_total == 6
+        assert again.n_chunks == plan.n_chunks == 3
+        assert [again.chunk_bounds(i) for i in range(3)] == [
+            plan.chunk_bounds(i) for i in range(3)
+        ] == [(0, 2), (2, 4), (4, 6)]
+        assert [again.entry_name(i) for i in range(3)] == [
+            plan.entry_name(i) for i in range(3)
+        ]
+
+    def test_identity_knobs_join_the_job(self, base_cfg, static, plan):
+        other = plan_elastic_sweep(
+            base_cfg, AXES, static, chunk_size=CHUNK, n_y=N_Y + 40,
+            retry=_retry(),
+        )
+        assert other.job != plan.job
+
+    def test_chunk_size_drift_is_caught_by_the_record(
+        self, base_cfg, static, plan, tmp_path
+    ):
+        # chunking is OPERATIONAL, not result identity: it shares the
+        # job hash — so the record, not the namespace, must catch it
+        store = Store(str(tmp_path / "store"))
+        ensure_job_record(store, plan)
+        other = plan_elastic_sweep(
+            base_cfg, AXES, static, chunk_size=3, n_y=N_Y, retry=_retry(),
+        )
+        assert other.job == plan.job
+        with pytest.raises(ElasticError, match="does not match"):
+            ensure_job_record(store, other)
+
+    def test_job_record_round_trip_and_drift(self, plan, tmp_path):
+        store = Store(str(tmp_path / "store"))
+        first = ensure_job_record(store, plan)
+        assert first == plan.job_record()
+        # identical re-derivation cross-validates cleanly
+        assert ensure_job_record(store, plan) == first
+        # a drifted record (a role launched with different inputs) is
+        # a LOUD error, never a silent mixed-spec fold
+        bad = dict(plan.job_record())
+        bad["chunk_size"] = int(bad.get("chunk_size", 0)) + 1
+        store.put_json(f"elastic/{plan.job}.json", bad)
+        with pytest.raises(ElasticError, match="does not match"):
+            ensure_job_record(store, plan)
+
+    def test_torn_job_record_is_rewritten(self, plan, tmp_path):
+        store = Store(str(tmp_path / "store"))
+        ensure_job_record(store, plan)
+        path = store.path_for(f"elastic/{plan.job}.json")
+        with open(path, "w", encoding="utf-8") as f:
+            f.write('{"schema": 1, "job":')  # torn mid-write
+        assert ensure_job_record(store, plan) == plan.job_record()
+
+
+# ---- lease plane (ManualClock, no engine) -------------------------------
+
+
+class TestLeasePlane:
+    def _plane(self, tmp_path, **kw):
+        clock = ManualClock()
+        store = Store(str(tmp_path / "leases"))
+        kw.setdefault("ttl_s", 10.0)
+        kw.setdefault("quarantine_after", 2)
+        plane = LeasePlane(store, "job0", 3, clock=clock, **kw)
+        return plane, clock, store
+
+    def test_claim_is_exclusive_while_live(self, tmp_path):
+        plane, clock, _ = self._plane(tmp_path)
+        assert plane.claim(0, "w0")
+        assert not plane.claim(0, "w1")
+        assert plane.state(0) == "leased"
+        assert plane.state(1) == "queued"  # untouched chunks are free
+
+    def test_heartbeat_extends_the_lease(self, tmp_path):
+        plane, clock, _ = self._plane(tmp_path)
+        assert plane.claim(0, "w0")
+        clock.advance(8.0)
+        assert plane.heartbeat(0, "w0")
+        clock.advance(8.0)  # 16s since claim, 8s since heartbeat
+        assert not plane.claim(0, "w1")  # still live
+        assert not plane.heartbeat(0, "w1")  # non-holders cannot extend
+
+    def test_expired_lease_is_stolen_with_failure_credit(self, tmp_path):
+        plane, clock, _ = self._plane(tmp_path)
+        assert plane.claim(0, "w0")
+        clock.advance(11.0)
+        assert plane.claim(0, "w1")  # steal
+        rec = plane.read(0)
+        assert rec["worker"] == "w1"
+        assert rec["failures"] == ["w0"]
+        assert rec["generation"] == 1
+        # the stale holder's heartbeat finds its lease gone
+        assert not plane.heartbeat(0, "w0")
+
+    def test_done_and_quarantined_are_terminal(self, tmp_path):
+        plane, clock, _ = self._plane(tmp_path)
+        assert plane.claim(0, "w0")
+        plane.complete(0, "w0")
+        assert plane.state(0) == "done"
+        assert not plane.claim(0, "w1")
+        clock.advance(100.0)
+        assert not plane.claim(0, "w1")  # done never expires
+
+    def test_distinct_failures_quarantine_fleet_wide(self, tmp_path):
+        plane, clock, _ = self._plane(tmp_path)  # quarantine_after=2
+        plane.fail(0, "w0", err=RuntimeError("boom"))
+        assert plane.state(0) == "queued"  # one strike: requeued
+        assert plane.claim(0, "w1")
+        plane.fail(0, "w1", err=RuntimeError("boom"))
+        assert plane.state(0) == "quarantined"
+        assert not plane.claim(0, "w2")
+        assert sorted(plane.read(0)["failures"]) == ["w0", "w1"]
+
+    def test_repeat_failure_by_same_worker_counts_once(self, tmp_path):
+        plane, clock, _ = self._plane(tmp_path)
+        plane.fail(0, "w0")
+        plane.fail(0, "w0")
+        assert plane.state(0) == "queued"  # still one DISTINCT worker
+        assert plane.read(0)["failures"] == ["w0"]
+
+    def test_requeue_expired_sweeps_the_whole_plane(self, tmp_path):
+        plane, clock, _ = self._plane(tmp_path)
+        assert plane.claim(0, "w0")
+        assert plane.claim(1, "w1")
+        plane.complete(1, "w1")
+        clock.advance(11.0)
+        assert plane.requeue_expired() == [0]  # done chunk untouched
+        assert plane.state(0) == "queued"
+        assert plane.read(0)["failures"] == ["w0"]
+        assert plane.requeue_expired() == []  # idempotent
+
+    def test_torn_lease_record_frees_the_chunk(self, tmp_path):
+        plane, clock, store = self._plane(tmp_path)
+        assert plane.claim(0, "w0")
+        path = store.path_for(lease_entry_name("job0", 0))
+        with open(path, "w", encoding="utf-8") as f:
+            f.write('{"state": "le')  # torn mid-write
+        # the corrupt record reads as a miss AND is evicted, so the
+        # exclusive create can win again — no permanently wedged chunk
+        assert plane.read(0) is None
+        assert plane.claim(0, "w1")
+        assert plane.read(0)["worker"] == "w1"
+
+    def test_lease_fault_fails_the_claim_only(self, tmp_path):
+        churn = FaultPlan.from_obj(
+            [{"site": "lease", "kind": "transient", "chunk": 0, "times": 1}]
+        )
+        clock = ManualClock()
+        store = Store(str(tmp_path / "leases"))
+        plane = LeasePlane(
+            store, "job0", 3, ttl_s=10.0, quarantine_after=2,
+            clock=clock, faults=churn,
+        )
+        assert not plane.claim(0, "w0")  # flaky claim RPC
+        assert plane.state(0) == "queued"  # chunk stays claimable
+        assert plane.claim(0, "w0")  # budget spent: recovered
+
+
+class TestClocks:
+    def test_manual_clock_advances_deterministically(self):
+        clock = ManualClock()
+        t0 = clock()
+        assert clock() == t0  # reading does not advance
+        t1 = clock.advance(2.5)
+        assert t1 == clock() == t0 + 2.5
+
+    def test_wall_clock_sleeps_through_the_seam(self):
+        t = [100.0]
+        slept = []
+
+        def fake_sleep(s):
+            slept.append(s)
+            t[0] += s
+
+        clock = WallClock(time_fn=lambda: t[0], sleep=fake_sleep)
+        assert clock() == 100.0
+        assert clock.advance(3.0) == 103.0
+        assert slept == [3.0]
+
+
+# ---- publish-then-commit ------------------------------------------------
+
+
+class TestPublishCommit:
+    def _host(self, plan, ci, bump=0.0):
+        lo, hi = plan.chunk_bounds(ci)
+        n = hi - lo
+        return {
+            f: np.linspace(1.0, 2.0, n) + i + bump
+            for i, f in enumerate(plan.fields)
+        }
+
+    def test_first_commit_wins_second_verifies(self, plan, tmp_path):
+        store = Store(str(tmp_path / "store"))
+        host = self._host(plan, 0)
+        assert publish_chunk(store, plan, 0, host) is True
+        # an honest double-compute (stolen lease) verifies and defers
+        assert publish_chunk(store, plan, 0, host) is False
+        # retry count is operational history, not result identity
+        assert publish_chunk(store, plan, 0, host, n_retries=7) is False
+
+    def test_commit_mismatch_raises_loudly(self, plan, tmp_path):
+        store = Store(str(tmp_path / "store"))
+        assert publish_chunk(store, plan, 0, self._host(plan, 0))
+        drifted = self._host(plan, 0)
+        drifted[plan.fields[0]] = drifted[plan.fields[0]] + 1e-9
+        with pytest.raises(CommitMismatchError, match="re-commit disagrees"):
+            publish_chunk(store, plan, 0, drifted)
+
+    def test_quarantine_mask_joins_the_verification(self, plan, tmp_path):
+        store = Store(str(tmp_path / "store"))
+        assert publish_chunk(store, plan, 0, self._host(plan, 0))
+        lo, hi = plan.chunk_bounds(0)
+        qmask = np.ones(hi - lo, dtype=bool)
+        with pytest.raises(CommitMismatchError, match="quarantine mask"):
+            publish_chunk(store, plan, 0, self._host(plan, 0), qmask=qmask)
+
+    def test_torn_entry_recommits(self, plan, tmp_path):
+        store = Store(str(tmp_path / "store"))
+        host = self._host(plan, 0)
+        assert publish_chunk(store, plan, 0, host)
+        path = store.path_for(plan.entry_name(0))
+        with open(path, "rb") as f:
+            data = f.read()
+        with open(path, "wb") as f:
+            f.write(data[: len(data) // 2])  # torn write
+        # the torn entry reads as a miss, so this commit wins again
+        assert publish_chunk(store, plan, 0, host) is True
+        assert store.get_npz(plan.entry_name(0)) is not None
+
+
+# ---- store satellites ---------------------------------------------------
+
+
+class TestStoreRobustness:
+    def test_torn_store_read_detects_and_recomputes(self, tmp_path):
+        store = Store(str(tmp_path / "store"))
+        store.put_npz("sweep_chunk/torn-probe.npz", {"a": np.arange(8.0)})
+        store.arm_faults(FaultPlan.from_obj(
+            [{"site": "store_read", "kind": "torn", "call": 0}]
+        ))
+        # read 0 is torn mid-flight: detected, evicted, reported a miss
+        assert store.get_npz("sweep_chunk/torn-probe.npz") is None
+        assert store.stats.dropped_corrupt == 1
+        assert not store.has("sweep_chunk/torn-probe.npz")
+        # recompute-and-rewrite heals it (the fault fires once)
+        store.put_npz("sweep_chunk/torn-probe.npz", {"a": np.arange(8.0)})
+        out = store.get_npz("sweep_chunk/torn-probe.npz")
+        np.testing.assert_array_equal(out["a"], np.arange(8.0))
+
+    def test_durable_puts_fsync_file_and_directory(self, tmp_path, monkeypatch):
+        import os as _os
+
+        synced = []
+        real_fsync = _os.fsync
+
+        def counting_fsync(fd):
+            synced.append(fd)
+            return real_fsync(fd)
+
+        monkeypatch.setattr(_os, "fsync", counting_fsync)
+        store = Store(str(tmp_path / "store"))
+        synced.clear()
+        store.put_npz("sweep_chunk/durability-probe.npz", {"a": np.arange(4.0)})
+        # commit durability: the temp file AND its directory entry must
+        # both hit disk before the rename publishes the chunk
+        assert len(synced) >= 2
+        synced.clear()
+        store.put_json("elastic/durability-probe.json", {"ok": True})
+        assert len(synced) >= 2
+
+
+# ---- coordinator election ----------------------------------------------
+
+
+class TestElection:
+    def test_first_create_wins_then_ttl_steal(self, tmp_path):
+        from bdlz_tpu.parallel.multihost import elect_coordinator
+
+        store = Store(str(tmp_path / "store"))
+        t = [0.0]
+        clock = lambda: t[0]  # noqa: E731
+        assert elect_coordinator(store, "jobX", "a", ttl_s=30.0, clock=clock)
+        assert not elect_coordinator(store, "jobX", "b", ttl_s=30.0, clock=clock)
+        # re-election by the holder extends the seat
+        assert elect_coordinator(store, "jobX", "a", ttl_s=30.0, clock=clock)
+        t[0] = 31.0
+        # holder extended at t=0, so its lease runs to t=30: expired now
+        assert elect_coordinator(store, "jobX", "b", ttl_s=30.0, clock=clock)
+        assert not elect_coordinator(store, "jobX", "a", ttl_s=30.0, clock=clock)
+
+
+# ---- cross-process backoff determinism (satellite) ----------------------
+
+
+def test_backoff_schedule_identical_across_processes():
+    """Two unrelated processes must derive byte-identical backoff
+    schedules from the same policy inputs — claim/steal fairness and
+    event-log replayability rest on it."""
+    worker = pathlib.Path(__file__).parent / "_mp_backoff_worker.py"
+    runs = [
+        subprocess.run(
+            [sys.executable, str(worker)],
+            capture_output=True, text=True, timeout=60,
+        )
+        for _ in range(2)
+    ]
+    for r in runs:
+        assert r.returncode == 0, r.stderr
+    lines = runs[0].stdout.splitlines()
+    assert len(lines) == 3 * 4 * 5  # seeds x labels x attempts
+    assert all(float(ln) >= 0.0 for ln in lines)
+    assert runs[0].stdout == runs[1].stdout
+
+
+# ---- the elastic engine (shared compiles) -------------------------------
+
+
+class TestElasticEngine:
+    def test_needs_a_store(self, base_cfg, static):
+        with pytest.raises(ElasticError, match="store"):
+            run_sweep_elastic(
+                base_cfg, AXES, static, store=None, chunk_size=CHUNK,
+                n_y=N_Y,
+            )
+
+    def test_clean_run_bitwise_equals_serial(self, serial, elastic_clean):
+        res, _, _ = elastic_clean
+        assert res.n_points == serial.n_points
+        assert res.chunks == serial.chunks
+        assert (res.quad_impl, res.n_quad_nodes) == (
+            serial.quad_impl, serial.n_quad_nodes,
+        )
+        for f in serial.outputs:
+            assert_bitwise(res.outputs[f], serial.outputs[f], f)
+        np.testing.assert_array_equal(res.failed_mask, serial.failed_mask)
+        np.testing.assert_array_equal(
+            res.quarantined_mask, serial.quarantined_mask
+        )
+        assert res.n_quarantined == 0
+
+    def test_streaming_consumer_sees_every_fold(self, serial, elastic_clean):
+        res, events, _ = elastic_clean
+        assert sorted(ci for ci, _, _, _ in events) == [0, 1, 2]
+        covered = np.zeros(res.n_points, dtype=bool)
+        for ci, lo, hi, ent in events:
+            assert (lo, hi) == (2 * ci, 2 * ci + 2)
+            covered[lo:hi] = True
+            # the streamed entry IS the committed result, not a preview
+            for f in serial.outputs:
+                assert_bitwise(ent[f], serial.outputs[f][lo:hi], f)
+            assert not np.asarray(ent["failed"]).any()
+        assert covered.all()
+
+    def test_elastic_store_warms_run_sweep_cache(
+        self, base_cfg, static, serial, elastic_clean
+    ):
+        """Key-drift pin: elastic commits land under the SAME
+        content-addressed names run_sweep's cache uses, so a later
+        serial run folds entirely warm."""
+        _, _, store = elastic_clean
+        res = run_sweep(
+            base_cfg, AXES, static, mesh=None, chunk_size=CHUNK, n_y=N_Y,
+            retry=_retry(), cache=store,
+        )
+        assert res.cache_hits == res.chunks == 3
+        assert res.cache_misses == 0
+        for f in serial.outputs:
+            assert_bitwise(res.outputs[f], serial.outputs[f], f)
+
+    def test_second_elastic_run_folds_from_prescan(
+        self, base_cfg, static, serial, elastic_clean
+    ):
+        _, _, store = elastic_clean
+        res = run_sweep_elastic(
+            base_cfg, AXES, static, store=store, chunk_size=CHUNK, n_y=N_Y,
+            retry=_retry(),
+        )
+        assert res.cache_hits == 3 and res.cache_misses == 0
+        for f in serial.outputs:
+            assert_bitwise(res.outputs[f], serial.outputs[f], f)
+
+    def test_churn_run_bitwise_equals_serial(
+        self, base_cfg, static, serial, tmp_path
+    ):
+        """THE acceptance pin: a worker crash, an expiring lease, a torn
+        store read, and scripted fleet churn — and the folded result is
+        still bitwise-identical to the single-host engine."""
+        store = Store(str(tmp_path / "churn"))
+        churn = FaultPlan.from_obj([
+            {"site": "worker_crash", "kind": "transient", "chunk": 1,
+             "times": 1},
+            {"site": "lease", "kind": "transient", "chunk": 2, "times": 1},
+            {"site": "store_read", "kind": "torn", "call": 0},
+        ])
+        res = run_sweep_elastic(
+            base_cfg, AXES, static, store=store, chunk_size=CHUNK, n_y=N_Y,
+            retry=_retry(), n_workers=2, lease_ttl_s=5.0,
+            churn_plan=churn,
+            churn_schedule=[(1, "kill"), (2, "spawn")],
+        )
+        for f in serial.outputs:
+            assert_bitwise(res.outputs[f], serial.outputs[f], f)
+        np.testing.assert_array_equal(res.failed_mask, serial.failed_mask)
+        assert res.n_quarantined == 0
+        assert not res.quarantined_mask.any()
+        # the churn genuinely happened: the torn read was detected and
+        # evicted, and the crashed worker's lease expired onto the
+        # failure list before the chunk was re-run elsewhere
+        assert store.stats.dropped_corrupt >= 1
+        plan = plan_elastic_sweep(
+            base_cfg, AXES, static, chunk_size=CHUNK, n_y=N_Y,
+            retry=_retry(),
+        )
+        rec = read_lease(store, plan.job, 1)
+        assert rec["state"] == "done"
+        assert len(rec["failures"]) >= 1
+
+    def test_fleet_quarantine_isolates_the_chunk(
+        self, base_cfg, static, serial, tmp_path
+    ):
+        """A chunk that kills quarantine_after DISTINCT workers is
+        quarantined fleet-wide: NaN + mask for its points, every other
+        point still bitwise-equal to serial."""
+        store = Store(str(tmp_path / "quar"))
+        churn = FaultPlan.from_obj([
+            {"site": "worker_crash", "kind": "transient", "chunk": 1,
+             "times": 2},
+        ])
+        res = run_sweep_elastic(
+            base_cfg, AXES, static, store=store, chunk_size=CHUNK, n_y=N_Y,
+            retry=_retry(), n_workers=2, lease_ttl_s=2.0,
+            quarantine_after=2, churn_plan=churn,
+        )
+        lo, hi = 2, 4  # chunk 1's points
+        assert res.n_quarantined == 2
+        assert res.quarantined_mask[lo:hi].all()
+        assert not res.quarantined_mask[:lo].any()
+        assert not res.quarantined_mask[hi:].any()
+        assert res.failed_mask[lo:hi].all()
+        for f in serial.outputs:
+            assert np.isnan(res.outputs[f][lo:hi]).all(), f
+            assert_bitwise(res.outputs[f][:lo], serial.outputs[f][:lo], f)
+            assert_bitwise(res.outputs[f][hi:], serial.outputs[f][hi:], f)
+
+    def test_external_worker_drains_then_coordinator_folds(
+        self, base_cfg, static, serial, tmp_path
+    ):
+        """The sweep_cli worker-role protocol, in-process: an external
+        worker (own clock, own sleep seam) drains the job, then a
+        coordinator folds the committed chunks without recomputing."""
+        store = Store(str(tmp_path / "roles"))
+        t = [0.0]
+        summary = run_worker_loop(
+            base_cfg, AXES, static, store=store, worker_id="wext",
+            chunk_size=CHUNK, n_y=N_Y, retry=_retry(),
+            lease_ttl_s=30.0, poll_s=0.5,
+            clock=lambda: t[0],
+            sleep=lambda s: t.__setitem__(0, t[0] + s),
+        )
+        assert summary["alive"] and summary["chunks_done"] == 3
+        res = run_sweep_elastic(
+            base_cfg, AXES, static, store=store, chunk_size=CHUNK, n_y=N_Y,
+            retry=_retry(),
+        )
+        assert res.cache_hits == 3  # pure fold, no recompute
+        for f in serial.outputs:
+            assert_bitwise(res.outputs[f], serial.outputs[f], f)
+
+    def test_stuck_protocol_raises_not_hangs(self, base_cfg, static, tmp_path):
+        # every claim on every chunk fails forever: no engine build,
+        # no progress — the driver must detect the deadlock loudly
+        churn = FaultPlan.from_obj([
+            {"site": "lease", "kind": "transient", "chunk": ci,
+             "times": 10**6}
+            for ci in range(3)
+        ])
+        with pytest.raises(ElasticError, match="no full progress"):
+            run_sweep_elastic(
+                base_cfg, AXES, static,
+                store=str(tmp_path / "stuck"), chunk_size=CHUNK, n_y=N_Y,
+                retry=_retry(), churn_plan=churn, max_rounds=4,
+            )
+
+
+# ---- emulator streaming consumer ----------------------------------------
+
+
+class TestEmulatorElastic:
+    def test_exact_fields_elastic_parity(
+        self, base_cfg, static, serial, elastic_clean
+    ):
+        """The emulator's streaming elastic build fills the same surface
+        as the serial engine (folded warm here — the commit names are
+        content-addressed, so the clean run's store already holds every
+        chunk of this spec)."""
+        from bdlz_tpu.emulator.build import _exact_fields
+
+        _, _, store = elastic_clean
+        flat, n_pts = _exact_fields(
+            base_cfg, AXES, static, product=True, mesh=None,
+            chunk_size=CHUNK, n_y=N_Y, retry=_retry(), impl="tabulated",
+            cache=store, elastic={"n_workers": 1},
+        )
+        assert n_pts == 6
+        for f in flat:
+            assert_bitwise(flat[f], serial.outputs[f], f)
+
+    def test_elastic_build_requires_a_store(self, base_cfg, static):
+        from bdlz_tpu.emulator.build import EmulatorBuildError, _exact_fields
+
+        with pytest.raises(EmulatorBuildError, match="shared store"):
+            _exact_fields(
+                base_cfg, AXES, static, product=True, mesh=None,
+                chunk_size=CHUNK, n_y=N_Y, impl="tabulated",
+                cache=None, elastic=2,
+            )

@@ -1,0 +1,155 @@
+"""Real-subprocess elastic fleet: ``sweep_cli --elastic`` across
+processes, with churn, against the single-host bitwise baseline.
+
+The in-process protocol coverage lives in ``tests/test_elastic.py``
+(tier-1); these tests pay real process spawns, real wall-clock lease
+expiry, and per-process jit compiles, so they are ``@pytest.mark.slow``
+(tier-1's ``-m 'not slow'`` excludes them — see ``scripts/tier1.sh``).
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from bdlz_tpu.config import config_from_dict, static_choices_from_config
+from bdlz_tpu.parallel.scheduler import run_sweep_elastic
+from bdlz_tpu.parallel.sweep import run_sweep
+from bdlz_tpu.provenance import Store
+from bdlz_tpu.utils.retry import RetryPolicy
+
+CFG = {
+    "regime": "nonthermal",
+    "P_chi_to_B": 0.14925839040304145,
+    "source_shape_sigma_y": 9.0,
+    "incident_flux_scale": 1.07e-9,
+    "Y_chi_init": 4.90e-10,
+}
+AXIS_FLAGS = ["--axis", "m_chi_GeV=0.5,1.0,2.0", "--axis", "T_p_GeV=80.0,150.0"]
+AXES = {"m_chi_GeV": [0.5, 1.0, 2.0], "T_p_GeV": [80.0, 150.0]}
+
+
+def _child_env():
+    env = dict(os.environ)
+    # children must not inherit the axon TPU plugin (a dead relay would
+    # hang their first backend touch) — pin host CPU explicitly
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    return env
+
+
+def _worker_cmd(cfg_path, store, worker_id, *extra):
+    return [
+        sys.executable, "-m", "bdlz_tpu.sweep_cli",
+        "--config", str(cfg_path), *AXIS_FLAGS,
+        "--chunk", "2", "--n-y", "200",
+        "--elastic-store", str(store),
+        "--lease-ttl", "5", "--poll", "0.2",
+        "--worker-id", worker_id, *extra,
+    ]
+
+
+@pytest.fixture(scope="module")
+def serial():
+    base = config_from_dict(dict(CFG))
+    static = static_choices_from_config(base)
+    return run_sweep(
+        base, AXES, static, mesh=None, chunk_size=2, n_y=200,
+        retry=RetryPolicy(max_attempts=2, backoff_s=0.0, sleep=lambda s: None),
+    )
+
+
+def _run_fleet(cmds, timeout=420):
+    procs = [
+        subprocess.Popen(
+            cmd, env=_child_env(),
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
+        for cmd in cmds
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=timeout)
+            outs.append((p.returncode, out, err))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for rc, out, err in outs:
+        assert rc == 0, f"elastic CLI process failed (rc={rc}):\n{out}\n{err}"
+    return outs
+
+
+def _fold_and_compare(store_root, serial):
+    """Fold the committed chunks in this process (pure prescan — no
+    recompute) and pin them bitwise against the serial baseline."""
+    base = config_from_dict(dict(CFG))
+    static = static_choices_from_config(base)
+    store = Store(str(store_root))
+    res = run_sweep_elastic(
+        base, AXES, static, store=store, chunk_size=2, n_y=200,
+        retry=RetryPolicy(max_attempts=2, backoff_s=0.0, sleep=lambda s: None),
+    )
+    assert res.cache_hits == 3 and res.cache_misses == 0
+    for f in serial.outputs:
+        a, b = res.outputs[f], serial.outputs[f]
+        assert a.dtype == b.dtype and a.tobytes() == b.tobytes(), (
+            f"subprocess fleet drifted from serial on {f}"
+        )
+    assert not res.failed_mask.any() and not res.quarantined_mask.any()
+    return res
+
+
+@pytest.mark.slow
+def test_subprocess_worker_fleet_with_crash_is_bitwise(tmp_path, serial):
+    """Two real worker processes, one of which CRASHES on its first
+    attempt at chunk 1; the survivor steals the expired lease and the
+    folded result is bitwise-identical to the single-host engine."""
+    cfg_path = tmp_path / "cfg.json"
+    cfg_path.write_text(json.dumps(CFG))
+    store = tmp_path / "store"
+    crash = json.dumps([
+        {"site": "worker_crash", "kind": "transient", "chunk": 1, "times": 1}
+    ])
+    outs = _run_fleet([
+        _worker_cmd(cfg_path, store, "wA", "--elastic", "worker"),
+        _worker_cmd(cfg_path, store, "wB", "--elastic", "worker",
+                    "--churn-plan", crash),
+    ])
+    summaries = [json.loads(out.strip().splitlines()[-1]) for _, out, _ in outs]
+    assert all(s["elastic"] == "worker" for s in summaries)
+    assert {s["worker"] for s in summaries} == {"wA", "wB"}
+    assert len({s["job"] for s in summaries}) == 1  # same derived plan
+    # every chunk was completed by SOMEONE (a steal double-complete can
+    # push the sum past n_chunks; it can never fall short)
+    assert sum(s["chunks_done"] for s in summaries) >= 3
+    _fold_and_compare(store, serial)
+
+
+@pytest.mark.slow
+def test_subprocess_auto_election_drains_the_job(tmp_path, serial):
+    """Two ``--elastic auto`` processes: exactly one wins the
+    coordinator seat (store-lease election) and prints the fold-side
+    summary; the other drains chunks as a worker.  No spec-level state
+    crosses processes — both re-derive the plan from the same flags."""
+    cfg_path = tmp_path / "cfg.json"
+    cfg_path.write_text(json.dumps(CFG))
+    store = tmp_path / "store"
+    outs = _run_fleet([
+        _worker_cmd(cfg_path, store, "nodeA", "--elastic", "auto",
+                    "--elastic-workers", "1"),
+        _worker_cmd(cfg_path, store, "nodeB", "--elastic", "auto",
+                    "--elastic-workers", "1"),
+    ])
+    summaries = [json.loads(out.strip().splitlines()[-1]) for _, out, _ in outs]
+    coords = [s for s in summaries if "n_points" in s]
+    workers = [s for s in summaries if s.get("elastic") == "worker"]
+    assert len(coords) == 1 and len(workers) == 1
+    assert coords[0]["n_points"] == 6
+    assert coords[0]["n_failed"] == 0
+    assert coords[0]["n_quarantined"] == 0
+    _fold_and_compare(store, serial)

@@ -58,7 +58,7 @@ def test_cli_exits_nonzero_on_fixture_with_json_report():
     proc = _run_cli(str(FIXTURE), "--format", "json")
     assert proc.returncode == 1, proc.stdout + proc.stderr
     payload = json.loads(proc.stdout)
-    assert payload["n_findings"] == 6
+    assert payload["n_findings"] == 7
     assert set(payload["counts_by_rule"]) == set(RULES)
     assert all(
         {"path", "line", "col", "rule", "message", "hint", "suppressed"}
@@ -82,7 +82,7 @@ def test_per_line_suppression_syntax():
     )
     report = lint_source(all_off, path="physics/seeded_variant.py")
     assert not report.active
-    assert len(report.suppressed) == 6
+    assert len(report.suppressed) == 7
 
 
 def test_rule_subset_selection():
@@ -155,6 +155,21 @@ def test_faults_and_retry_modules_clean():
     assert report.files_scanned == 2
     offenders = "\n".join(f.render() for f in report.active)
     assert not report.active, f"robustness-layer findings:\n{offenders}"
+
+
+def test_scheduler_and_worker_modules_clean():
+    """The elastic scheduler/worker pair is the R7 rule's reason to
+    exist (all waiting through injectable clock/sleep seams, no bare
+    time.sleep) and leans on the elastic STATIC_PARAM_NAMES additions
+    (lease_ttl_s/n_workers/churn_plan/…) — pinned per-file at zero
+    unsuppressed findings so a regression names the module."""
+    report = lint_paths([
+        str(PACKAGE / "parallel" / "scheduler.py"),
+        str(PACKAGE / "parallel" / "worker.py"),
+    ])
+    assert report.files_scanned == 2
+    offenders = "\n".join(f.render() for f in report.active)
+    assert not report.active, f"elastic-scheduler findings:\n{offenders}"
 
 
 def test_emulator_and_serve_packages_clean():
