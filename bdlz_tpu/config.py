@@ -185,6 +185,27 @@ class Config:
     # gated fallbacks, or requests in latency-SLO-breaching batches)
     # inside the observation window (serve/rollout.py).
     rollback_budget: float = 0.1
+    # ---- multi-tenant serving plane (bdlz_tpu/serve/tenancy.py,
+    # docs/serving.md "Multi-tenant plane") — same orchestration-only
+    # exclusion rule: pools, budgets and autoscaling change WHICH fleet
+    # answers and when a pool sheds, never a served value's bits (the
+    # tenancy parity tests pin bit-identity vs a single-tenant fleet).
+    # Routing policy for tagged requests: None = engine decides
+    # ("scenario" when a tenant map is configured, "hash" otherwise),
+    # "scenario" = requests must carry a scenario tag resolved through
+    # the tenant map, "hash" = requests must carry an artifact hash.
+    tenant_routing: Optional[str] = None
+    # Device-memory budget across resident pools: None = unbounded,
+    # else idle pools are LRU-evicted when the estimated resident
+    # artifact bytes exceed the budget (evicted pools answer via the
+    # loud degraded exact path, reason "pool_evicted").
+    memory_budget_bytes: Optional[int] = None
+    # Seconds (service clock) between autoscaler rebalance passes over
+    # observed per-pool occupancy/p99.
+    autoscale_interval_s: float = 5.0
+    # Autoscaler floor: no resident pool is ever scaled below this many
+    # replicas (the ceiling is the service's fleet-wide replica budget).
+    pool_min_replicas: int = 1
     # ---- provenance / result-cache knobs (bdlz_tpu/provenance/,
     # docs/provenance.md) — orchestration like the serve knobs: caching
     # changes WHERE a result comes from, never its bits (the sweep_cache
@@ -350,7 +371,17 @@ SERVE_CONFIG_FIELDS = (
     # tests/test_health.py)
     "health_enabled", "breaker_window", "breaker_threshold",
     "breaker_cooldown_s", "breaker_latency_slo_s", "rollback_budget",
+    # the multi-tenant plane knobs (serve/tenancy.py) share the rule:
+    # routing, memory budgets and autoscaling pick WHICH pool/fleet
+    # answers and when an idle pool is evicted — per-artifact answers
+    # stay bit-identical to a single-tenant fleet (pinned in
+    # tests/test_tenancy.py), so resizing tenancy stales no identity
+    "tenant_routing", "memory_budget_bytes", "autoscale_interval_s",
+    "pool_min_replicas",
 )
+
+#: Valid values of the ``tenant_routing`` knob (None = engine decides).
+VALID_TENANT_ROUTING = ("scenario", "hash")
 
 #: Provenance-cache knobs with the same exclusion rule: a cache hit
 #: returns the bytes a cold run would compute (the sweep_cache bench
@@ -593,6 +624,21 @@ def validate(cfg: Config, backend: Optional[str] = None) -> Config:
             f"rollback_budget must be a fraction in (0, 1], got "
             f"{cfg.rollback_budget!r}"
         )
+    if cfg.tenant_routing is not None and (
+        cfg.tenant_routing not in VALID_TENANT_ROUTING
+    ):
+        raise ConfigError(
+            f"tenant_routing={cfg.tenant_routing!r} is not one of "
+            f"{VALID_TENANT_ROUTING} (or null = engine decides)"
+        )
+    if cfg.memory_budget_bytes is not None and cfg.memory_budget_bytes < 1:
+        raise ConfigError(
+            "memory_budget_bytes must be >= 1 (or null = unbounded)"
+        )
+    if not cfg.autoscale_interval_s > 0.0:
+        raise ConfigError("autoscale_interval_s must be > 0")
+    if cfg.pool_min_replicas < 1:
+        raise ConfigError("pool_min_replicas must be >= 1")
     if cfg.cache_root is not None and not isinstance(cfg.cache_root, str):
         raise ConfigError(
             f"cache_root must be a directory path or null, got "

@@ -79,9 +79,21 @@ Sites and what their keys mean:
     ``times``) kill the WORKER at compute start — the lease it held
     dangles until TTL expiry re-queues the chunk, and the dead worker
     lands on the lease's distinct-failures list (fleet-wide quarantine
-    after ``quarantine_after`` distinct workers).  Operational churn
-    only: these sites never join any result identity, because churn
-    must not change bits.
+    after ``quarantine_after`` distinct workers).
+``pool_evict``
+    The multi-tenant plane's memory-pressure eviction
+    (``serve/tenancy.py``); ``key`` = eviction call counter.  Kind
+    ``raise`` forces the next LRU candidate's eviction regardless of
+    the memory budget (a canned mid-trace eviction the bench chaos
+    plan uses); the evicted pool's requests answer via the loud
+    degraded exact path (reason ``"pool_evicted"``), never an error.
+``autoscale``
+    The multi-tenant autoscaler's rebalance pass (``serve/tenancy.py``);
+    ``key`` = pass counter.  Kinds ``raise``/``transient`` fail the
+    pass — pools keep their current replica counts (the plane serves
+    through a sick autoscaler; budgeted by ``times``).  Operational
+    churn only: these sites never join any result identity, because
+    churn must not change bits.
 
 Resolution (:meth:`FaultPlan.resolve`) follows the tri-state knob
 pattern: ``Config.fault_injection`` ``None`` enables injection iff a
@@ -99,7 +111,7 @@ from typing import Any, Dict, List, NamedTuple, Optional
 VALID_SITES = (
     "step", "chunk_write", "probe", "serve_exact", "clock",
     "replica_dispatch", "registry_fetch", "store_read", "lease",
-    "worker_crash",
+    "worker_crash", "pool_evict", "autoscale",
 )
 VALID_KINDS = ("raise", "transient", "poison", "nan", "torn", "slow",
                "corrupt")

@@ -156,6 +156,19 @@ STATIC_PARAM_NAMES = {
     "churn_schedule",
     "tick_s",
     "poll_s",
+    # multi-tenant serving-plane knobs (serve/tenancy.py,
+    # docs/serving.md "Multi-tenant plane"): the tenant map, routing
+    # mode, memory budget, autoscale cadence and pool floor are
+    # host-side orchestration of WHICH pool's fleet answers and WHEN
+    # its tables are resident — per-pool answers are bit-identical to
+    # a single-tenant fleet's, and none of these is ever
+    # tracer-valued.  Same specific-names-only rule as above.
+    "tenant_map",
+    "tenant_routing",
+    "memory_budget_bytes",
+    "autoscale_interval_s",
+    "pool_min_replicas",
+    "replica_budget",
     "n_y",
     "nz",
     "n_mu",

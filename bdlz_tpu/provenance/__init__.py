@@ -25,6 +25,7 @@ from bdlz_tpu.provenance.registry import (
     LEASE_KIND,
     create_lease,
     fetch_artifact,
+    fetch_artifact_with_retry,
     lease_entry_name,
     publish_artifact,
     read_lease,
@@ -57,6 +58,7 @@ __all__ = [
     "ARTIFACT_KIND",
     "LEASE_KIND",
     "fetch_artifact",
+    "fetch_artifact_with_retry",
     "publish_artifact",
     "lease_entry_name",
     "read_lease",

@@ -74,15 +74,19 @@ def publish_artifact(store: Store, artifact) -> str:
     return content_hash
 
 
-#: Process-wide fetch call counter — the key injected ``registry_fetch``
-#: faults fire on (deterministic in a single process; reset in tests via
-#: :func:`reset_fetch_counter`).
-_fetch_calls = 0
+def reset_fetch_counter(store: Store = None) -> None:
+    """Reset the ``registry_fetch`` fault-key counter.
 
-
-def reset_fetch_counter() -> None:
-    global _fetch_calls
-    _fetch_calls = 0
+    The counter is scoped PER-STORE (the ``store_read`` pattern —
+    :meth:`Store.arm_faults`): every :class:`Store` instance starts at
+    zero, so two stores in one process (the multi-tenant plane's
+    registry + a test's scratch store) can no longer perturb each
+    other's fault keys the way the old process-global counter did.
+    With a ``store`` the counter is reset on that instance; without one
+    the call is a no-op kept for pre-scoping callers (a fresh store IS
+    a fresh counter)."""
+    if store is not None:
+        store._fetches = 0
 
 
 def _inject_fetch_fault(fault_plan, key: int, path: str) -> None:
@@ -109,14 +113,13 @@ def fetch_artifact(store: Store, content_hash: str, fault_plan=None):
     verified hash is not the requested one (an impersonating or
     renamed entry); a corrupt entry is deleted first, so the next
     publish starts clean.  ``fault_plan`` (site ``registry_fetch``,
-    keyed by the per-process fetch call counter) exercises exactly
+    keyed by the PER-STORE fetch call counter) exercises exactly
     those refusal paths deterministically — see bdlz_tpu/faults.py."""
     from bdlz_tpu.emulator.artifact import EmulatorArtifactError
     from bdlz_tpu.emulator.multidomain import load_any_artifact
 
-    global _fetch_calls
-    fetch_key = _fetch_calls
-    _fetch_calls += 1
+    fetch_key = getattr(store, "_fetches", 0)
+    store._fetches = fetch_key + 1
     path = os.path.join(store.root, ARTIFACT_KIND, str(content_hash))
     if fault_plan is not None and os.path.isdir(path):
         _inject_fetch_fault(fault_plan, fetch_key, path)
@@ -145,6 +148,38 @@ def fetch_artifact(store: Store, content_hash: str, fault_plan=None):
         )
     store.stats.hits += 1
     return artifact
+
+
+def fetch_artifact_with_retry(
+    store: Store, content_hash: str, fault_plan=None, retry=None,
+    label: str = "registry_fetch",
+):
+    """:func:`fetch_artifact` under the shared :class:`RetryPolicy`
+    (``utils/retry.py`` — bounded attempts, deterministic backoff,
+    injectable sleep).
+
+    The serving tier's registry fetches — the health plane's replica
+    re-provision and the multi-tenant plane's cold-artifact admission —
+    were single-attempt: one torn read or one lost publish race failed
+    the whole re-provision cycle.  A corrupt entry is still deleted on
+    the failing attempt (so a retry sees a clean absent entry, never
+    the same poisoned bytes), and a publish that lands between attempts
+    is admitted — the fetch-vs-publish race resolves to a validated
+    artifact or a typed :class:`EmulatorArtifactError`, never a torn
+    read.  ``retry=None`` keeps the old single-attempt semantics
+    exactly (zero behavior change for callers that do not opt in)."""
+    from bdlz_tpu.utils.retry import call_with_retry
+
+    if retry is None:
+        return fetch_artifact(store, content_hash, fault_plan=fault_plan)
+    from bdlz_tpu.emulator.artifact import EmulatorArtifactError
+
+    return call_with_retry(
+        lambda: fetch_artifact(store, content_hash, fault_plan=fault_plan),
+        retry,
+        label=f"{label}:{content_hash}",
+        retryable=(EmulatorArtifactError, OSError),
+    )
 
 
 # ---- lease records (the elastic scheduler's claim plane) ----------------

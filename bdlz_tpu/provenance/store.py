@@ -95,6 +95,10 @@ class Store:
         # armed per-instance via arm_faults(); zero overhead when unarmed
         self._faults = None
         self._reads = 0
+        # per-store registry-fetch fault key (site "registry_fetch",
+        # bumped by provenance.registry.fetch_artifact) — scoped here so
+        # two stores in one process cannot perturb each other's keys
+        self._fetches = 0
 
     def arm_faults(self, plan) -> None:
         """Arm a :class:`~bdlz_tpu.faults.FaultPlan` on this store's READ

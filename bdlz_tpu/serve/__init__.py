@@ -13,7 +13,13 @@
 * zero-downtime artifact rollout (``rollout.py``) — stage artifact N+1
   beside N, warm its kernels, cut over atomically with multihost
   agreement, and auto-roll-back when the post-cutover error budget is
-  blown; responses always carry the artifact hash that answered.
+  blown; responses always carry the artifact hash that answered;
+* multi-tenant plane (``tenancy.py``) — scenario/hash-routed
+  per-artifact pools (own queue, breakers, stats), cold admission by
+  registry fetch, load-driven autoscaling with hysteresis under a
+  fleet-wide replica ceiling, memory-budget LRU eviction with loud
+  ``"pool_evicted"`` degraded-exact answering, and typed
+  ``TenancyError`` cross-scenario skew rejection.
 
 The full typed-error surface exports here — ``QueueFull`` (admission),
 ``DeadlineExceeded`` (shedding), ``ServiceUnavailable`` (closed
@@ -41,7 +47,11 @@ from bdlz_tpu.serve.health import (  # noqa: F401
     HealthPlane,
     resolve_health_policy,
 )
-from bdlz_tpu.serve.rollout import ArtifactRollout, RolloutError  # noqa: F401
+from bdlz_tpu.serve.rollout import (  # noqa: F401
+    ArtifactRollout,
+    RolloutError,
+    looks_like_content_hash,
+)
 from bdlz_tpu.serve.service import (  # noqa: F401
     REASON_DEGRADED,
     REASON_OOD,
@@ -52,4 +62,10 @@ from bdlz_tpu.serve.service import (  # noqa: F401
     gate_fallback_masks,
     resolve_error_gate,
     resolve_service_static,
+)
+from bdlz_tpu.serve.tenancy import (  # noqa: F401
+    REASON_POOL_EVICTED,
+    MultiTenantService,
+    PoolState,
+    TenancyError,
 )

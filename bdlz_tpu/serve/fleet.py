@@ -575,6 +575,12 @@ class FleetService:
             fault_plan=fault_plan, lz_profile=lz_profile,
         )
         self._faults = self._fallback.fault_plan
+        #: The resolved retry policy the registry-facing paths share
+        #: (health-plane re-provision here, cold admission in
+        #: serve/tenancy.py): bounded attempts with deterministic
+        #: backoff instead of single-attempt failure.  None = healing
+        #: off = the pre-retry single-attempt fetch, exactly.
+        self.registry_retry = self._fallback._retry
         self.replica_set = ReplicaSet(
             artifact, field=field, n_replicas=n_replicas, devices=devices,
             max_batch_size=self.max_batch_size, routing=routing,
@@ -582,6 +588,9 @@ class FleetService:
             error_gate=self.error_gate_tol is not None,
             fault_plan=self._faults,
         )
+        #: The device pool :meth:`resize` rebuilds onto (None = every
+        #: local device, resolved by ReplicaSet at build time).
+        self._devices = list(devices) if devices is not None else None
         #: The replica health plane (serve/health.py; tri-state
         #: ``health`` argument > ``Config.health_enabled``; None =
         #: engine decides = ON for the fleet front).  ``None`` here =
@@ -589,6 +598,9 @@ class FleetService:
         #: disabled service is byte-identical to the pre-health one
         #: (pinned in tests/test_health.py).
         policy = resolve_health_policy(health, base)
+        #: Retained so :meth:`resize` can rebuild the plane at the new
+        #: fleet width with the SAME resolved policy.
+        self._health_policy = policy
         self.health = (
             HealthPlane(self.replica_set.n_replicas, policy,
                         stats=self.stats)
@@ -661,6 +673,55 @@ class FleetService:
         with self._lock:
             old, self.replica_set = self.replica_set, replica_set
         return old
+
+    def resize(self, n_replicas: int) -> int:
+        """Rebuild the fleet at ``n_replicas`` replicas IN PLACE — the
+        multi-tenant autoscaler's rebalance hook (serve/tenancy.py),
+        and the one sanctioned way to change the fleet shape on a live
+        service (a rollout must NOT — see :meth:`swap_replica_set`).
+
+        The new set is built from the same artifact object on the same
+        device pool and warmed BEFORE the cutover, so no request pays a
+        compile; batches already in flight resolve on the set they were
+        dispatched on (the ``_InFlight.rset`` pin).  The health plane
+        is rebuilt at the new width with the same resolved policy —
+        breaker windows and probe state reset, deliberately: a resize
+        is a redeploy of the replica surface, and a breaker tracking a
+        replica index that no longer exists would be lying.  Replica
+        count never changes served bits (the fleet parity pins), so no
+        identity is staled.  Returns the new replica count.
+        """
+        n = int(n_replicas)
+        if n < 1:
+            raise ValueError("n_replicas must be >= 1")
+        if self._closed:
+            raise ServiceUnavailable("service is closed; cannot resize")
+        if n == self.replica_set.n_replicas:
+            return n
+        if self.in_flight():
+            # a shrunk health plane must never be asked to score a
+            # replica index that predates the resize
+            raise ValueError(
+                "resize with batches in flight; poll() them to "
+                "completion first (the autoscaler rebalances only "
+                "between dispatches)"
+            )
+        replica_set = ReplicaSet(
+            self.replica_set.artifact, field=self.field, n_replicas=n,
+            devices=self._devices, max_batch_size=self.max_batch_size,
+            routing=self.replica_set.routing, warm=True, stats=self.stats,
+            error_gate=self.replica_set.error_gate,
+            fault_plan=self._faults,
+        )
+        health = (
+            HealthPlane(replica_set.n_replicas, self._health_policy,
+                        stats=self.stats)
+            if self._health_policy is not None else None
+        )
+        with self._lock:
+            self.replica_set = replica_set
+            self.health = health
+        return n
 
     # ---- enqueue (admission control) --------------------------------
 
@@ -1097,17 +1158,19 @@ class FleetService:
         """Re-provision a persistently sick replica from the provenance
         registry by content hash (fresh tables + kernel on the same
         device).  Needs a resolvable store AND a breaker that has
-        burned its probe cycles (``needs_reprovision``); a failed fetch
-        (missing/corrupt entry) is counted and the breaker simply stays
-        open — the next probe cycle retries."""
+        burned its probe cycles (``needs_reprovision``); the fetch runs
+        under the shared registry retry policy (bounded deterministic
+        backoff), and a fetch that still fails (missing/corrupt entry)
+        is counted and the breaker simply stays open — the next probe
+        cycle retries."""
         if self.store is None or not self.health.needs_reprovision(index):
             return
-        from bdlz_tpu.provenance import fetch_artifact
+        from bdlz_tpu.provenance import fetch_artifact_with_retry
 
         try:
-            artifact = fetch_artifact(
+            artifact = fetch_artifact_with_retry(
                 self.store, self.replica_set.artifact_hash,
-                fault_plan=self._faults,
+                fault_plan=self._faults, retry=self.registry_retry,
             )
             self.replica_set.reprovision(index, artifact)
         except Exception:  # noqa: BLE001 — counted, breaker stays open

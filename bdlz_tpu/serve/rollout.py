@@ -342,17 +342,21 @@ class ArtifactRollout:
         return prev.artifact_hash
 
 
+def looks_like_content_hash(s: str) -> bool:
+    """Pure format check: is ``s`` shaped like a 16-hex artifact
+    content hash?  The tenant-map parser (serve/tenancy.py + the CLI's
+    ``--tenant-map``) validates its hash values with this — no
+    filesystem exception there, a map entry is never a path."""
+    return len(s) == 16 and all(c in "0123456789abcdef" for c in s)
+
+
 def _looks_like_content_hash(s: str) -> bool:
     """A 16-hex artifact content hash (vs a filesystem path).  A path
     that happens to exist always wins — an operator staging a directory
     literally named like a hash should get the directory."""
     import os
 
-    return (
-        len(s) == 16
-        and all(c in "0123456789abcdef" for c in s)
-        and not os.path.exists(s)
-    )
+    return looks_like_content_hash(s) and not os.path.exists(s)
 
 
 def _agree_cutover(staged_hash: str, warmed: bool) -> None:
@@ -403,4 +407,5 @@ __all__ = [
     "ArtifactRollout",
     "RolloutError",
     "HASH_WIRE_WIDTH",
+    "looks_like_content_hash",
 ]

@@ -13,6 +13,20 @@ admission control (``--queue-bound``; rejected requests get structured
 ``artifact_hash`` that answered them (the rollout provenance,
 docs/serving.md).
 
+``--tenant-map`` (JSON text or path: scenario label -> artifact
+content hash) switches to the MULTI-TENANT plane (serve/tenancy.py):
+one pool per artifact, cold-admitted from the provenance registry by
+content hash on first request, with per-pool admission/shedding,
+load-driven autoscaling and ``--memory-budget`` LRU eviction (evicted
+pools answer through the loud degraded exact path, reason
+``"pool_evicted"``).  Requests then carry ``"scenario"`` (or
+``"artifact_hash"``) routing tags, and every answer and error record
+names its ``pool`` (the answering artifact hash) and ``scenario`` —
+cross-scenario skew (a stated ``lz_mode`` disagreeing with the pool)
+is a structured typed ``TenancyError`` record.  ``--artifact`` is not
+used in this mode; the registry (``cache_root``/``BDLZ_CACHE_ROOT``)
+is the artifact source.
+
 Requests are JSON lines, one query each, either an object mapping the
 artifact's axis names to values (``{"m_chi_GeV": 0.95, "T_p_GeV":
 100.0}``) or ``{"theta": [0.95, 100.0]}`` in artifact axis order; an
@@ -59,9 +73,11 @@ def _error_record(rid, exc, **extra) -> dict:
         QueueFull,
         RolloutError,
         ServiceUnavailable,
+        TenancyError,
     )
 
-    typed = (QueueFull, DeadlineExceeded, ServiceUnavailable, RolloutError)
+    typed = (QueueFull, DeadlineExceeded, ServiceUnavailable, RolloutError,
+             TenancyError)
     name = type(exc).__name__
     return {
         "id": rid,
@@ -80,8 +96,10 @@ def main(argv: Optional[list] = None) -> int:
     )
     ap.add_argument("--config", required=True,
                     help="yields_config JSON the artifact was built for")
-    ap.add_argument("--artifact", required=True,
-                    help="emulator artifact directory (manifest.json + artifact.npz)")
+    ap.add_argument("--artifact", default=None,
+                    help="emulator artifact directory (manifest.json + "
+                         "artifact.npz); required unless --tenant-map "
+                         "serves from the registry")
     ap.add_argument("--requests", default=None,
                     help="JSON-lines request file ('-' = stdin)")
     ap.add_argument("--bench", type=int, default=None, metavar="N",
@@ -115,6 +133,19 @@ def main(argv: Optional[list] = None) -> int:
                          "(--replicas only; docs/robustness.md): auto "
                          "= the config tri-state (fleet default ON), "
                          "off = the pre-health byte-identical behavior")
+    ap.add_argument("--tenant-map", default=None, dest="tenant_map",
+                    help="multi-tenant plane (serve/tenancy.py): JSON "
+                         "text or path mapping scenario labels to "
+                         "artifact content hashes; pools are "
+                         "cold-admitted from the provenance registry "
+                         "(cache_root/BDLZ_CACHE_ROOT) on first request")
+    ap.add_argument("--memory-budget", type=int, default=None,
+                    dest="memory_budget", metavar="BYTES",
+                    help="device-memory budget across resident pools "
+                         "(--tenant-map only): idle pools are "
+                         "LRU-evicted beyond it and answer through the "
+                         "degraded exact path until readmitted "
+                         "(default: unbounded)")
     ap.add_argument("--lz-profile", default=None, dest="lz_profile",
                     help="Bounce-profile CSV for a scenario "
                          "(chain/thermal) artifact: its exact fallback "
@@ -138,6 +169,10 @@ def main(argv: Optional[list] = None) -> int:
 
     event_log = EventLog(path=args.events) if args.events else EventLog()
     base = validate(load_config(args.config))
+    if args.tenant_map is not None:
+        return _serve_tenant(args, ap, base, event_log)
+    if args.artifact is None:
+        ap.error("--artifact is required (or serve pools via --tenant-map)")
     # kind-dispatching load: single artifacts AND seam-split bundles
     # (multi-domain, stitched at query time) serve through one front
     artifact = load_any_artifact(args.artifact)
@@ -306,6 +341,176 @@ def main(argv: Optional[list] = None) -> int:
     return 1 if (n_lines and n_ok == 0) else 0
 
 
+def _load_tenant_map(text_or_path: str) -> dict:
+    """Parse a ``--tenant-map`` value: JSON text, or a path to a JSON
+    file (the fault-plan parsing pattern).  Content validation (labels,
+    hash shape) is the service's job — one home for that rule."""
+    text = text_or_path
+    if not text_or_path.lstrip().startswith("{"):
+        with open(text_or_path, encoding="utf-8") as f:
+            text = f.read()
+    obj = json.loads(text)
+    if not isinstance(obj, dict):
+        raise ValueError(
+            "tenant map must be a JSON object mapping scenario labels "
+            "to artifact content hashes"
+        )
+    return obj
+
+
+def _serve_tenant(args, ap, base, event_log) -> int:
+    """``--tenant-map`` mode: drain the request stream through the
+    multi-tenant plane (serve/tenancy.py).  Every answer and error
+    record names its ``pool`` (the answering artifact hash) and
+    ``scenario``; routing/skew refusals (typed ``TenancyError``) and
+    per-pool overload (``QueueFull``) are per-request structured
+    errors, never a dead stream.  Closing the service on the way out
+    fails anything still queued with typed ``ServiceUnavailable``."""
+    from bdlz_tpu.serve.tenancy import MultiTenantService
+
+    if args.artifact is not None:
+        ap.error("--artifact is not used with --tenant-map (pools are "
+                 "fetched from the registry by content hash)")
+    if args.bench is not None:
+        ap.error("--bench is not supported with --tenant-map (the bench "
+                 "harness's serve_multitenant leg covers it)")
+    if args.requests is None:
+        ap.error("one of --requests or --bench is required")
+    try:
+        tenant_map = _load_tenant_map(args.tenant_map)
+    except Exception as exc:  # noqa: BLE001 — flag-layer refusal
+        ap.error(f"--tenant-map: {exc}")
+    svc = MultiTenantService(
+        base,
+        tenant_map=tenant_map,
+        field=args.field,
+        max_batch_size=args.max_batch,
+        n_replicas=args.replicas if args.replicas else None,
+        queue_bound=args.queue_bound,
+        routing=args.routing,
+        max_wait_s=args.max_wait_ms / 1e3,
+        deadline_s=(
+            None if args.deadline_ms is None else args.deadline_ms / 1e3
+        ),
+        health={"auto": None, "on": True, "off": False}[args.health],
+        lz_profile=args.lz_profile,
+        memory_budget_bytes=args.memory_budget,
+    )
+    event_log.emit(
+        "serve_start",
+        tenant_map=dict(tenant_map),
+        tenant_routing=svc.tenant_routing,
+        memory_budget_bytes=svc.memory_budget_bytes,
+        max_batch=args.max_batch,
+        max_wait_ms=args.max_wait_ms,
+    )
+    n_lines = 0
+    n_ok = 0
+    submitted = []  # (rid, scenario, future)
+    resolved_at = {}  # submitted index -> resolve-time latency
+
+    def _stamp(index, t0):
+        def cb(_fut):
+            resolved_at[index] = time.monotonic() - t0
+
+        return cb
+
+    fh = (
+        sys.stdin if args.requests == "-"
+        else open(args.requests, encoding="utf-8")
+    )
+    try:
+        for ln, line in enumerate(fh, 1):
+            line = line.strip()
+            if not line:
+                continue
+            n_lines += 1
+            try:
+                obj = json.loads(line)
+            except Exception as exc:  # noqa: BLE001 — report per request
+                print(json.dumps(_error_record(
+                    None, exc, line=ln, pool=None, scenario=None,
+                )))
+                continue
+            if not isinstance(obj, dict):
+                print(json.dumps(_error_record(ln, ValueError(
+                    "request line must be a JSON object"
+                ), line=ln, pool=None, scenario=None)))
+                continue
+            rid = obj.get("id", ln)
+            scenario = obj.get("scenario")
+            ahash = obj.get("artifact_hash")
+            pool = ahash if ahash else tenant_map.get(scenario)
+            t0 = time.monotonic()
+            try:
+                if "theta" in obj:
+                    fut = svc.submit(
+                        np.asarray(obj["theta"], dtype=np.float64),
+                        scenario=scenario, artifact_hash=ahash,
+                        lz_mode=obj.get("lz_mode"),
+                    )
+                else:
+                    # mapping-style requests keep their stated lz_mode
+                    # inside the mapping (validated per pool)
+                    point = {
+                        k: v for k, v in obj.items()
+                        if k not in ("id", "scenario", "artifact_hash")
+                    }
+                    fut = svc.submit(
+                        point, scenario=scenario, artifact_hash=ahash,
+                    )
+            except Exception as exc:  # noqa: BLE001 — report per request
+                print(json.dumps(_error_record(
+                    rid, exc, line=ln, pool=pool, scenario=scenario,
+                )))
+                continue
+            fut.add_done_callback(_stamp(len(submitted), t0))
+            submitted.append((rid, scenario, fut))
+            svc.run_once()
+            svc.poll(block=False)
+    finally:
+        if fh is not sys.stdin:
+            fh.close()
+    try:
+        svc.drain()
+        for index, (rid, scenario, fut) in enumerate(submitted):
+            latency = round(resolved_at.get(index, 0.0), 6)
+            try:
+                resp = fut.result(timeout=0)
+            except Exception as exc:  # noqa: BLE001 — report per request
+                print(json.dumps(_error_record(
+                    rid, exc, latency_s=latency, pool=None,
+                    scenario=scenario,
+                )))
+                continue
+            n_ok += 1
+            print(json.dumps({
+                "id": rid,
+                "value": float(resp.value),
+                # which pool answered (the artifact hash IS the pool key)
+                "pool": resp.artifact_hash,
+                "scenario": (
+                    scenario if scenario is not None
+                    else svc.scenario_for(resp.artifact_hash)
+                ),
+                "artifact_hash": resp.artifact_hash,
+                "replica": resp.replica,
+                "lz_mode": resp.lz_mode,
+                "fallback_reason": resp.fallback_reason,
+                # loud degraded markers: every breaker open ("degraded")
+                # or the pool is memory-evicted ("pool_evicted")
+                "degraded": resp.degraded,
+                "latency_s": latency,
+            }))
+    finally:
+        # the abandon path: anything an escaped error left queued (on
+        # ANY pool, degraded queues included) fails with a typed
+        # ServiceUnavailable, never a future hanging into exit
+        svc.close()
+    event_log.emit("serve_done", **svc.summary())
+    return 1 if (n_lines and n_ok == 0) else 0
+
+
 def _serve_requests_fleet(fleet, requests) -> int:
     """Drain parsed requests through the fleet front.
 
@@ -357,6 +562,12 @@ def _serve_requests_fleet(fleet, requests) -> int:
         print(json.dumps({
             "id": rid,
             "value": float(resp.value),
+            # single-tenant fleet: the one artifact IS the pool; the
+            # scenario label is a tenant-map concept (null here) — the
+            # keys exist so stream consumers see ONE answer schema
+            # across the fleet and multi-tenant fronts
+            "pool": resp.artifact_hash,
+            "scenario": None,
             "artifact_hash": resp.artifact_hash,
             "replica": resp.replica,
             # the physics scenario that answered (docs/scenarios.md)

@@ -1947,6 +1947,297 @@ def main(argv=None) -> None:
         else:
             lz_thermal_per_chip = val
 
+    # --- secondary metric: serve_multitenant (scenario-routed pools) ---
+    # The multi-tenant serving plane (bdlz_tpu/serve/tenancy.py) under a
+    # deterministic fake-clock mixed-scenario trace: three pools —
+    # the round's coherent artifact plus purpose-built N-level-chain and
+    # finite-T thermal boxes — are cold-admitted from a provenance store
+    # by content hash, pumped concurrently, then hit with a canned chaos
+    # plan (replica faults confined to the chain pool via
+    # fault_scenarios + one forced pool_evict mid-trace).  The evicted
+    # pool answers a burst through the loud degraded exact path (reason
+    # "pool_evicted"), is readmitted warm, and every non-degraded answer
+    # must come back BIT-identical to a single-tenant fleet serving the
+    # same artifact — routing, autoscaling and the evict/readmit cycle
+    # may never buy a different answer.  The line carries availability,
+    # QPS/chip, per-pool p50/p99 + shed rate, and the cold-admission /
+    # readmit latency evidence.
+    def serve_multitenant_metric(artifact):
+        import dataclasses
+        import tempfile
+
+        from bdlz_tpu.emulator import AxisSpec, build_emulator
+        from bdlz_tpu.provenance import Store, publish_artifact
+        from bdlz_tpu.serve import REASON_POOL_EVICTED, MultiTenantService
+        from bdlz_tpu.serve.fleet import FleetService
+        from bdlz_tpu.serve.tenancy import pool_base
+
+        mt_batch = int(os.environ.get("BDLZ_BENCH_MT_BATCH", 32))
+        mt_ticks = max(8, int(os.environ.get("BDLZ_BENCH_MT_TICKS", 12)))
+        mt_ny = int(os.environ.get("BDLZ_BENCH_MT_NY", 400))
+        mt_nodes = int(os.environ.get("BDLZ_BENCH_MT_GRID", 3))
+        mt_levels = int(os.environ.get("BDLZ_BENCH_MT_CHAIN_LEVELS", 5))
+        scenarios = ("coherent", "chain", "thermal")
+
+        # the two scenario boxes share the coherent leg's build base and
+        # differ ONLY in the scenario knobs — the tenancy plane's strict
+        # per-pool identity check demands exactly that
+        base_chain = dataclasses.replace(
+            base, lz_mode="chain", lz_n_levels=mt_levels
+        )
+        base_thermal = dataclasses.replace(
+            base, lz_mode="thermal", lz_bath_eta=bath_eta,
+            lz_bath_omega_c=bath_omega_c,
+        )
+        build_kw = dict(
+            rtol=1e-2, n_probe=4, n_holdout=8, max_rounds=1, n_y=mt_ny,
+            chunk_size=64, require_converged=False, lz_profile=lz_prof,
+        )
+        t_build = time.time()
+        art_chain, _ = build_emulator(
+            base_chain,
+            {"m_chi_GeV": AxisSpec(0.9, 1.1, 2, "log"),
+             "v_w": AxisSpec(0.25, 0.35, mt_nodes, "lin")},
+            **build_kw,
+        )
+        art_thermal, _ = build_emulator(
+            base_thermal,
+            {"T_p_GeV": AxisSpec(90.0, 110.0, 2, "log"),
+             "v_w": AxisSpec(0.25, 0.35, mt_nodes, "lin")},
+            **build_kw,
+        )
+        build_seconds = time.time() - t_build
+        arts = {"coherent": artifact, "chain": art_chain,
+                "thermal": art_thermal}
+
+        # per-scenario request streams drawn inside each pool's hull
+        rng = np.random.default_rng(23)
+        thetas_of, cursor = {}, {}
+        for scn, art in arts.items():
+            lo = np.array([nodes[0] for nodes in art.axis_nodes])
+            hi = np.array([nodes[-1] for nodes in art.axis_nodes])
+            thetas_of[scn] = rng.uniform(
+                lo, hi, size=(mt_ticks * mt_batch, len(lo))
+            )
+            cursor[scn] = 0
+
+        # canned chaos plan: replica-1 faults confined to the CHAIN pool
+        # (fault_scenarios), plus one forced eviction (key 0 = the first
+        # eviction-counter value; it defers until a pool is provably
+        # idle — the trace makes that the coherent pool, mid-trace)
+        plan_obj = {"faults": [
+            {"site": "replica_dispatch", "kind": "transient", "key": 1,
+             "times": 2},
+            {"site": "replica_dispatch", "kind": "nan", "key": 1,
+             "times": 1},
+            {"site": "pool_evict", "kind": "raise", "key": 0},
+        ]}
+
+        class _Tick:
+            t = 0.0
+
+            def __call__(self):
+                return self.t
+
+        # gate off + tight breaker knobs, exactly the chaos_serve
+        # rationale: the bitwise pin compares pure replica-kernel
+        # answers, and one bad batch must trip/heal INSIDE the trace
+        scfg = dataclasses.replace(
+            base, breaker_window=1, breaker_cooldown_s=0.05,
+            error_gate_tol=False,
+        )
+        ta = mt_ticks // 2          # all three pools busy
+        tb = max(2, mt_ticks // 4)  # coherent dark: evict + degraded
+        per_pool = {}
+        with tempfile.TemporaryDirectory() as mt_root:
+            store = Store(os.path.join(mt_root, "store"))
+            tenant_map = {
+                scn: publish_artifact(store, art)
+                for scn, art in arts.items()
+            }
+            tick = _Tick()
+            t_trace = time.time()
+            svc = MultiTenantService(
+                scfg, tenant_map=tenant_map, store=store,
+                max_batch_size=mt_batch, n_replicas=2, clock=tick,
+                max_wait_s=1e-3, fault_plan=json.dumps(plan_obj),
+                fault_scenarios=("chain",), error_gate_tol=False,
+                lz_profile=lz_prof, replica_budget=8,
+                autoscale_interval_s=0.05,
+            )
+            futs = []
+
+            def burst(scn):
+                i = cursor[scn]
+                cursor[scn] = i + mt_batch
+                for k in range(i, i + mt_batch):
+                    futs.append(
+                        (scn, k, svc.submit(thetas_of[scn][k], scenario=scn))
+                    )
+
+            for t in range(mt_ticks):
+                if t == ta + tb:
+                    # warm readmission through the cold-admission path
+                    svc.readmit("coherent")
+                if t < ta or t >= ta + tb or t == ta + 1:
+                    # t == ta: coherent goes dark (idle -> the forced
+                    # eviction's victim); t == ta + 1: one burst lands
+                    # on the evicted pool's degraded queue
+                    burst("coherent")
+                burst("chain")
+                burst("thermal")
+                # advance BEFORE dispatch so per-request latency is a
+                # nonzero deterministic function of the trace
+                tick.t += 0.02
+                svc.run_once()
+                svc.poll(block=True)
+                if t == ta and not svc.pool("coherent").evicted:
+                    raise RuntimeError(
+                        "forced pool_evict did not fire at the idle tick"
+                    )
+            svc.drain()
+            trace_seconds = time.time() - t_trace
+
+            n_req = len(futs)
+            answered = 0
+            degraded_answers = 0
+            mt_vals = {
+                scn: np.full(cursor[scn], np.nan) for scn in scenarios
+            }
+            exact_ok = {
+                scn: np.zeros(cursor[scn], dtype=bool) for scn in scenarios
+            }
+            for scn, k, f in futs:
+                try:
+                    resp = f.result(timeout=0)
+                except Exception:  # noqa: BLE001 — availability counts these
+                    continue
+                answered += 1
+                if resp.degraded:
+                    if resp.fallback_reason == REASON_POOL_EVICTED:
+                        degraded_answers += 1
+                else:
+                    mt_vals[scn][k] = resp.value
+                    exact_ok[scn][k] = True
+            availability = answered / n_req
+            summary = svc.summary()
+            n_devices = max(
+                p.fleet.replica_set.n_devices
+                for p in svc.pools.values() if p.fleet is not None
+            )
+            admissions = list(svc.admission_events)
+            svc.close()
+
+            # the single-tenant control fleets: same artifacts, same
+            # per-pool configs, no faults — every non-degraded answer
+            # must match them bit-for-bit
+            bitwise = True
+            for scn, art in arts.items():
+                rcfg = dataclasses.replace(
+                    pool_base(scfg, art),
+                    fault_plan=None, fault_injection=False,
+                )
+                ref = FleetService(
+                    art, rcfg, max_batch_size=mt_batch, n_replicas=1,
+                    max_wait_s=1e-3,
+                    lz_profile=lz_prof if scn != "coherent" else None,
+                )
+                rfuts = [
+                    ref.submit(th) for th in thetas_of[scn][:cursor[scn]]
+                ]
+                ref.drain()
+                ref_vals = np.array(
+                    [f.result(timeout=0).value for f in rfuts]
+                )
+                ref.close()
+                ok = exact_ok[scn]
+                bitwise = bitwise and bool(
+                    np.array_equal(mt_vals[scn][ok], ref_vals[ok])
+                )
+
+        cold_admission_s = {
+            ev["scenario"]: round(ev["seconds"], 4)
+            for ev in admissions if not ev["readmit"]
+        }
+        readmit_s = next(
+            (round(ev["seconds"], 4) for ev in admissions if ev["readmit"]),
+            None,
+        )
+        for content_hash, s in summary["pools"].items():
+            per_pool[s["scenario"]] = {
+                "artifact_hash": content_hash,
+                "lz_mode": s["lz_mode"],
+                "n_replicas": s["n_replicas"],
+                "evicted": s["evicted"],
+                "accepted": s["accepted"],
+                "shed_rate": s["shed_rate"],
+                "p50_latency_s": s["p50_latency_s"],
+                "p99_latency_s": s["p99_latency_s"],
+                "mean_occupancy": s["mean_occupancy"],
+            }
+        serve_seconds = max(
+            trace_seconds - sum(ev["seconds"] for ev in admissions), 1e-9
+        )
+        qps_per_chip = round(answered / serve_seconds / n_devices, 1)
+        payload = {
+            "metric": "serve_multitenant_availability",
+            "value": round(availability, 4),
+            "unit": "answered fraction across %d scenario pools under a "
+                    "canned chaos plan (chain-pool replica faults + one "
+                    "forced eviction, fake-clock trace, batch %d)"
+                    % (len(scenarios), mt_batch),
+            "n_requests": n_req,
+            "n_pools": len(scenarios),
+            "scenarios": list(scenarios),
+            "qps_per_chip": qps_per_chip,
+            "per_pool": per_pool,
+            "shed_rate": max(
+                p["shed_rate"] for p in per_pool.values()
+            ),
+            "cold_admission_s": cold_admission_s,
+            "readmit_s": readmit_s,
+            "degraded_answers": degraded_answers,
+            "evictions": summary["evictions"],
+            "forced_evictions": summary["forced_evictions"],
+            "admissions": summary["admissions"],
+            "readmissions": summary["readmissions"],
+            "autoscale_passes": summary["autoscale_passes"],
+            "resizes": summary["resizes"],
+            "replica_budget": summary["replica_budget"],
+            "tenant_routing": summary["tenant_routing"],
+            "bitwise_equal_unaffected": bitwise,
+            "fault_plan": plan_obj["faults"],
+            "build_seconds": round(build_seconds, 3),
+            "wall_seconds": round(trace_seconds, 4),
+            "platform": jax.devices()[0].platform,
+            "tpu_unavailable": tpu_unavailable,
+        }
+        emit(payload)
+        return {
+            k: payload[k] for k in (
+                "value", "qps_per_chip", "shed_rate", "cold_admission_s",
+                "readmit_s", "degraded_answers", "forced_evictions",
+                "autoscale_passes", "bitwise_equal_unaffected",
+            )
+        }
+
+    multitenant_summary = None
+    try:
+        _mt_hit = leg_lookup("serve_multitenant")
+        if _mt_hit is not None:
+            multitenant_summary = _mt_hit.get("summary")
+        elif emu_artifact is None:
+            print("[bench] serve_multitenant skipped: no emulator artifact "
+                  "this round", file=sys.stderr)
+        else:
+            multitenant_summary = run_leg(
+                "serve_multitenant",
+                lambda: serve_multitenant_metric(emu_artifact),
+            )
+    except Exception as exc:  # noqa: BLE001 — secondary metric is best-effort
+        print(f"[bench] serve_multitenant metric unavailable: {exc}",
+              file=sys.stderr)
+
     # --- secondary metric: the differentiable pipeline (grad_sweep) ----
     # d(Ω_DM/Ω_b)/dθ throughput through jax.grad of the exact pipeline
     # (sampling/grad.py — the gradient layer NUTS and the Fisher-aware
@@ -2246,6 +2537,12 @@ def main(argv=None) -> None:
                 # trace (availability / recovery / bitwise pin; null =
                 # leg failed — its secondary line has the full detail)
                 "chaos_serve": chaos_serve_summary,
+                # the multi-tenant scenario-routed serving plane
+                # (availability under chain-pool faults + forced
+                # eviction, cold-admission/readmit latency, bitwise pin
+                # vs single-tenant fleets; null = leg failed — its
+                # secondary line has the full detail)
+                "serve_multitenant": multitenant_summary,
                 # the seam-split emulator A/B (split-domain build +
                 # error-gated serve trace vs single-domain; null = leg
                 # failed — its secondary line has the full detail)

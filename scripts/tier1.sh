@@ -13,11 +13,17 @@ cd "$(dirname "$0")/.."
 
 set -o pipefail
 rm -f /tmp/_t1.log
-timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+t1_budget_s=870
+t1_start=$SECONDS
+timeout -k 10 "$t1_budget_s" env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
     -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
     -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
 test_rc=${PIPESTATUS[0]}
+t1_wall=$((SECONDS - t1_start))
 echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
+# headroom telemetry: the suite's wall-clock against the timeout budget
+# above, so a PR that eats the margin is visible BEFORE one that blows it
+echo "TIER1_WALL_S=${t1_wall} (budget ${t1_budget_s}s, headroom $((t1_budget_s - t1_wall))s)"
 
 bash scripts/lint.sh
 lint_rc=$?

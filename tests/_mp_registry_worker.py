@@ -1,0 +1,108 @@
+"""Worker for the real 2-process registry fetch-vs-evict race test.
+
+Launched twice by ``tests/test_provenance.py::TestRegistryRace`` as
+``python _mp_registry_worker.py <role> <contested_root> <artifact_dir>
+<content_hash> <deadline_epoch>``.  Both processes share one contested
+store root:
+
+* the **churner** loops publish → truncate-the-npz → fetch (which
+  detects the corrupt entry and evicts it) → republish the same hash,
+  i.e. it keeps the entry permanently mid-transition;
+* the **fetcher** hammers ``fetch_artifact`` the whole time and asserts
+  the registry contract under that churn: every call either returns a
+  FULLY VALIDATED artifact whose table bytes are identical to the
+  pristine copy, or raises typed
+  (``EmulatorArtifactError``/``OSError``) — never a torn read.
+
+Exit 0 with a JSON result line on stdout; any contract violation is a
+loud traceback + nonzero exit the parent test surfaces.
+"""
+import json
+import os
+import pathlib
+import sys
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _entry_dir(root: str, content_hash: str) -> str:
+    from bdlz_tpu.provenance.registry import ARTIFACT_KIND
+
+    return os.path.join(root, ARTIFACT_KIND, content_hash)
+
+
+def churner(store, art_dir: str, content_hash: str, deadline: float):
+    """Publish / corrupt / evict / republish until the deadline."""
+    from bdlz_tpu.emulator.artifact import EmulatorArtifactError
+    from bdlz_tpu.provenance import fetch_artifact, publish_artifact
+
+    published = evicted = 0
+    while time.time() < deadline:
+        assert publish_artifact(store, art_dir) == content_hash
+        published += 1
+        entry = _entry_dir(store.root, content_hash)
+        try:
+            victim = next(
+                os.path.join(entry, n) for n in sorted(os.listdir(entry))
+                if n.endswith(".npz")
+            )
+            # truncate rather than flip a header byte: zipfile decodes
+            # members from the CENTRAL directory, so a flipped local-
+            # header byte loads fine — a half-file can never parse
+            with open(victim, "r+b") as fh:
+                fh.truncate(max(1, os.path.getsize(victim) // 2))
+        except (OSError, StopIteration):
+            continue  # the fetcher's eviction won the race; republish
+        try:
+            fetch_artifact(store, content_hash)
+        except (EmulatorArtifactError, OSError):
+            evicted += 1  # corrupt entry detected -> deleted, as pinned
+    return {"published": published, "evicted": evicted}
+
+
+def fetcher(store, art_dir: str, content_hash: str, deadline: float):
+    """Assert every concurrent fetch is validated-or-typed, never torn."""
+    import numpy as np
+
+    from bdlz_tpu.emulator.artifact import EmulatorArtifactError
+    from bdlz_tpu.emulator.multidomain import load_any_artifact
+    from bdlz_tpu.provenance import fetch_artifact
+
+    pristine = load_any_artifact(art_dir)
+    ref = {
+        k: np.asarray(v) for k, v in pristine.values.items()
+    }
+    ok = refused = 0
+    while time.time() < deadline:
+        try:
+            art = fetch_artifact(store, content_hash)
+        except (EmulatorArtifactError, OSError):
+            refused += 1  # typed refusal: absent, corrupt, or mid-evict
+            continue
+        # a served artifact must be the pristine one, bit for bit —
+        # anything else is the torn read this test exists to catch
+        assert art.content_hash == content_hash
+        for k, v in ref.items():
+            assert np.array_equal(np.asarray(art.values[k]), v), (
+                f"torn read: field {k} differs from the pristine artifact"
+            )
+        ok += 1
+    return {"ok": ok, "refused": refused}
+
+
+def main() -> None:
+    role, contested_root, art_dir, content_hash, deadline = sys.argv[1:6]
+
+    from bdlz_tpu.provenance import Store
+
+    store = Store(contested_root)
+    run = {"churner": churner, "fetcher": fetcher}[role]
+    result = run(store, art_dir, content_hash, float(deadline))
+    print(json.dumps({"role": role, **result}))
+
+
+if __name__ == "__main__":
+    main()

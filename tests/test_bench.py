@@ -105,6 +105,10 @@ def test_bench_leg_cache_replays_cpu_round(tmp_path, jax_compile_cache):
         BDLZ_BENCH_SERVE_LAT_QUERIES="256",
         BDLZ_BENCH_CHAOS_SERVE_QUERIES="384",
         BDLZ_BENCH_CHAOS_SERVE_BATCH="16",
+        # tiny multi-tenant leg: three pools (coherent/chain/thermal)
+        # with the evict→degrade→readmit trace still run end to end
+        BDLZ_BENCH_MT_BATCH="8", BDLZ_BENCH_MT_TICKS="8",
+        BDLZ_BENCH_MT_NY="200", BDLZ_BENCH_MT_GRID="2",
         # tiny seam leg: the split/build/serve machinery still runs,
         # but no acceptance numbers are asserted on THIS test (replay
         # equality is)
@@ -178,6 +182,14 @@ def test_bench_cpu_smoke(jax_compile_cache):
         # choreography the acceptance asserts below pin
         BDLZ_BENCH_CHAOS_SERVE_QUERIES="384",
         BDLZ_BENCH_CHAOS_SERVE_BATCH="16",
+        # small serve_multitenant leg: three scenario pools, chain-pool
+        # replica faults + one forced eviction — the availability /
+        # bit-parity / eviction-choreography acceptance asserts below
+        # pin this exact line
+        BDLZ_BENCH_MT_BATCH="8",
+        BDLZ_BENCH_MT_TICKS="8",
+        BDLZ_BENCH_MT_NY="200",
+        BDLZ_BENCH_MT_GRID="2",
         # the seam_split leg at its ACCEPTANCE settings (rtol 1e-4,
         # full round budget): the >=10x fallback ratio and the <=1e-3
         # gated-agreement are asserted below on this exact line
@@ -250,6 +262,7 @@ def test_bench_cpu_smoke(jax_compile_cache):
             "seam_split_fallback_ratio",
             "serve_bench_queries_per_sec_per_chip",
             "chaos_serve_availability",
+            "serve_multitenant_availability",
             "grad_sweep_points_per_sec_per_chip",
             "nuts_ess_per_eval"} <= names
     # robustness schema: every sweep metric line carries the failure
@@ -261,6 +274,7 @@ def test_bench_cpu_smoke(jax_compile_cache):
                            "serve_bench_queries_per_sec_per_chip",
                            "seam_split_fallback_ratio",
                            "chaos_serve_availability",
+                           "serve_multitenant_availability",
                            "nuts_ess_per_eval"):
             continue  # query/serving/sampler metrics, not sweep lines
         assert {"n_failed", "n_quarantined", "n_retries"} <= set(s), s["metric"]
@@ -353,6 +367,7 @@ def test_bench_cpu_smoke(jax_compile_cache):
                            "serve_bench_queries_per_sec_per_chip",
                            "seam_split_fallback_ratio",
                            "chaos_serve_availability",
+                           "serve_multitenant_availability",
                            "nuts_ess_per_eval"):
             continue
         assert {"cache_hits", "cache_misses"} <= set(s), s["metric"]
@@ -488,6 +503,61 @@ def test_bench_cpu_smoke(jax_compile_cache):
         "breaker_reclosed": cs["breaker_reclosed"],
         "healed_batches": cs["healed_batches"],
         "bitwise_equal_unaffected": cs["bitwise_equal_unaffected"],
+    }
+    # the serve_multitenant line (docs/serving.md "Multi-tenant plane"):
+    # three scenario-routed artifact pools through the canned chaos
+    # trace — chain-pool replica faults healed in place, the coherent
+    # pool force-evicted mid-trace (its answers degrade LOUDLY to the
+    # exact path, never silently), then readmitted by hash — with
+    # every per-pool answer bit-identical to a single-tenant fleet
+    mt = next(s for s in secondary
+              if s["metric"] == "serve_multitenant_availability")
+    assert {"value", "n_requests", "n_pools", "scenarios", "qps_per_chip",
+            "per_pool", "shed_rate", "cold_admission_s", "readmit_s",
+            "degraded_answers", "evictions", "forced_evictions",
+            "admissions", "readmissions", "autoscale_passes", "resizes",
+            "replica_budget", "tenant_routing",
+            "bitwise_equal_unaffected", "fault_plan", "build_seconds",
+            "wall_seconds", "platform", "tpu_unavailable"} <= set(mt)
+    assert mt["value"] >= 0.99
+    assert mt["bitwise_equal_unaffected"] is True
+    assert mt["n_pools"] == 3
+    assert set(mt["scenarios"]) == {"coherent", "chain", "thermal"}
+    # the eviction choreography: exactly one forced eviction (the
+    # armed pool_evict fault), answered through the degraded exact
+    # path, then one cold readmission by content hash
+    assert mt["forced_evictions"] == 1
+    assert mt["evictions"] == 1
+    assert mt["degraded_answers"] > 0
+    assert mt["readmissions"] == 1
+    assert mt["readmit_s"] is not None
+    assert mt["admissions"] == 3           # one cold admission per pool
+    assert set(mt["cold_admission_s"]) == {"coherent", "chain", "thermal"}
+    assert all(v > 0 for v in mt["cold_admission_s"].values())
+    assert mt["autoscale_passes"] >= 1
+    assert mt["qps_per_chip"] > 0
+    assert {"site", "kind"} <= set(mt["fault_plan"][0])
+    for scn, p in mt["per_pool"].items():
+        assert len(p["artifact_hash"]) == 16
+        assert p["n_replicas"] >= 1
+        assert p["p50_latency_s"] is not None, scn
+        assert p["p99_latency_s"] is not None, scn
+        assert p["p99_latency_s"] >= p["p50_latency_s"], scn
+    assert mt["per_pool"]["chain"]["lz_mode"] == "chain"
+    assert mt["per_pool"]["thermal"]["lz_mode"] == "thermal"
+    # only the evicted pool served degraded answers; it was readmitted
+    # before the trace ended, so it is resident again at summary time
+    assert mt["per_pool"]["coherent"]["evicted"] is False
+    assert d["serve_multitenant"] == {
+        "value": mt["value"],
+        "qps_per_chip": mt["qps_per_chip"],
+        "shed_rate": mt["shed_rate"],
+        "cold_admission_s": mt["cold_admission_s"],
+        "readmit_s": mt["readmit_s"],
+        "degraded_answers": mt["degraded_answers"],
+        "forced_evictions": mt["forced_evictions"],
+        "autoscale_passes": mt["autoscale_passes"],
+        "bitwise_equal_unaffected": mt["bitwise_equal_unaffected"],
     }
     # the seam_split line (the PR's acceptance criteria, checked on the
     # line itself): on a deterministic seam-crossing trace the

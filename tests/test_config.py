@@ -395,6 +395,53 @@ class TestHealthPlaneKnobs:
         assert grid_hash(cfg, axes, 2000) == grid_hash(tuned, axes, 2000)
 
 
+class TestTenancyKnobs:
+    """The multi-tenant serving-plane knobs (serve/tenancy.py):
+    validated bounds + the SERVE_CONFIG_FIELDS exclusion — routing,
+    memory budgets and autoscale cadence pick WHICH pool/replica
+    answers and WHEN tables are resident, never what a kernel
+    computes, so tuning them stales nothing."""
+
+    def test_validation(self):
+        from bdlz_tpu.config import ConfigError, config_from_dict, validate
+
+        validate(config_from_dict({
+            "tenant_routing": "scenario", "memory_budget_bytes": 1 << 20,
+            "autoscale_interval_s": 0.5, "pool_min_replicas": 2,
+        }))
+        validate(config_from_dict({"tenant_routing": "hash"}))
+        validate(config_from_dict({}))  # null routing = engine decides
+        with pytest.raises(ConfigError, match="tenant_routing"):
+            validate(config_from_dict({"tenant_routing": "round_robin"}))
+        with pytest.raises(ConfigError, match="memory_budget_bytes"):
+            validate(config_from_dict({"memory_budget_bytes": 0}))
+        with pytest.raises(ConfigError, match="autoscale_interval_s"):
+            validate(config_from_dict({"autoscale_interval_s": 0.0}))
+        with pytest.raises(ConfigError, match="pool_min_replicas"):
+            validate(config_from_dict({"pool_min_replicas": 0}))
+
+    def test_excluded_from_every_identity(self):
+        from bdlz_tpu.config import (
+            SERVE_CONFIG_FIELDS,
+            config_from_dict,
+            config_identity_dict,
+        )
+        from bdlz_tpu.parallel.sweep import grid_hash
+
+        for k in ("tenant_routing", "memory_budget_bytes",
+                  "autoscale_interval_s", "pool_min_replicas"):
+            assert k in SERVE_CONFIG_FIELDS
+        base = {"P_chi_to_B": 0.149}
+        cfg = config_from_dict(base)
+        tuned = config_from_dict(dict(
+            base, tenant_routing="hash", memory_budget_bytes=1 << 24,
+            autoscale_interval_s=0.25, pool_min_replicas=3,
+        ))
+        assert config_identity_dict(tuned) == config_identity_dict(cfg)
+        axes = {"m_chi_GeV": [0.5, 1.0]}
+        assert grid_hash(cfg, axes, 2000) == grid_hash(tuned, axes, 2000)
+
+
 class TestEmulatorSeamKnobs:
     """The seam-split/error-gate/posterior-weight knobs: validated
     tri-states with DELIBERATE identity treatment — seam_split and

@@ -233,6 +233,20 @@ def test_health_plane_module_clean():
     assert not report.active, f"health-plane findings:\n{offenders}"
 
 
+def test_tenancy_module_clean():
+    """The multi-tenant plane (serve/tenancy.py) is pure host-side
+    orchestration — pool routing, the autoscaler's hysteresis on the
+    injectable clock, LRU eviction, cold admission by content hash —
+    that delegates every computation to the pool fleets: pinned
+    per-file at zero unsuppressed findings (STATIC_PARAM_NAMES
+    additions: tenant_map/tenant_routing/memory_budget_bytes/
+    autoscale_interval_s/pool_min_replicas/replica_budget)."""
+    report = lint_paths([str(PACKAGE / "serve" / "tenancy.py")])
+    assert report.files_scanned == 1
+    offenders = "\n".join(f.render() for f in report.active)
+    assert not report.active, f"tenancy findings:\n{offenders}"
+
+
 def test_seam_split_and_gating_modules_clean():
     """The seam-split plane: multidomain.py is host-side orchestration
     (band scan, sub-builds, bundle IO), grid.py gained the jitted

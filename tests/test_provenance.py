@@ -672,3 +672,54 @@ class TestRegistryAndRollout:
         rollout = ArtifactRollout(svc, store=store)
         staged = rollout.stage(h, warm=False)
         assert staged == h and rollout.staged_hash == h
+
+
+class TestRegistryRace:
+    """The real 2-process fetch-vs-evict race (ISSUE-14 satellite).
+
+    One contested store root, two OS processes: a churner that loops
+    publish -> corrupt-one-byte -> evict-on-fetch -> republish (the
+    same content hash), and a fetcher hammering ``fetch_artifact`` the
+    whole time.  The registry contract under churn: every fetch either
+    serves a FULLY VALIDATED artifact (table bytes identical to the
+    pristine copy — asserted in the worker) or raises typed — never a
+    torn read.  Real subprocesses + wall-clock churn, so slow-marked
+    like the other ``_mp`` siblings (tier-1 covers the single-process
+    corrupt-entry eviction above).
+    """
+
+    @pytest.mark.slow
+    def test_concurrent_fetch_during_evict_and_republish(
+        self, tmp_path, tiny_emulator
+    ):
+        import subprocess
+        import sys
+        import time
+
+        _, art_dir, art, _ = tiny_emulator
+        contested = str(tmp_path / "contested")
+        Store(contested)  # create + trust the shared root up front
+        worker = os.path.join(os.path.dirname(__file__),
+                              "_mp_registry_worker.py")
+        deadline = str(time.time() + 4.0)
+        procs = {
+            role: subprocess.Popen(
+                [sys.executable, worker, role, contested, str(art_dir),
+                 art.content_hash, deadline],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            )
+            for role in ("churner", "fetcher")
+        }
+        results = {}
+        for role, p in procs.items():
+            out, err = p.communicate(timeout=300)
+            assert p.returncode == 0, (
+                f"{role} violated the registry contract:\n{out}\n{err}"
+            )
+            results[role] = json.loads(out.strip().splitlines()[-1])
+        # the churn was real (entries were corrupted/evicted and
+        # republished under the fetcher's feet) AND validated fetches
+        # got through it
+        assert results["churner"]["published"] >= 2
+        assert results["churner"]["evicted"] >= 1
+        assert results["fetcher"]["ok"] >= 1

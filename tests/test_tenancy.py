@@ -1,0 +1,414 @@
+"""Multi-tenant scenario-routed serving plane (bdlz_tpu/serve/tenancy.py).
+
+Pins the ISSUE-14 acceptance contract: per-pool isolation (a saturated
+tenant sheds its OWN traffic — its neighbor's shed rate is untouched),
+autoscaler hysteresis (no replica flapping on an oscillating load
+trace, growth only on a sustained streak), the evict → degraded-exact →
+readmit-warm round trip with bit-identical pre/post-eviction answers,
+cross-scenario skew rejected loudly (a chain-tagged request can never
+be answered by a thermal pool), per-artifact answers bit-identical to a
+single-tenant fleet, and the close() contract (every pool's pending —
+and degraded-queued — futures fail with typed ServiceUnavailable on a
+fake clock, never park).
+"""
+import dataclasses
+
+import numpy as np
+import pytest
+
+from bdlz_tpu.config import config_from_dict, validate
+from bdlz_tpu.lz.profile import BounceProfile
+from bdlz_tpu.serve import (
+    MultiTenantService,
+    QueueFull,
+    REASON_POOL_EVICTED,
+    ServiceUnavailable,
+    TenancyError,
+)
+
+XI = np.linspace(-30.0, 30.0, 1001)
+PROF = BounceProfile(
+    xi=XI, delta=-0.08 * np.tanh(XI / 4.0), mix=np.full_like(XI, 0.02)
+)
+
+PHYS = {
+    "regime": "nonthermal",
+    "source_shape_sigma_y": 9.0,
+    "incident_flux_scale": 1.07e-9,
+    "Y_chi_init": 4.90e-10,
+}
+
+
+def _cfg(**kw):
+    return validate(config_from_dict({**PHYS, **kw}), backend="tpu")
+
+
+class _Tick:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+@pytest.fixture(scope="module")
+def tenant_plane(tmp_path_factory, jit_warmup):
+    """Two tiny published artifacts sharing one build base (coherent
+    two-channel + N=3 chain) and the store that serves them — the
+    minimal two-tenant world every test here routes through."""
+    from bdlz_tpu.emulator import AxisSpec, build_emulator
+    from bdlz_tpu.provenance import Store, publish_artifact
+
+    base = _cfg(P_chi_to_B=0.1)
+    base_chain = dataclasses.replace(base, lz_mode="chain", lz_n_levels=3)
+    spec = {
+        "m_chi_GeV": AxisSpec(0.9, 1.1, 2, "log"),
+        "v_w": AxisSpec(0.25, 0.35, 3, "lin"),
+    }
+    kw = dict(rtol=1e-2, n_probe=4, n_holdout=8, max_rounds=1, n_y=400,
+              chunk_size=64, require_converged=False)
+    root = tmp_path_factory.mktemp("tenancy")
+    art_coh, _ = build_emulator(
+        base, spec, out_dir=str(root / "coh"), **kw
+    )
+    art_chain, _ = build_emulator(
+        base_chain, spec, out_dir=str(root / "chain"), lz_profile=PROF, **kw
+    )
+    store = Store(str(root / "store"))
+    h_coh = publish_artifact(store, art_coh)
+    h_chain = publish_artifact(store, art_chain)
+    return {
+        "base": base,
+        "store": store,
+        "art_coh": art_coh,
+        "art_chain": art_chain,
+        "tenant_map": {"coherent": h_coh, "chain": h_chain},
+        "h_coh": h_coh,
+        "h_chain": h_chain,
+    }
+
+
+def _service(plane, clock=None, **kw):
+    kw.setdefault("max_batch_size", 4)
+    kw.setdefault("lz_profile", PROF)
+    return MultiTenantService(
+        plane["base"], tenant_map=plane["tenant_map"],
+        store=plane["store"], clock=clock or _Tick(), **kw
+    )
+
+
+def _thetas(n, seed=5):
+    rng = np.random.default_rng(seed)
+    return np.column_stack([
+        rng.uniform(0.92, 1.08, n), rng.uniform(0.26, 0.34, n)
+    ])
+
+
+# ---------------------------------------------------------------------------
+# routing + skew
+# ---------------------------------------------------------------------------
+
+class TestRoutingAndSkew:
+    def test_cross_scenario_skew_rejected_loudly(self, tenant_plane):
+        # a chain-tagged request can NEVER be answered by another
+        # scenario's pool: a stated mode that contradicts the routed
+        # pool is a typed refusal, not a silent wrong answer
+        svc = _service(tenant_plane)
+        try:
+            theta = _thetas(1)[0]
+            with pytest.raises(TenancyError, match="skew"):
+                svc.submit(theta, scenario="chain", lz_mode="thermal")
+            with pytest.raises(TenancyError, match="skew"):
+                svc.submit(theta, scenario="coherent", lz_mode="chain")
+            # mapping-style requests state the mode inside the point
+            with pytest.raises(ValueError, match="skew"):
+                svc.submit(
+                    {"m_chi_GeV": 1.0, "v_w": 0.3, "lz_mode": "thermal"},
+                    scenario="chain",
+                )
+        finally:
+            svc.close()
+
+    def test_mode_named_label_must_match_pool_mode(self, tenant_plane):
+        # a tenant map that routes the label "thermal" to a CHAIN
+        # artifact is cross-scenario skew at admission time
+        svc = MultiTenantService(
+            tenant_plane["base"],
+            tenant_map={"thermal": tenant_plane["h_chain"]},
+            store=tenant_plane["store"], max_batch_size=4, lz_profile=PROF,
+            clock=_Tick(),
+        )
+        try:
+            with pytest.raises(TenancyError, match="skew"):
+                svc.submit(_thetas(1)[0], scenario="thermal")
+        finally:
+            svc.close()
+
+    def test_routing_refusals_are_typed(self, tenant_plane):
+        svc = _service(tenant_plane)
+        try:
+            with pytest.raises(TenancyError, match="unknown scenario"):
+                svc.submit(_thetas(1)[0], scenario="nope")
+            with pytest.raises(TenancyError, match="scenario tag"):
+                svc.submit(_thetas(1)[0])  # scenario routing needs a tag
+            with pytest.raises(TenancyError, match="conflicting"):
+                svc.submit(_thetas(1)[0], scenario="chain",
+                           artifact_hash=tenant_plane["h_coh"])
+        finally:
+            svc.close()
+
+    def test_tenant_map_and_store_validated(self, tenant_plane,
+                                            monkeypatch):
+        with pytest.raises(TenancyError, match="16-hex"):
+            MultiTenantService(
+                tenant_plane["base"], tenant_map={"a": "not-a-hash"},
+                store=tenant_plane["store"],
+            )
+        monkeypatch.delenv("BDLZ_CACHE_ROOT", raising=False)
+        with pytest.raises(TenancyError, match="store"):
+            MultiTenantService(
+                tenant_plane["base"],
+                tenant_map=tenant_plane["tenant_map"], store=None,
+            )
+
+
+# ---------------------------------------------------------------------------
+# bit-identity + isolation
+# ---------------------------------------------------------------------------
+
+class TestPoolIsolation:
+    def test_answers_bitwise_equal_single_tenant_fleet(self, tenant_plane):
+        # the tentpole guarantee: routing through the multi-tenant plane
+        # never buys a different answer than a dedicated fleet
+        from bdlz_tpu.serve import FleetService
+
+        thetas = _thetas(12)
+        svc = _service(tenant_plane)
+        try:
+            futs = [
+                (scn, svc.submit(t, scenario=scn))
+                for t in thetas for scn in ("coherent", "chain")
+            ]
+            svc.drain()
+            got = {
+                scn: [f.result().value for s, f in futs if s == scn]
+                for scn in ("coherent", "chain")
+            }
+            hashes = {
+                f.result().artifact_hash for s, f in futs if s == "chain"
+            }
+            assert hashes == {tenant_plane["h_chain"]}
+        finally:
+            svc.close()
+        for scn, art, prof in (
+            ("coherent", tenant_plane["art_coh"], None),
+            ("chain", tenant_plane["art_chain"], PROF),
+        ):
+            base = dataclasses.replace(
+                tenant_plane["base"],
+                **({"lz_mode": "chain", "lz_n_levels": 3}
+                   if scn == "chain" else {}),
+            )
+            ref = FleetService(art, base, max_batch_size=4,
+                               lz_profile=prof)
+            rfuts = [ref.submit(t) for t in thetas]
+            ref.drain()
+            assert got[scn] == [f.result().value for f in rfuts]
+            ref.close()
+
+    def test_saturated_tenant_sheds_alone(self, tenant_plane):
+        # tenant A (coherent) saturated at its own admission bound;
+        # tenant B (chain) keeps its zero shed rate — isolation is the
+        # whole point of per-pool queues
+        tick = _Tick()
+        svc = _service(tenant_plane, clock=tick, queue_bound=4)
+        try:
+            thetas = _thetas(16)
+            rejected = 0
+            for t in thetas:
+                try:
+                    svc.submit(t, scenario="coherent")
+                except QueueFull:
+                    rejected += 1
+            assert rejected > 0
+            for t in thetas[:4]:
+                svc.submit(t, scenario="chain")
+            svc.drain()
+            pools = svc.summary()["pools"]
+            coh = pools[tenant_plane["h_coh"]]
+            chn = pools[tenant_plane["h_chain"]]
+            assert coh["admission_rejects"] == rejected
+            assert coh["shed_rate"] > 0.0
+            assert chn["admission_rejects"] == 0
+            assert chn["shed_rate"] == 0.0
+        finally:
+            svc.close()
+
+
+# ---------------------------------------------------------------------------
+# eviction + degraded + readmit
+# ---------------------------------------------------------------------------
+
+class TestEvictReadmit:
+    def test_evict_degraded_readmit_bitwise_round_trip(self, tenant_plane):
+        tick = _Tick()
+        plan = ('{"faults": [{"site": "pool_evict", "kind": "raise", '
+                '"key": 0}]}')
+        svc = _service(tenant_plane, clock=tick, fault_plan=plan)
+        try:
+            thetas = _thetas(8)
+            pre = [svc.submit(t, scenario="coherent") for t in thetas]
+            svc.drain()
+            pre_vals = [f.result().value for f in pre]
+            svc.run_once()  # pool idle -> the forced eviction fires
+            pool = svc.pool("coherent")
+            assert pool.evicted and svc.forced_evictions == 1
+            assert pool.resident_bytes == 0
+
+            # evicted-pool requests answer through the LOUD degraded
+            # exact path — correct and slow, never an error
+            deg = [svc.submit(t, scenario="coherent") for t in thetas]
+            svc.drain()
+            for f in deg:
+                r = f.result()
+                assert r.degraded is True
+                assert r.fallback_reason == REASON_POOL_EVICTED
+                assert r.replica == -1
+                assert np.isfinite(r.value)
+
+            # readmit re-fetches/warms/probes through cold admission;
+            # the answers come back bit-identical to pre-eviction
+            svc.readmit("coherent")
+            assert not pool.evicted
+            post = [svc.submit(t, scenario="coherent") for t in thetas]
+            svc.drain()
+            assert [f.result().value for f in post] == pre_vals
+            ev = svc.admission_events
+            assert [e["readmit"] for e in ev].count(True) == 1
+            assert svc.summary()["readmissions"] == 1
+        finally:
+            svc.close()
+
+    def test_memory_budget_evicts_lru_idle_pool(self, tenant_plane):
+        tick = _Tick()
+        svc = _service(tenant_plane, clock=tick)
+        try:
+            thetas = _thetas(4)
+            a = [svc.submit(t, scenario="coherent") for t in thetas]
+            tick.t += 1.0
+            b = [svc.submit(t, scenario="chain") for t in thetas]
+            svc.drain()
+            for f in a + b:
+                f.result()
+            # budget that fits exactly one pool: the LRU (coherent)
+            # pool is the victim on the next tick, the hot one stays
+            svc.memory_budget_bytes = svc.pool("chain").resident_bytes
+            svc.run_once()
+            assert svc.pool("coherent").evicted
+            assert not svc.pool("chain").evicted
+            assert svc.evictions == 1 and svc.forced_evictions == 0
+        finally:
+            svc.close()
+
+
+# ---------------------------------------------------------------------------
+# autoscaler hysteresis
+# ---------------------------------------------------------------------------
+
+class TestAutoscaler:
+    def _pump_full_batch(self, svc, tick, scenario="coherent"):
+        for t in _thetas(4):
+            svc.submit(t, scenario=scenario)
+        svc.run_once()
+        svc.poll(block=True)
+        # advance past the interval, then an idle tick so the pass
+        # runs with nothing in flight (resizes need a quiesced pool)
+        tick.t += 1.0
+        svc.run_once()
+
+    def test_sustained_load_grows_once_no_flapping_on_oscillation(
+        self, tenant_plane
+    ):
+        tick = _Tick()
+        svc = _service(tenant_plane, clock=tick, autoscale_interval_s=1.0,
+                       n_replicas=1)
+        try:
+            # oscillating load: full batch, silence, full batch, ... —
+            # every pass resets the opposite streak, so NO resize ever
+            # happens (flapping is exactly what hysteresis forbids)
+            for _ in range(4):
+                self._pump_full_batch(svc, tick)   # occupancy-1.0 pass
+                tick.t += 1.0
+                svc.run_once()                     # empty (cold) pass
+            assert svc.summary()["resizes"] == 0
+            assert svc.pool("coherent").n_replicas == 1
+            assert svc.summary()["autoscale_passes"] >= 8
+
+            # sustained hot streak: UP_PASSES consecutive full-batch
+            # passes grow the pool exactly once
+            self._pump_full_batch(svc, tick)
+            self._pump_full_batch(svc, tick)
+            assert svc.pool("coherent").n_replicas == 2
+            assert svc.summary()["resizes"] == 1
+        finally:
+            svc.close()
+
+    def test_autoscale_fault_skips_pass(self, tenant_plane):
+        tick = _Tick()
+        plan = ('{"faults": [{"site": "autoscale", "kind": "raise", '
+                '"key": 0}]}')
+        svc = _service(tenant_plane, clock=tick, autoscale_interval_s=1.0,
+                       fault_plan=plan, n_replicas=1)
+        try:
+            self._pump_full_batch(svc, tick)
+            self._pump_full_batch(svc, tick)
+            self._pump_full_batch(svc, tick)
+            s = svc.summary()
+            assert s["autoscale_skipped"] == 1
+            assert s["autoscale_passes"] >= 2
+        finally:
+            svc.close()
+
+
+# ---------------------------------------------------------------------------
+# close() contract (satellite: serve_cli/fleet close semantics)
+# ---------------------------------------------------------------------------
+
+class TestClose:
+    def test_close_fails_pending_and_degraded_futures_typed(
+        self, tenant_plane
+    ):
+        # fake clock: nothing ages out, nothing dispatches (batch of 4
+        # never fills) — the requests are provably still pending when
+        # close() runs, and every future must fail TYPED, never park
+        tick = _Tick()
+        plan = ('{"faults": [{"site": "pool_evict", "kind": "raise", '
+                '"key": 0}]}')
+        svc = _service(tenant_plane, clock=tick, fault_plan=plan)
+        try:
+            warm = [svc.submit(t, scenario="coherent") for t in _thetas(4)]
+            svc.drain()
+            for f in warm:
+                f.result()
+            svc.run_once()  # idle -> forced eviction
+            assert svc.pool("coherent").evicted
+            pend = [svc.submit(t, scenario="chain") for t in _thetas(2)]
+            deg = [svc.submit(t, scenario="coherent") for t in _thetas(2)]
+        finally:
+            n = svc.close()
+        assert n == 4
+        for f in pend + deg:
+            with pytest.raises(ServiceUnavailable):
+                f.result(timeout=0)
+        with pytest.raises(ServiceUnavailable):
+            svc.submit(_thetas(1)[0], scenario="chain")
+        assert svc.close() == 0  # idempotent
+
+    def test_replica_budget_refusal_is_typed(self, tenant_plane):
+        svc = _service(tenant_plane, n_replicas=1, replica_budget=1)
+        try:
+            svc.submit(_thetas(1)[0], scenario="coherent")
+            with pytest.raises(TenancyError, match="replica budget"):
+                svc.submit(_thetas(1)[0], scenario="chain")
+        finally:
+            svc.close()
