@@ -1,0 +1,39 @@
+"""In-framework O(4) bounce solver: potential → profile → P → yields.
+
+Closes the loop the reference snapshot leaves open (PAPER.md §0: its
+`transport_from_profile.py` is absent): instead of ingesting an
+externally supplied bounce-profile CSV, a validated quartic
+:class:`PotentialSpec` is shot through the radial bubble ODE
+φ'' + (3/ρ)φ' = V′(φ) (overshoot/undershoot bisection on the release
+point, reusing the batched ESDIRK machinery), the wall profile is
+extracted as the `lz/profile.py` :class:`BounceProfile` type, and the
+derived P flows through the existing two-channel/chain/thermal kernels
+unchanged — potential-space becomes a sweepable, emulatable, servable
+axis set (docs/scenarios.md "Potential-space axes").
+"""
+from bdlz_tpu.bounce.potential import (  # noqa: F401
+    PotentialError,
+    PotentialSpec,
+    as_potential_spec,
+    load_potential_json,
+    potential_V,
+    potential_dV,
+    potential_fingerprint,
+    reference_potential,
+    thin_wall_action,
+    thin_wall_radius,
+    validate_potential,
+    vacua,
+    wall_tension,
+    wall_width_mu,
+    write_potential_json,
+)
+from bdlz_tpu.bounce.shooting import (  # noqa: F401
+    BounceSolution,
+    BounceSolveError,
+    bounce_probabilities,
+    bounce_profile,
+    solve_bounce,
+    solve_bounce_batch,
+    solve_bounce_scalar_loop,
+)

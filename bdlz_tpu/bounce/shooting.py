@@ -1,0 +1,402 @@
+"""Batched O(4) bounce shooting: release-point bisection over ESDIRK.
+
+The radial bubble ODE (Euclidean O(4), paper Appendix A)
+
+    φ''(ρ) + (3/ρ)·φ'(ρ) = V′(φ),   φ'(0) = 0,  φ(∞) = φ_false
+
+is solved by the classic overshoot/undershoot construction: a release
+point φ₀ near the true vacuum either overshoots past φ_false (too much
+energy) or turns back (friction won) — bisection on φ₀ converges to the
+bounce.  Everything decision-making is expressed in ``lax`` primitives:
+
+* each classification integrates a ladder of fixed segments through
+  ``solvers.sdirk.esdirk_solve`` (the repo's batched ESDIRK machinery)
+  inside a ``lax.while_loop`` that stops at the first overshoot /
+  undershoot verdict;
+* the bisection itself is a ``lax.fori_loop`` (fixed ``n_bisect``
+  float64 halvings — the thin-wall release offset is ~e^(−μR) and needs
+  the full mantissa);
+* the converged release point is densified by a fixed-grid RK4
+  ``lax.scan`` that ALSO accumulates the Euclidean action
+  S₄ = 2π²∫ρ³[½φ'² + V − V(φ_false)]dρ sequentially in the carry —
+  a jnp.sum over the collected grid could reorder under vmap, and the
+  vmapped-batch vs scalar-loop bitwise-parity contract (the PR-2
+  pattern, pinned in tests/test_bounce.py) forbids that.
+
+One compiled program therefore solves a whole BATCH of potentials under
+``jax.vmap`` (``solve_bounce_batch``) bit-identically to the scalar
+loop (``solve_bounce_scalar_loop``) — the A/B the ``bounce_sweep``
+bench leg reports.
+
+Bit-parity is engineered the way the repacked ESDIRK engine does it
+(``solvers/batching.py``'s fixed-width lane programs): XLA fuses a
+``vmap`` differently per BATCH SHAPE, and a one-ulp shift in a segment
+endpoint flips a bisection verdict, so the same spec shot at batch
+sizes 1 and 3 would differ in the last mantissa bits.  Instead ONE
+program is compiled per ``lane_width`` and every call pads its chunk to
+that width with copies of the chunk's first spec — lanes are provably
+value-independent of their co-lanes (while_loop batching freezes
+finished lanes by select; nothing reduces across the batch axis), so
+padding never perturbs a real lane and batch-vs-loop parity is exact
+(pinned in tests/test_bounce.py).
+
+Host-side work (vacuum Newton, profile interpolation onto the wall
+window) stays in numpy: spec plumbing and profile IO are not hot paths.
+"""
+from __future__ import annotations
+
+from functools import lru_cache
+from typing import NamedTuple, Sequence, Union
+
+import numpy as np  # host-side use only; jitted paths go through the backend.py xp seam (bdlz-lint R1 audit)
+
+from bdlz_tpu.bounce.potential import (
+    PotentialSpec,
+    as_potential_spec,
+    potential_V,
+    potential_dV,
+    vacua,
+    wall_width_mu,
+)
+from bdlz_tpu.lz.profile import BounceProfile
+
+# -- solver knobs (structural: fixed loop/grid shapes at trace time) --------
+DEFAULT_RHO0 = 1e-2          # series-IC start (regularizes the 3/ρ term)
+DEFAULT_RHO_MAX = 80.0       # far edge of the integration domain
+DEFAULT_N_SEGMENTS = 80      # classification ladder segments
+DEFAULT_N_BISECT = 60        # float64 release-point halvings
+DEFAULT_N_DENSE = 4096       # RK4 densification steps
+DEFAULT_N_XI = 801           # profile samples across the wall window
+DEFAULT_XI_HALFWIDTH_WALLS = 8.0  # window half-width in wall widths (1/μ)
+DEFAULT_LANE_WIDTH = 8       # fixed vmap width of the compiled program
+
+# -- classification tolerances ----------------------------------------------
+#: overshoot: φ dips below φ_false by this fraction of Δφ = φ_true−φ_false
+_OVERSHOOT_FRAC = 1e-6
+#: undershoot: φ' turns positive past this absolute floor (rejects
+#: rounding noise at release, where |φ'| is exponentially small)
+_UNDERSHOOT_V_TOL = 1e-14
+#: dense pass freezes the state onto φ_false once within this fraction of
+#: Δφ — past the wall the shot trajectory deviates exponentially (the
+#: release point is only f64-exact), and freezing zeroes the integrand
+#: instead of letting the deviation pollute the action tail
+_SETTLE_FRAC = 1e-4
+#: bisection upper bracket: φ_true − Δφ·this (exactly φ_true never rolls)
+_HI_OFFSET_FRAC = 1e-13
+
+
+class BounceSolution(NamedTuple):
+    """One solved bounce (host-side numpy views of the device results)."""
+
+    phi0: np.ndarray       # converged release point
+    r_wall: np.ndarray     # wall radius: φ(r_wall) = φ_mid
+    action: np.ndarray     # Euclidean action S₄ of the shot trajectory
+    converged: np.ndarray  # every ESDIRK segment succeeded + wall located
+    rho: np.ndarray        # dense radial grid (n_dense+1,)
+    phi: np.ndarray        # φ(ρ) on the dense grid
+    dphi: np.ndarray       # φ'(ρ) on the dense grid
+
+
+class BounceSolveError(RuntimeError):
+    """Raised when a shoot cannot produce a usable profile."""
+
+
+@lru_cache(maxsize=None)
+def _bounce_program(rho0, rho_max, n_segments, n_bisect, n_dense, lane_width):
+    """The fixed-width jitted vmapped program: (W, 6) params → arrays.
+
+    ``params`` rows are the 6-vector (λ₄, v, ε, φ_false, φ_top, φ_true);
+    the vacua are Newton-solved host-side once per spec and enter as
+    traced values so the compiled program is knob-shaped only.  Cached
+    per (knobs, lane_width) tuple — every call at the same knobs reuses
+    ONE compiled program regardless of how many specs it carries (the
+    fixed-lane-width pattern of ``solvers/batching.py``; callers pad).
+    """
+    # jax_numpy() probes the accelerator relay before the first backend
+    # touch — a direct jax import here could hang forever on a dead
+    # relay (documented environment failure mode)
+    from bdlz_tpu.backend import jax_numpy
+
+    jnp = jax_numpy()
+    import jax
+
+    from bdlz_tpu.solvers.sdirk import esdirk_solve
+
+    h_seg = (rho_max - rho0) / n_segments
+    h_dense = (rho_max - rho0) / n_dense
+
+    def solve_one(params):
+        lam4, vev, eps, phi_false, phi_top, phi_true = (
+            params[0], params[1], params[2], params[3], params[4], params[5]
+        )
+        delta_phi = phi_true - phi_false
+        phi_mid = 0.5 * (phi_true + phi_false)
+        v_false = potential_V(phi_false, lam4, vev, eps)
+
+        def rhs(rho, y):
+            return jnp.stack(
+                [y[1], potential_dV(y[0], lam4, vev, eps) - 3.0 * y[1] / rho]
+            )
+
+        def series_ic(phi0):
+            # φ(ρ) = φ₀ + V′(φ₀)ρ²/8 + O(ρ⁴) near the regular origin of
+            # the 3/ρ friction term; evaluated at ρ₀
+            dv0 = potential_dV(phi0, lam4, vev, eps)
+            return jnp.stack(
+                [phi0 + 0.125 * dv0 * rho0 * rho0, 0.25 * dv0 * rho0]
+            )
+
+        def classify(phi0):
+            """+1 overshoot / −1 undershoot at segment granularity."""
+
+            def cond(s):
+                k, _y, verdict, _ok = s
+                return jnp.logical_and(verdict == 0, k < n_segments)
+
+            def body(s):
+                k, y, _verdict, ok = s
+                a = rho0 + h_seg * k
+                sol = esdirk_solve(
+                    rhs, a, a + h_seg, y, auto_h0=True
+                )
+                y2 = sol.y
+                over = y2[0] < phi_false - _OVERSHOOT_FRAC * delta_phi
+                under = y2[1] > _UNDERSHOOT_V_TOL
+                verdict = jnp.where(
+                    over, jnp.int64(1), jnp.where(under, jnp.int64(-1), jnp.int64(0))
+                )
+                return k + 1, y2, verdict, jnp.logical_and(ok, sol.success)
+
+            k0 = jnp.float64(0.0)
+            state = (k0, series_ic(phi0), jnp.int64(0), jnp.asarray(True))
+            _k, _y, verdict, ok = jax.lax.while_loop(cond, body, state)
+            # never resolved by rho_max → friction won: undershoot
+            verdict = jnp.where(verdict == 0, jnp.int64(-1), verdict)
+            return verdict, ok
+
+        def bisect_body(_i, s):
+            lo, hi, ok = s
+            mid = 0.5 * (lo + hi)
+            verdict, ok_i = classify(mid)
+            lo2 = jnp.where(verdict < 0, mid, lo)
+            hi2 = jnp.where(verdict < 0, hi, mid)
+            return lo2, hi2, jnp.logical_and(ok, ok_i)
+
+        lo0 = phi_top                                  # guaranteed undershoot
+        hi0 = phi_true - _HI_OFFSET_FRAC * delta_phi   # rolls off, overshoots
+        lo, _hi, ok = jax.lax.fori_loop(
+            0, n_bisect, bisect_body, (lo0, hi0, jnp.asarray(True))
+        )
+        phi0 = lo  # undershoot side: trajectory stays bounded to rho_max
+
+        # -- dense pass: fixed-grid RK4 + sequential trapezoid action ------
+        def integrand(rho, y):
+            return rho**3 * (
+                0.5 * y[1] * y[1] + potential_V(y[0], lam4, vev, eps) - v_false
+            )
+
+        def dense_step(carry, k):
+            y, s_acc, f_prev = carry
+            rho = rho0 + h_dense * k
+            k1 = rhs(rho, y)
+            k2 = rhs(rho + 0.5 * h_dense, y + 0.5 * h_dense * k1)
+            k3 = rhs(rho + 0.5 * h_dense, y + 0.5 * h_dense * k2)
+            k4 = rhs(rho + h_dense, y + h_dense * k3)
+            y2 = y + (h_dense / 6.0) * (k1 + 2.0 * k2 + 2.0 * k3 + k4)
+            settled = y2[0] < phi_false + _SETTLE_FRAC * delta_phi
+            y2 = jnp.where(
+                settled, jnp.stack([phi_false, 0.0 * phi_false]), y2
+            )
+            f_new = integrand(rho + h_dense, y2)
+            s2 = s_acc + 0.5 * (f_prev + f_new) * h_dense
+            return (y2, s2, f_new), (y2[0], y2[1])
+
+        y_init = series_ic(phi0)
+        f0 = integrand(jnp.float64(rho0), y_init)
+        (_yf, s_acc, _fl), (phis, dphis) = jax.lax.scan(
+            dense_step,
+            (y_init, jnp.float64(0.0), f0),
+            jnp.arange(n_dense, dtype=jnp.float64),
+        )
+        two_pi_sq = 2.0 * jnp.pi**2
+        action = two_pi_sq * s_acc
+        phis = jnp.concatenate([y_init[0][None], phis])
+        dphis = jnp.concatenate([y_init[1][None], dphis])
+        rho_grid = rho0 + h_dense * jnp.arange(n_dense + 1, dtype=jnp.float64)
+
+        # wall radius: first dense sample at/below φ_mid, linear interp
+        below = phis <= phi_mid
+        idx = jnp.argmax(below)
+        crossed = jnp.logical_and(below[idx], idx > 0)
+        i0 = jnp.maximum(idx - 1, 0)
+        p0, p1 = phis[i0], phis[i0 + 1]
+        denom = jnp.where(p1 == p0, jnp.float64(1.0), p1 - p0)
+        frac = (phi_mid - p0) / denom
+        r_wall = jnp.where(
+            crossed, rho_grid[i0] + frac * h_dense, jnp.float64(np.nan)
+        )
+        converged = jnp.logical_and(
+            jnp.logical_and(ok, crossed),
+            jnp.logical_and(
+                jnp.isfinite(action), jnp.all(jnp.isfinite(phis))
+            ),
+        )
+        return phi0, r_wall, action, converged, rho_grid, phis, dphis
+
+    return jax.jit(jax.vmap(solve_one))
+
+
+def _params_row(spec: PotentialSpec) -> np.ndarray:
+    spec = as_potential_spec(spec)
+    phi_false, phi_top, phi_true = vacua(spec)
+    return np.asarray(
+        [spec.lam4, spec.vev, spec.eps, phi_false, phi_top, phi_true],
+        dtype=np.float64,
+    )
+
+
+def _knob_tuple(rho0, rho_max, n_segments, n_bisect, n_dense, lane_width):
+    if int(lane_width) < 1:
+        raise BounceSolveError(f"lane_width must be >= 1, got {lane_width}")
+    return (
+        float(rho0), float(rho_max), int(n_segments), int(n_bisect),
+        int(n_dense), int(lane_width),
+    )
+
+
+def _run_rows(rows: np.ndarray, knobs: tuple) -> "list[np.ndarray]":
+    """Run rows through the fixed-width program in padded chunks.
+
+    The pad lanes copy the chunk's FIRST row: always a valid spec, and
+    provably inert — lanes are value-independent of co-lanes, so the
+    sliced-off pads cannot perturb a real lane's bits.
+    """
+    program = _bounce_program(*knobs)
+    width = knobs[-1]
+    outs: "list[list[np.ndarray]]" = []
+    for start in range(0, rows.shape[0], width):
+        chunk = rows[start:start + width]
+        n_real = chunk.shape[0]
+        if n_real < width:
+            pad = np.repeat(chunk[:1], width - n_real, axis=0)
+            chunk = np.concatenate([chunk, pad], axis=0)
+        out = program(chunk)
+        outs.append([np.asarray(a)[:n_real] for a in out])
+    return [np.concatenate(parts, axis=0) for parts in zip(*outs)]
+
+
+def solve_bounce(
+    spec: Union[PotentialSpec, str, dict],
+    rho0: float = DEFAULT_RHO0,
+    rho_max: float = DEFAULT_RHO_MAX,
+    n_segments: int = DEFAULT_N_SEGMENTS,
+    n_bisect: int = DEFAULT_N_BISECT,
+    n_dense: int = DEFAULT_N_DENSE,
+    lane_width: int = DEFAULT_LANE_WIDTH,
+) -> BounceSolution:
+    """Shoot one potential (one real lane of the fixed-width program)."""
+    knobs = _knob_tuple(rho0, rho_max, n_segments, n_bisect, n_dense, lane_width)
+    out = _run_rows(_params_row(spec)[None, :], knobs)
+    return BounceSolution(*(np.asarray(a)[0] for a in out))
+
+
+def solve_bounce_batch(
+    specs: Sequence[Union[PotentialSpec, str, dict]],
+    rho0: float = DEFAULT_RHO0,
+    rho_max: float = DEFAULT_RHO_MAX,
+    n_segments: int = DEFAULT_N_SEGMENTS,
+    n_bisect: int = DEFAULT_N_BISECT,
+    n_dense: int = DEFAULT_N_DENSE,
+    lane_width: int = DEFAULT_LANE_WIDTH,
+) -> BounceSolution:
+    """Shoot a whole batch of potentials through full vmap lanes.
+
+    Returns a :class:`BounceSolution` whose fields carry a leading batch
+    axis; bitwise-identical per lane to :func:`solve_bounce_scalar_loop`
+    (pinned in tests — the fixed-lane-width parity contract).
+    """
+    if len(specs) == 0:
+        raise BounceSolveError("solve_bounce_batch needs at least one spec")
+    knobs = _knob_tuple(rho0, rho_max, n_segments, n_bisect, n_dense, lane_width)
+    rows = np.stack([_params_row(s) for s in specs])
+    return BounceSolution(*_run_rows(rows, knobs))
+
+
+def solve_bounce_scalar_loop(
+    specs: Sequence[Union[PotentialSpec, str, dict]],
+    rho0: float = DEFAULT_RHO0,
+    rho_max: float = DEFAULT_RHO_MAX,
+    n_segments: int = DEFAULT_N_SEGMENTS,
+    n_bisect: int = DEFAULT_N_BISECT,
+    n_dense: int = DEFAULT_N_DENSE,
+    lane_width: int = DEFAULT_LANE_WIDTH,
+) -> BounceSolution:
+    """Host loop driving the SAME program one spec at a time — the A/B
+    baseline the ``bounce_sweep`` bench leg times against the batched
+    path (a loop pays the full lane width per spec; the batch fills it)."""
+    sols = [
+        solve_bounce(s, rho0=rho0, rho_max=rho_max, n_segments=n_segments,
+                     n_bisect=n_bisect, n_dense=n_dense, lane_width=lane_width)
+        for s in specs
+    ]
+    return BounceSolution(*(np.stack(f) for f in zip(*sols)))
+
+
+def bounce_profile(
+    spec: Union[PotentialSpec, str, dict],
+    n_xi: int = DEFAULT_N_XI,
+    xi_halfwidth_walls: float = DEFAULT_XI_HALFWIDTH_WALLS,
+    solution: "BounceSolution | None" = None,
+    **solver_knobs,
+) -> BounceProfile:
+    """Derive the two-channel LZ profile from a potential spec.
+
+    The wall window is ξ ∈ ±(``xi_halfwidth_walls``/μ) around the solved
+    wall radius, sampled uniformly at ``n_xi`` points; Δ(ξ) =
+    g_Δ·(φ(ξ) − φ_mid) crosses zero exactly once at the wall and
+    m_mix(ξ) = m₀ is constant — the spec's fingerprint plus this
+    profile's own array fingerprint both join every downstream identity.
+    """
+    spec = as_potential_spec(spec)
+    sol = solution if solution is not None else solve_bounce(spec, **solver_knobs)
+    if sol.phi0.ndim != 0:
+        raise BounceSolveError(
+            "bounce_profile expects a single solved spec (got a batched solution)"
+        )
+    if not bool(sol.converged):
+        raise BounceSolveError(
+            f"bounce shoot did not converge for {spec} "
+            f"(phi0={float(sol.phi0)!r}, action={float(sol.action)!r}); "
+            f"widen rho_max or revisit the spec"
+        )
+    if n_xi < 2:
+        raise BounceSolveError(f"n_xi must be >= 2, got {n_xi}")
+    mu = wall_width_mu(spec)
+    half = float(xi_halfwidth_walls) / mu
+    r_wall = float(sol.r_wall)
+    if r_wall - half < float(sol.rho[0]) or r_wall + half > float(sol.rho[-1]):
+        raise BounceSolveError(
+            f"wall window ±{half:.3g} around r_wall={r_wall:.3g} escapes the "
+            f"solved domain [{float(sol.rho[0]):.3g}, {float(sol.rho[-1]):.3g}]; "
+            f"increase rho_max or reduce xi_halfwidth_walls"
+        )
+    phi_false, _phi_top, phi_true = vacua(spec)
+    phi_mid = 0.5 * (phi_true + phi_false)
+    xi = np.linspace(-half, half, int(n_xi))
+    phi = np.interp(xi + r_wall, sol.rho, sol.phi)
+    delta = spec.g_delta * (phi - phi_mid)
+    mix = np.full_like(xi, spec.m_mix0)
+    return BounceProfile(xi=xi, delta=delta, mix=mix)
+
+
+def bounce_probabilities(
+    spec: Union[PotentialSpec, str, dict],
+    v_w,
+    method: str = "local",
+    **profile_knobs,
+) -> np.ndarray:
+    """Potential → profile → P(v_w): the closed loop, in one call."""
+    from bdlz_tpu.lz.sweep_bridge import probabilities_for_points
+
+    profile = bounce_profile(spec, **profile_knobs)
+    return probabilities_for_points(profile, v_w, method=method)

@@ -114,6 +114,7 @@ def build_identity(
     posterior_weight: "str | None" = None,
     lz_profile_fp: "str | None" = None,
     refine_signal: "str | None" = None,
+    bounce_fp: "str | None" = None,
 ) -> Dict[str, Any]:
     """The physics identity an artifact is valid for.
 
@@ -152,6 +153,11 @@ def build_identity(
     (the bounce-profile fingerprint the per-point P was derived from)
     is its own ``lz_profile`` key with the posterior_weight wildcard
     rule: strict when the caller states a profile, wildcard when not.
+    ``bounce_fp`` (the POTENTIAL fingerprint when the profile was shot
+    in-framework from a :class:`~bdlz_tpu.bounce.PotentialSpec` rather
+    than loaded from a CSV) joins the same way as its own ``bounce``
+    key — wildcard-when-unstated, so profile-fed artifacts keep their
+    hashes, but two potentials can never share a surface.
     """
     from bdlz_tpu.config import (
         ROBUSTNESS_STATIC_FIELDS,
@@ -195,6 +201,8 @@ def build_identity(
         out["lz_scenario"] = scen
     if lz_profile_fp is not None:
         out["lz_profile"] = str(lz_profile_fp)
+    if bounce_fp is not None:
+        out["bounce"] = str(bounce_fp)
     return out
 
 
@@ -478,7 +486,8 @@ def check_identity(
     the same rule: strict when the caller names a weighting, wildcard
     when their knob is unset (weighting moves nodes, never what the
     exact engine computes at them — the fallback path is unaffected),
-    and ``lz_profile`` (the scenario bounce-profile fingerprint) too.
+    and ``lz_profile`` (the scenario bounce-profile fingerprint) and
+    ``bounce`` (the in-framework potential fingerprint) too.
     The ``lz_scenario`` key is deliberately STRICT both ways: a chain
     or thermal surface served to a two-channel consumer (or vice
     versa) is cross-mode skew and must reject loudly — there is no
@@ -498,6 +507,12 @@ def check_identity(
         stored.pop("refine_signal", None)
     if "lz_profile" not in want:
         stored.pop("lz_profile", None)
+    if "bounce" not in want:
+        # wildcard like lz_profile: the potential fingerprint names the
+        # SOURCE of the derived profile; a caller that states no
+        # potential matches either, while stating one pins it strictly
+        # (cross-potential artifact/consumer skew must reject loudly)
+        stored.pop("bounce", None)
     sb = dict(stored.get("base", {}))
     wb = dict(want.get("base", {}))
     for key in set(exempt_config_keys) | set(artifact.axis_names):

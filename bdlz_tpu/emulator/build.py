@@ -790,6 +790,7 @@ def build_emulator(
     posterior_weight: Optional[str] = None,
     refine_signal: Optional[str] = None,
     lz_profile=None,
+    bounce=None,
     elastic=None,
 ) -> Tuple[EmulatorArtifact, BuildReport]:
     """Build (and optionally save) an error-controlled yield-surface emulator.
@@ -851,6 +852,14 @@ def build_emulator(
     tabulated-impl only, loudly — a scenario mode derives P host-side
     (no in-graph gradient) and the stiff/direct engines never evaluate
     through the differentiable closure this signal uses.
+
+    ``bounce`` (a :class:`~bdlz_tpu.bounce.PotentialSpec` / mapping /
+    JSON path; scenario modes only, mutually exclusive with
+    ``lz_profile``) shoots the wall profile in-framework from the
+    potential instead of loading a CSV; the potential fingerprint joins
+    the artifact identity as its own ``bounce`` key (wildcard-when-
+    unstated, like ``lz_profile``) so cross-potential artifact reuse
+    rejects loudly at admission.
     """
     from bdlz_tpu.config import (
         VALID_POSTERIOR_WEIGHTS,
@@ -892,12 +901,46 @@ def build_emulator(
             f"refine_signal={rs!r} is not one of "
             f"{VALID_REFINE_SIGNALS} (or None = curvature)"
         )
+    # Potential-space plane (docs/scenarios.md): a bounce spec is shot
+    # into a wall profile once, host-side, then rides the lz_profile
+    # machinery below unchanged — the potential fingerprint joins the
+    # artifact identity as its own ``bounce`` key alongside the derived
+    # profile's ``lz_profile`` fingerprint.  Seam-split sub-builds
+    # re-derive from the SPEC (pure function of the knobs), so both
+    # sides resolve the identical identity.
+    lz_mode = getattr(static, "lz_mode", "two_channel")
+    bounce_fp = None
+    if bounce is not None:
+        if lz_profile is not None:
+            raise EmulatorBuildError(
+                "pass either bounce or lz_profile, not both — the bounce "
+                "solver derives the profile the lz_profile seam would load"
+            )
+        if elastic:
+            raise EmulatorBuildError(
+                "elastic build cannot ship per-point bounce profiles; "
+                "drop elastic=... or bounce=..."
+            )
+        if lz_mode == "two_channel":
+            raise EmulatorBuildError(
+                "bounce requires a scenario lz_mode ('chain'/'thermal') "
+                "in the config/static — the two-channel emulator takes P "
+                "from the config or a P_chi_to_B axis"
+            )
+        from bdlz_tpu.bounce import (
+            as_potential_spec,
+            bounce_profile,
+            potential_fingerprint,
+        )
+
+        bounce = as_potential_spec(bounce)
+        bounce_fp = potential_fingerprint(bounce)
+        lz_profile = bounce_profile(bounce)
     # LZ scenario plane (docs/scenarios.md): a chain/thermal mode builds
     # the surface over profile-derived per-point P, so the profile is
     # required — and a profile without a scenario mode would silently
     # change nothing (the two-channel emulator evaluates P from the
     # config/axes), which is a caller error, not a no-op.
-    lz_mode = getattr(static, "lz_mode", "two_channel")
     lz_fp = None
     if lz_mode != "two_channel":
         if lz_profile is None:
@@ -947,7 +990,12 @@ def build_emulator(
             impl=impl, chunk_size=chunk_size, mesh=mesh,
             require_converged=require_converged, fault_plan=fault_plan,
             retry=retry, cache=cache, posterior_weight=pw,
-            refine_signal=rs, lz_profile=lz_profile,
+            refine_signal=rs,
+            # sub-builds take the SPEC and re-derive (pure in the knobs);
+            # handing them the already-derived profile too would trip the
+            # either/or guard above
+            lz_profile=None if bounce_fp is not None else lz_profile,
+            bounce=bounce,
         )
     # Engine resolution mirrors run_sweep, and is done HERE (once) so the
     # product population, the probe evaluations, and the artifact identity
@@ -1347,7 +1395,7 @@ def build_emulator(
         values=values,
         identity=build_identity(
             base, static, n_y, impl, posterior_weight=pw,
-            lz_profile_fp=lz_fp, refine_signal=rs,
+            lz_profile_fp=lz_fp, refine_signal=rs, bounce_fp=bounce_fp,
         ),
         manifest=manifest,
         predicted_error=predicted,

@@ -169,6 +169,19 @@ STATIC_PARAM_NAMES = {
     "autoscale_interval_s",
     "pool_min_replicas",
     "replica_budget",
+    # bounce-solver knobs (bdlz_tpu/bounce/shooting.py, docs/scenarios.md
+    # "Potential-space axes"): the shooting knobs shape the compiled
+    # fixed-lane-width program (grid sizes, bisection depth, lane
+    # width) and the `bounce` seam parameter is the host-side potential
+    # spec resolved to a profile BEFORE any tracer exists.  Same
+    # specific-names-only rule as above.
+    "bounce",
+    "lane_width",
+    "n_segments",
+    "n_bisect",
+    "n_dense",
+    "n_xi",
+    "rho_max",
     "n_y",
     "nz",
     "n_mu",

@@ -28,6 +28,7 @@ from bdlz_tpu.lz.profile import (  # noqa: F401
     ProfileError,
     find_crossings,
     load_profile_csv,
+    write_profile_csv,
 )
 from bdlz_tpu.lz.sweep_bridge import (  # noqa: F401
     PTableN,

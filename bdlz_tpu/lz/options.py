@@ -52,6 +52,36 @@ def add_lz_method_flags(
                          "dephased (energy units of the profile's Delta)")
 
 
+def add_bounce_flag(ap) -> None:
+    """Register ``--bounce`` (the potential-space plane, docs/scenarios.md).
+
+    One home for the flag across the sweep/serve drivers and the
+    standalone ``bounce_cli``: a potential-spec JSON shoots the wall
+    profile in-framework (:mod:`bdlz_tpu.bounce`) instead of loading a
+    ``--lz-profile`` CSV; the derived profile then flows through the
+    identical estimator/scenario machinery, and the potential
+    fingerprint joins every identity.
+    """
+    ap.add_argument("--bounce", default=None, dest="bounce",
+                    help="Potential-spec JSON (keys lam4/vev/eps/g_delta/"
+                         "m_mix0): shoot the O(4) bounce profile "
+                         "in-framework from the quartic potential instead "
+                         "of loading an --lz-profile CSV; the potential "
+                         "fingerprint joins the sweep/artifact identity. "
+                         "Mutually exclusive with --lz-profile")
+
+
+def bounce_flag_error(args) -> "str | None":
+    """The --bounce pairing validation shared by every driver (None = ok)."""
+    if (
+        getattr(args, "bounce", None) is not None
+        and getattr(args, "lz_profile", None)
+    ):
+        return ("pass either --bounce or --lz-profile, not both (the "
+                "bounce solver derives the profile)")
+    return None
+
+
 def add_lz_scenario_flags(ap) -> None:
     """Register the scenario-plane flags (docs/scenarios.md).
 

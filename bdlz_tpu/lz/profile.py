@@ -67,18 +67,31 @@ def load_profile_csv(path: str) -> BounceProfile:
         raise ProfileError(f"{path}: missing required column 'xi' (has {list(names)})")
     xi = col("xi")
     if xi.size < 2:
-        raise ProfileError(f"{path}: need at least 2 profile samples, got {xi.size}")
-    if not np.all(np.diff(xi) > 0):
-        order = np.argsort(xi)
-        xi = xi[order]
-    else:
-        order = slice(None)
+        raise ProfileError(
+            f"{path}: need at least 2 profile samples, got {xi.size} "
+            f"(data row 1 is the only sample — the kernel needs at least "
+            f"one ξ segment)"
+        )
+    bad = np.flatnonzero(np.diff(xi) <= 0)
+    if bad.size:
+        # Strictly-increasing ξ is the kernel's segment contract — a
+        # sorted-under-the-hood profile silently reorders (Δ, m_mix)
+        # against the caller's file and a duplicated ξ produces a
+        # zero-width segment, both of which used to surface as wrong
+        # answers deep in the propagation.  Name the first offending
+        # data row (1-based, header excluded) instead.
+        i = int(bad[0])
+        raise ProfileError(
+            f"{path}: xi must be strictly increasing; data row {i + 2} "
+            f"(xi={xi[i + 1]!r}) does not increase past data row {i + 1} "
+            f"(xi={xi[i]!r})"
+        )
 
     if "delta" in names and "m_mix" in names:
-        delta, mix = col("delta")[order], col("m_mix")[order]
+        delta, mix = col("delta"), col("m_mix")
     elif all(k in names for k in ("m11", "m22", "m12")):
-        delta = (col("m11") - col("m22"))[order]
-        mix = col("m12")[order]
+        delta = col("m11") - col("m22")
+        mix = col("m12")
     else:
         raise ProfileError(
             f"{path}: columns must be (xi, delta, m_mix) or (xi, m11, m22, m12); "
@@ -87,6 +100,59 @@ def load_profile_csv(path: str) -> BounceProfile:
     if not (np.all(np.isfinite(delta)) and np.all(np.isfinite(mix))):
         raise ProfileError(f"{path}: non-finite profile values")
     return BounceProfile(xi=xi, delta=delta, mix=mix)
+
+
+def write_profile_csv(
+    path: str,
+    profile: BounceProfile,
+    schema: str = "delta",
+    durable: bool = False,
+) -> None:
+    """Archive a profile as CSV, bit-identically re-ingestable.
+
+    The write side of :func:`load_profile_csv`, closing the bounce loop:
+    a solver-derived profile written here and loaded back compares
+    bitwise equal (``repr`` is the float64 shortest round-trip form).
+
+    ``schema`` picks the column layout:
+
+    * ``"delta"``  — ``xi, delta, m_mix`` (the direct form);
+    * ``"matrix"`` — ``xi, m11, m22, m12`` with m11 = Δ/2, m22 = −Δ/2,
+      m12 = m_mix, so the loader's Δ = m11 − m22 reconstructs the
+      original splitting exactly (halving and re-summing a float64 is
+      bit-exact).
+
+    The write is atomic via :func:`bdlz_tpu.utils.io.atomic_write_text`
+    (mkstemp + rename; ``durable`` adds the fsync pair) so a crash can
+    never leave a torn profile for a later sweep to ingest.
+    """
+    from bdlz_tpu.utils.io import atomic_write_text
+
+    if schema not in ("delta", "matrix"):
+        raise ProfileError(
+            f"write_profile_csv schema must be 'delta' or 'matrix', got {schema!r}"
+        )
+    xi = np.asarray(profile.xi, dtype=np.float64)
+    delta = np.asarray(profile.delta, dtype=np.float64)
+    mix = np.asarray(profile.mix, dtype=np.float64)
+    if not (xi.shape == delta.shape == mix.shape) or xi.ndim != 1:
+        raise ProfileError(
+            f"profile arrays must be 1-D and same-length; got shapes "
+            f"xi={xi.shape} delta={delta.shape} mix={mix.shape}"
+        )
+    lines = []
+    # .tolist() hands back Python floats, whose repr is the shortest
+    # round-trip form — numpy scalar reprs are not parseable CSV fields
+    if schema == "delta":
+        lines.append("xi,delta,m_mix")
+        for x, d, m in zip(xi.tolist(), delta.tolist(), mix.tolist()):
+            lines.append(f"{x!r},{d!r},{m!r}")
+    else:
+        lines.append("xi,m11,m22,m12")
+        for x, d, m in zip(xi.tolist(), delta.tolist(), mix.tolist()):
+            half = d / 2.0
+            lines.append(f"{x!r},{half!r},{(-half)!r},{m!r}")
+    atomic_write_text(path, "\n".join(lines) + "\n", durable=durable)
 
 
 class Crossings(NamedTuple):

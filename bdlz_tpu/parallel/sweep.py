@@ -928,6 +928,7 @@ def run_sweep(
     lz_profile=None,
     lz_method: str = "local",
     lz_gamma_phi: float = 0.0,
+    bounce=None,
     overlap_chunks: bool = True,
     fault_plan=None,
     retry=None,
@@ -948,6 +949,16 @@ def run_sweep(
     v_w scans exercise the distributed-LZ physics end to end.
     ``lz_method`` picks the estimator (see ``lz.sweep_bridge``); the
     profile fingerprint joins the manifest hash.
+
+    ``bounce`` (a :class:`~bdlz_tpu.bounce.PotentialSpec`, mapping, or
+    ``--bounce`` JSON path) closes the loop one layer earlier: the wall
+    profile is SHOT in-framework from the potential
+    (:func:`bdlz_tpu.bounce.bounce_profile`) instead of loaded from a
+    CSV, then flows through the identical ``lz_profile`` machinery
+    below.  Mutually exclusive with ``lz_profile``; the potential
+    fingerprint joins the manifest hash as its own ``bounce`` key
+    ALONGSIDE the derived profile's ``lz_profile`` fingerprint, so both
+    potential-knob changes and solver-knob drift re-key the sweep.
 
     ``static.quad_panel_gl`` (tri-state) selects the y-quadrature on the
     tabulated engine: ``None`` (the default) runs the per-population
@@ -1016,6 +1027,27 @@ def run_sweep(
 
     faults = FaultPlan.resolve(fault_plan, base)
     retry_policy = resolve_engine_retry(retry, base, static)
+
+    # Potential-space plane (docs/scenarios.md): a bounce spec is shot
+    # into a wall profile ONCE, host-side, then rides the lz_profile
+    # path unchanged — the derived-profile fingerprint keys solver
+    # output, the potential fingerprint (added below) keys the knobs.
+    bounce_fp = None
+    if bounce is not None:
+        if lz_profile is not None:
+            raise ValueError(
+                "pass either bounce or lz_profile, not both — the bounce "
+                "solver derives the profile the lz_profile seam would load"
+            )
+        from bdlz_tpu.bounce import (
+            as_potential_spec,
+            bounce_profile,
+            potential_fingerprint,
+        )
+
+        bounce = as_potential_spec(bounce)
+        bounce_fp = potential_fingerprint(bounce)
+        lz_profile = bounce_profile(bounce)
 
     # With a profile the config's P is irrelevant (and may be None — the
     # natural way to use --lz-profile); give build_grid a placeholder that
@@ -1088,6 +1120,11 @@ def run_sweep(
                 # different sweeps (only keyed for the method that uses
                 # it, so existing directories keep their hashes)
                 hash_extra["lz_gamma_phi"] = float(lz_gamma_phi)
+        if bounce_fp is not None:
+            # the potential knobs key the manifest alongside the derived
+            # profile's array-level fingerprint (chunk-cache keys stay
+            # potential-blind on purpose: P is already in the slice bytes)
+            hash_extra["bounce"] = bounce_fp
         pp_all = pp_all._replace(P=P_pts)
     if mesh is not None:
         # The sharded batch axis must divide evenly across the mesh; chunks

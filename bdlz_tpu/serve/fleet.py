@@ -522,6 +522,7 @@ class FleetService:
         health=None,
         store=None,
         lz_profile=None,
+        bounce=None,
     ):
         from bdlz_tpu.emulator.artifact import build_identity
         from bdlz_tpu.provenance import resolve_store
@@ -535,7 +536,7 @@ class FleetService:
         #: — stamped on every stats row and FleetResponse; the identity
         #: check above already rejects cross-mode artifact/static skew.
         self.lz_mode = artifact_lz_mode(artifact)
-        lz_profile = resolve_service_profile(artifact, lz_profile)
+        lz_profile = resolve_service_profile(artifact, lz_profile, bounce)
         #: The exact-fallback error gate (shared resolution with
         #: YieldService — resolve_error_gate): None = membership-only.
         self.error_gate_tol = resolve_error_gate(

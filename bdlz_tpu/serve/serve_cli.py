@@ -154,9 +154,15 @@ def main(argv: Optional[list] = None) -> int:
                          "was built from (docs/scenarios.md).  Required "
                          "for scenario artifacts, rejected for "
                          "two-channel ones.")
+    from bdlz_tpu.lz.options import add_bounce_flag, bounce_flag_error
+
+    add_bounce_flag(ap)
     ap.add_argument("--events", default=None,
                     help="JSON-lines event log path (default stderr)")
     args = ap.parse_args(argv)
+    _berr = bounce_flag_error(args)
+    if _berr:
+        ap.error(_berr)
 
     from bdlz_tpu.backend import ensure_x64
 
@@ -192,12 +198,14 @@ def main(argv: Optional[list] = None) -> int:
             ),
             health={"auto": None, "on": True, "off": False}[args.health],
             lz_profile=args.lz_profile,
+            bounce=args.bounce,
         )
         service = None
     else:
         service = YieldService(
             artifact, base, field=args.field, max_batch_size=args.max_batch,
             lz_profile=args.lz_profile,
+            bounce=args.bounce,
         )
     event_log.emit(
         "serve_start",
@@ -394,6 +402,7 @@ def _serve_tenant(args, ap, base, event_log) -> int:
         ),
         health={"auto": None, "on": True, "off": False}[args.health],
         lz_profile=args.lz_profile,
+        bounce=args.bounce,
         memory_budget_bytes=args.memory_budget,
     )
     event_log.emit(

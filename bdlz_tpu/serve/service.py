@@ -111,7 +111,7 @@ def artifact_lz_mode(artifact) -> str:
     return str(scen["mode"]) if scen else "two_channel"
 
 
-def resolve_service_profile(artifact, lz_profile):
+def resolve_service_profile(artifact, lz_profile, bounce=None):
     """The bounce profile a service's exact fallback must run with.
 
     A chain/thermal artifact derives every exact-fallback P from the
@@ -123,20 +123,53 @@ def resolve_service_profile(artifact, lz_profile):
     the config/axes); passing one is a caller error, not a no-op.
     Returns the loaded :class:`~bdlz_tpu.lz.profile.BounceProfile` (or
     None for two-channel).
+
+    ``bounce`` (a potential spec / mapping / JSON path, mutually
+    exclusive with ``lz_profile``) derives the profile in-framework
+    instead: admission then checks the POTENTIAL fingerprint against
+    the artifact identity's ``bounce`` key — a surface built from a
+    different potential (or from a CSV, with no potential on record)
+    is cross-potential skew and rejects loudly — and the derived
+    profile's own fingerprint still passes through the ``lz_profile``
+    check below, so solver-knob drift is just as loud.
     """
     mode = artifact_lz_mode(artifact)
     if mode == "two_channel":
-        if lz_profile is not None:
+        if lz_profile is not None or bounce is not None:
             raise ValueError(
-                "lz_profile requires a scenario (chain/thermal) artifact "
-                "— this two-channel artifact's exact fallback takes P "
-                "from the config or its axes"
+                "lz_profile/bounce require a scenario (chain/thermal) "
+                "artifact — this two-channel artifact's exact fallback "
+                "takes P from the config or its axes"
             )
         return None
+    if bounce is not None:
+        if lz_profile is not None:
+            raise ValueError(
+                "pass either bounce or lz_profile, not both — the bounce "
+                "solver derives the profile the lz_profile seam would load"
+            )
+        from bdlz_tpu.bounce import (
+            as_potential_spec,
+            bounce_profile,
+            potential_fingerprint,
+        )
+
+        bounce = as_potential_spec(bounce)
+        got_pot = potential_fingerprint(bounce)
+        recorded_pot = dict(artifact.identity).get("bounce")
+        if recorded_pot != got_pot:
+            raise ValueError(
+                f"bounce potential fingerprint {got_pot} does not match "
+                f"the potential this artifact was built from "
+                f"({recorded_pot}): the exact fallback would answer from "
+                "different physics than the emulator surface"
+            )
+        lz_profile = bounce_profile(bounce)
     if lz_profile is None:
         raise ValueError(
             f"this artifact serves lz_mode={mode!r}: its exact fallback "
-            "derives P per point from a bounce profile; pass lz_profile"
+            "derives P per point from a bounce profile; pass lz_profile "
+            "(or bounce, for a surface built from a potential spec)"
         )
     from bdlz_tpu.lz.profile import load_profile_csv
     from bdlz_tpu.lz.sweep_bridge import profile_fingerprint
@@ -357,6 +390,7 @@ class YieldService:
         warm: bool = True,
         error_gate_tol=None,
         lz_profile=None,
+        bounce=None,
     ):
         # identity resolution + the retried/fault-injectable exact path
         # are shared with the fleet (resolve_service_static /
@@ -366,7 +400,7 @@ class YieldService:
         #: — stamped on every stats row and checked against any
         #: mode-stating request.
         self.lz_mode = artifact_lz_mode(artifact)
-        lz_profile = resolve_service_profile(artifact, lz_profile)
+        lz_profile = resolve_service_profile(artifact, lz_profile, bounce)
         self.artifact = artifact
         self.field = field
         self.max_batch_size = int(max_batch_size)

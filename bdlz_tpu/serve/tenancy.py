@@ -245,6 +245,7 @@ class MultiTenantService:
         error_gate_tol=None,
         health=None,
         lz_profile=None,
+        bounce=None,
         tenant_routing: Optional[str] = None,
         memory_budget_bytes: Optional[int] = None,
         autoscale_interval_s: Optional[float] = None,
@@ -269,6 +270,7 @@ class MultiTenantService:
         self._error_gate_tol = error_gate_tol
         self._health = health
         self._lz_profile = lz_profile
+        self._bounce = bounce
         self._store = resolve_store(store, base=base, label="tenancy")
         if self._store is None:
             raise TenancyError(
@@ -578,6 +580,9 @@ class MultiTenantService:
         # (fingerprint-checked against its artifact by the fleet); a
         # two-channel pool must not receive one — the fleet rejects it
         profile = self._lz_profile if mode != "two_channel" else None
+        # --bounce pools derive the shared profile in-framework; the
+        # fleet checks the potential fingerprint against each artifact
+        bounce = self._bounce if mode != "two_channel" else None
         pool = prior if prior is not None else PoolState(
             scenario, content_hash
         )
@@ -591,7 +596,7 @@ class MultiTenantService:
             fault_plan=self._pool_fault_plan(pool.scenario, content_hash),
             stats=pool.stats, warm=self._warm,
             error_gate_tol=self._error_gate_tol, health=self._health,
-            store=self._store, lz_profile=profile,
+            store=self._store, lz_profile=profile, bounce=bounce,
         )
         if self._warm:
             # the PR-9 re-provision probe: a full bucket at the hull's

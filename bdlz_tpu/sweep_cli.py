@@ -149,6 +149,7 @@ def main(argv=None) -> None:
     # documented divergence is its "local" default estimator
     from bdlz_tpu.lz.options import (
         SWEEP_METHODS,
+        add_bounce_flag,
         add_lz_method_flags,
         add_lz_scenario_flags,
     )
@@ -168,6 +169,7 @@ def main(argv=None) -> None:
                     "--lz-gamma-phi dephasing)",
     )
     add_lz_scenario_flags(ap)
+    add_bounce_flag(ap)
     ap.add_argument("--multihost", action="store_true",
                     help="Initialize jax.distributed from JAX_COORDINATOR_ADDRESS/"
                          "JAX_NUM_PROCESSES/JAX_PROCESS_ID before building the mesh "
@@ -220,14 +222,22 @@ def main(argv=None) -> None:
         if args.lz_profile:
             ap.error("--lz-profile sweeps are not supported with --elastic "
                      "(profiles are not shipped to workers); drop --elastic")
-    from bdlz_tpu.lz.options import lz_flags_error
+        if args.bounce:
+            ap.error("--bounce sweeps are not supported with --elastic "
+                     "(the derived profile is not shipped to workers); "
+                     "drop --elastic")
+    from bdlz_tpu.lz.options import bounce_flag_error, lz_flags_error
 
-    _gerr = lz_flags_error(args, default_method="local")
+    _gerr = bounce_flag_error(args) or lz_flags_error(
+        args, default_method="local"
+    )
     if _gerr:
         ap.error(_gerr)
-    if args.lz_mode in ("chain", "thermal") and not args.lz_profile:
+    if args.lz_mode in ("chain", "thermal") and not (
+        args.lz_profile or args.bounce
+    ):
         ap.error(f"--lz-mode {args.lz_mode} derives P per point from a "
-                 "bounce profile; pass --lz-profile")
+                 "bounce profile; pass --lz-profile or --bounce")
 
     if args.multihost:
         from bdlz_tpu.parallel import init_multihost
@@ -271,10 +281,10 @@ def main(argv=None) -> None:
 
     cfg = apply_scenario_flags(cfg, args)
     if cfg.lz_mode != "two_channel":
-        if not args.lz_profile:
+        if not (args.lz_profile or args.bounce):
             raise SystemExit(
                 f"lz_mode={cfg.lz_mode!r} derives P per point from a bounce "
-                "profile; pass --lz-profile"
+                "profile; pass --lz-profile or --bounce"
             )
         # a config-driven scenario mode forbids the two-channel estimator
         # knobs it would silently ignore (the flag-driven case is caught
@@ -321,7 +331,7 @@ def main(argv=None) -> None:
             event_log=event_log, trace_dir=args.profile_dir,
             impl=args.impl, interpret=interpret, fuse_exp=args.fuse_exp,
             lz_profile=args.lz_profile, lz_method=args.lz_method,
-            lz_gamma_phi=args.lz_gamma_phi,
+            lz_gamma_phi=args.lz_gamma_phi, bounce=args.bounce,
         )
 
     if args.sanitize:

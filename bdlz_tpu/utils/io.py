@@ -80,6 +80,34 @@ def atomic_write_json(
         raise
 
 
+def atomic_write_text(path: str, text: str, durable: bool = False) -> None:
+    """Write ``text`` to ``path`` atomically (mkstemp + replace).
+
+    The plain-text sibling of :func:`atomic_write_json` — same temp-file-
+    in-destination-directory rename, same optional fsync pair — for
+    artifacts that are text but not JSON (bounce-derived profile CSVs,
+    :func:`bdlz_tpu.lz.profile.write_profile_csv`).  Readers see either
+    the old complete file or the new complete file, never half a write.
+    """
+    d = os.path.dirname(os.path.abspath(path))
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "w", encoding="utf-8") as f:
+            f.write(text)
+            if durable:
+                f.flush()
+                os.fsync(f.fileno())
+        os.replace(tmp, path)
+        if durable:
+            _fsync_dir(d)
+    except BaseException:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
+
+
 def atomic_savez(path: str, durable: bool = False, **arrays: Any) -> None:
     """``np.savez`` with the mkstemp + ``os.replace`` atomicity of
     :func:`atomic_write_json`.

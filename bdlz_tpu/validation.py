@@ -757,3 +757,106 @@ def thermal_mode_audit(
         ok=ok, cold_limit_bitwise=cold_bitwise, monotonicity_defect=mono,
         saturation_err=sat, reason=reason,
     )
+
+
+class BounceAuditResult(NamedTuple):
+    """Verdict of :func:`bounce_audit`."""
+
+    ok: bool
+    #: rel err of the SHOT reference potential's P(v_w = 0.3, local
+    #: composition) vs the archived ``P_chi_to_B`` config value — the
+    #: known-profile reproduction check; the calibration is recorded to
+    #: full float64 (``bounce.potential.REFERENCE_M_MIX0``), so drift
+    #: here means the solver's trajectory moved, not the physics.
+    P_vs_archived: float
+    #: rel dev of the shot Euclidean action vs the closed-form thin-wall
+    #: S₄ = 27π²σ⁴/(2ε³) — the analytic-limit check.  The reference
+    #: point sits at μR = 10 where the measured deviation is ~6% (the
+    #: O(1/μR) friction correction); the tolerance doubles that budget.
+    action_vs_thin_wall: float
+    #: Δ(ξ) crossings located on the derived profile (contract: exactly 1
+    #: — the monotone wall crosses the diabatic midpoint once).
+    n_crossings: int
+    reason: "str | None" = None
+
+
+def bounce_audit(
+    rtol_P: float = 1e-6,
+    rtol_action: float = 0.12,
+    n_xi: "int | None" = None,
+) -> BounceAuditResult:
+    """The bounce-solver gate (ROADMAP item 4; docs/scenarios.md).
+
+    Shoots the reference potential (``bounce.potential
+    .reference_potential`` — the archived-P calibration point) through
+    the full potential → profile → P chain and scores: (a) P at the
+    benchmark wall speed against the archived ``P_chi_to_B =
+    0.14925839040304145``; (b) the numeric Euclidean action against the
+    closed-form thin-wall S₄; (c) the derived profile's crossing count.
+    A non-converged shoot or non-finite output raises through
+    :class:`GateFailure` into a failed result, mask-and-report style —
+    never a small error.
+    """
+    from bdlz_tpu.bounce.potential import (
+        REFERENCE_P_CHI_TO_B,
+        REFERENCE_V_WALL,
+        reference_potential,
+        thin_wall_action,
+    )
+    from bdlz_tpu.bounce.shooting import (
+        BounceSolveError,
+        bounce_profile,
+        solve_bounce,
+    )
+    from bdlz_tpu.lz.profile import find_crossings
+    from bdlz_tpu.lz.sweep_bridge import probabilities_for_points
+
+    spec = reference_potential()
+    try:
+        sol = solve_bounce(spec)
+        if not bool(sol.converged):
+            raise GateFailure(
+                f"bounce shoot did not converge on the reference potential "
+                f"(phi0={float(sol.phi0)!r}, action={float(sol.action)!r})"
+            )
+        if not np.isfinite(float(sol.action)):
+            raise GateFailure("non-finite bounce action")
+        try:
+            kwargs = {} if n_xi is None else {"n_xi": int(n_xi)}
+            profile = bounce_profile(spec, solution=sol, **kwargs)
+        except BounceSolveError as exc:
+            raise GateFailure(str(exc)) from exc
+        crossings = find_crossings(profile)
+        n_cross = int(crossings.xi_star.size)
+        if n_cross != 1:
+            raise GateFailure(
+                f"reference wall profile must cross Δ = 0 exactly once, "
+                f"found {n_cross} crossings"
+            )
+        P = probabilities_for_points(
+            profile, np.asarray([REFERENCE_V_WALL]), method="local"
+        )
+        if not np.isfinite(P).all():
+            raise GateFailure("non-finite bounce-derived probability")
+    except GateFailure as exc:
+        return BounceAuditResult(
+            ok=False, P_vs_archived=np.inf, action_vs_thin_wall=np.inf,
+            n_crossings=-1, reason=str(exc),
+        )
+    p_err = float(
+        abs(float(P[0]) - REFERENCE_P_CHI_TO_B) / REFERENCE_P_CHI_TO_B
+    )
+    s_tw = thin_wall_action(spec)
+    a_err = float(abs(float(sol.action) - s_tw) / s_tw)
+    ok = p_err <= rtol_P and a_err <= rtol_action
+    reason = None
+    if not ok:
+        reason = (
+            f"bounce gate breach: P vs archived {p_err:.3e} "
+            f"(<= {rtol_P:.0e}), action vs thin-wall {a_err:.3e} "
+            f"(<= {rtol_action:.2f})"
+        )
+    return BounceAuditResult(
+        ok=ok, P_vs_archived=p_err, action_vs_thin_wall=a_err,
+        n_crossings=n_cross, reason=reason,
+    )

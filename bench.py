@@ -50,6 +50,10 @@ profile through the two-channel LZ kernel, once analytically and once
 through the coherent transfer-matrix P(v_w) table; default: the full
 grid on TPU, 4096 on CPU fallback), BDLZ_BENCH_LZ_TABLE_N (coherent
 P-table nodes; default 16384 on TPU, 2048 on CPU fallback),
+BDLZ_BENCH_BOUNCE_POINTS (spec-batch size for the
+bounce_sweep leg — potentials/sec/chip through the batched O(4)
+shooting solver with the host scalar-loop A/B and the validation-gate
+residuals on the line; default 8, one full lane),
 BDLZ_BENCH_SERVE_QUERIES / BDLZ_BENCH_SERVE_BATCH /
 BDLZ_BENCH_SERVE_REPLICAS / BDLZ_BENCH_SERVE_LAT_QUERIES (the
 serve_bench leg: request-stream size, micro-batch bucket, fleet size,
@@ -1947,6 +1951,92 @@ def main(argv=None) -> None:
         else:
             lz_thermal_per_chip = val
 
+    # --- secondary metric: bounce_sweep (the in-framework O(4) bounce
+    # solver, bdlz_tpu/bounce): potentials/sec/chip through the batched
+    # fixed-lane-width shooting program, with the host scalar-loop A/B
+    # on the line.  Gate-first like the scenario legs: the validation
+    # gate (archived-P reproduction + thin-wall action) must pass before
+    # any throughput is reported, and the batch/scalar-loop bitwise
+    # parity contract is re-checked on the bench's own spec batch. ---
+    def bounce_sweep_metric():
+        from bdlz_tpu.bounce import (
+            reference_potential,
+            solve_bounce_batch,
+            solve_bounce_scalar_loop,
+        )
+        from bdlz_tpu.validation import bounce_audit
+
+        audit = bounce_audit()  # also warms the lane-width-8 program
+        if not audit.ok:
+            raise RuntimeError(audit.reason)
+        n_bounce = int(os.environ.get("BDLZ_BENCH_BOUNCE_POINTS", 8))
+        ref = reference_potential()
+        # a vacuum-splitting scan around the reference point: ±10% eps
+        # stays deep in the thin-wall regime, so every lane converges
+        specs = [
+            ref._replace(eps=float(e))
+            for e in np.linspace(0.9, 1.1, n_bounce) * ref.eps
+        ]
+        t0 = time.time()
+        batch = solve_bounce_batch(specs)
+        t_batch = time.time() - t0
+        t0 = time.time()
+        loop = solve_bounce_scalar_loop(specs)
+        t_loop = time.time() - t0
+        for a, b in zip(batch, loop):
+            if not np.array_equal(np.asarray(a), np.asarray(b)):
+                raise RuntimeError(
+                    "bounce batch vs scalar-loop parity breach on the "
+                    "bench spec batch"
+                )
+        n_failed = int(np.count_nonzero(~np.asarray(batch.converged)))
+        if n_failed:
+            raise RuntimeError(
+                f"{n_failed}/{n_bounce} bench bounce shoots failed to "
+                "converge"
+            )
+        per_chip_bounce = round(n_bounce / t_batch / n_dev, 2)
+        payload = {
+            "metric": "bounce_profiles_per_sec_per_chip",
+            "value": per_chip_bounce,
+            "unit": "potentials/sec/chip (O(4) shoot: segment ladder + "
+                    "bisection + dense action pass)",
+            "n_points": n_bounce,
+            "n_failed": n_failed,
+            "n_quarantined": None,
+            "n_retries": None,
+            "cache_hits": None,
+            "cache_misses": None,
+            "seconds": round(t_batch, 3),
+            # the A/B the tentpole claims: one vmapped lane-width-8
+            # program filled by the batch vs the same program driven one
+            # spec at a time from the host
+            "scalar_loop_seconds": round(t_loop, 3),
+            "vs_scalar_loop": (
+                round(t_loop / t_batch, 2) if t_batch > 0 else None
+            ),
+            "gate_P_vs_archived": float(f"{audit.P_vs_archived:.3e}"),
+            "gate_action_vs_thin_wall": float(
+                f"{audit.action_vs_thin_wall:.3e}"
+            ),
+            "platform": jax.devices()[0].platform,
+            "tpu_unavailable": tpu_unavailable,
+        }
+        emit(payload)
+        return {
+            "value": per_chip_bounce,
+            "vs_scalar_loop": payload["vs_scalar_loop"],
+            "gate_P_vs_archived": payload["gate_P_vs_archived"],
+            "gate_action_vs_thin_wall": payload["gate_action_vs_thin_wall"],
+        }
+
+    bounce_summary = None
+    try:
+        bounce_summary = run_leg("bounce_sweep", bounce_sweep_metric)
+    except Exception as exc:  # noqa: BLE001 — secondary metric is best-effort
+        print(f"[bench] bounce_sweep metric unavailable: {exc}",
+              file=sys.stderr)
+
     # --- secondary metric: serve_multitenant (scenario-routed pools) ---
     # The multi-tenant serving plane (bdlz_tpu/serve/tenancy.py) under a
     # deterministic fake-clock mixed-scenario trace: three pools —
@@ -2560,6 +2650,11 @@ def main(argv=None) -> None:
                 "lz_thermal_sweep_points_per_sec_per_chip": (
                     lz_thermal_per_chip
                 ),
+                # the in-framework O(4) bounce solver leg (potential →
+                # profile throughput, vmapped vs scalar-loop A/B, gate
+                # residuals; null = leg failed — the secondary line
+                # carries the full detail)
+                "bounce_sweep": bounce_summary,
                 # the differentiable-pipeline legs (gradient throughput
                 # + FD parity; NUTS-vs-stretch ESS per logp eval — null
                 # = leg failed, the secondary lines carry the detail)

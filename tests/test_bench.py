@@ -122,6 +122,9 @@ def test_bench_leg_cache_replays_cpu_round(tmp_path, jax_compile_cache):
         BDLZ_BENCH_NUTS_WALKERS="8", BDLZ_BENCH_NUTS_STRETCH_STEPS="64",
         BDLZ_BENCH_NUTS_CHAINS="2", BDLZ_BENCH_NUTS_STEPS="32",
         BDLZ_BENCH_NUTS_WARMUP="16",
+        # tiny bounce leg: the gate audit + a 2-spec batch/scalar A/B
+        # still run; replay equality is what THIS test asserts
+        BDLZ_BENCH_BOUNCE_POINTS="2",
         BDLZ_BENCH_LEG_CACHE="force",
         BDLZ_CACHE_ROOT=str(tmp_path / "store"),
         PYTHONPATH=REPO,
@@ -205,6 +208,11 @@ def test_bench_cpu_smoke(jax_compile_cache):
         BDLZ_BENCH_NUTS_STEPS="256",
         BDLZ_BENCH_NUTS_WARMUP="120",
         BDLZ_BENCH_NUTS_STRETCH_STEPS="320",
+        # small bounce_sweep leg: the validation gate + the batched
+        # vs scalar-loop A/B still run on a 2-spec eps scan — the
+        # gate residuals and parity are asserted below regardless of
+        # batch size (the gate itself shoots the reference potential)
+        BDLZ_BENCH_BOUNCE_POINTS="2",
         PYTHONPATH=REPO,
         **jax_compile_cache,
     )
@@ -264,6 +272,7 @@ def test_bench_cpu_smoke(jax_compile_cache):
             "chaos_serve_availability",
             "serve_multitenant_availability",
             "grad_sweep_points_per_sec_per_chip",
+            "bounce_profiles_per_sec_per_chip",
             "nuts_ess_per_eval"} <= names
     # robustness schema: every sweep metric line carries the failure
     # counters (nulls where the leg has no healing path), main line
@@ -675,5 +684,32 @@ def test_bench_cpu_smoke(jax_compile_cache):
         "stretch_ess_per_eval": nuts["stretch_ess_per_eval"],
         "mass_matrix": nuts["mass_matrix"],
         "nuts_divergent": nuts["nuts_divergent"],
+    }
+    # the bounce_sweep line (the in-framework O(4) bounce solver,
+    # bdlz_tpu/bounce): gate-first — the validation gate (archived-P
+    # reproduction, bitwise on the reference potential, + thin-wall
+    # action sanity) passed before any throughput was reported, and the
+    # batched vs host-scalar-loop A/B ran on the bench's own eps scan
+    # (bitwise parity is enforced INSIDE the leg; a breach would have
+    # made the metric unavailable, failing the names assertion above)
+    bn = next(s for s in secondary
+              if s["metric"] == "bounce_profiles_per_sec_per_chip")
+    assert {"value", "unit", "n_points", "n_failed", "seconds",
+            "scalar_loop_seconds", "vs_scalar_loop", "gate_P_vs_archived",
+            "gate_action_vs_thin_wall", "platform",
+            "tpu_unavailable"} <= set(bn)
+    assert bn["value"] > 0
+    assert bn["n_points"] == 2 and bn["n_failed"] == 0
+    assert bn["vs_scalar_loop"] > 0
+    # the P gate is an exact-reproduction contract, not a tolerance
+    assert bn["gate_P_vs_archived"] == 0.0
+    # thin-wall closed form is an estimate; the shot action must land
+    # within the documented ~6% of it on the reference potential
+    assert bn["gate_action_vs_thin_wall"] <= 0.1
+    assert d["bounce_sweep"] == {
+        "value": bn["value"],
+        "vs_scalar_loop": bn["vs_scalar_loop"],
+        "gate_P_vs_archived": bn["gate_P_vs_archived"],
+        "gate_action_vs_thin_wall": bn["gate_action_vs_thin_wall"],
     }
     assert np.isfinite(d["value"])

@@ -1,0 +1,413 @@
+"""In-framework O(4) bounce solver (docs/scenarios.md "Potential-space
+axes"): potential → profile → P → yields, end-to-end.
+
+Pins the subsystem's acceptance contract: the shot action lands within
+the documented margin of the closed-form thin-wall S₄ on the reference
+potential, the batched vmapped program is BITWISE-identical per lane to
+the host scalar loop (the fixed-lane-width parity contract), the shot
+reference profile reproduces the archived ``P_chi_to_B`` EXACTLY
+through the local LZ composition (``validation.bounce_audit``), the
+derived profile round-trips through both ``write_profile_csv`` schemas
+bit-identically, and the potential fingerprint joins every downstream
+identity — sweep manifest hashes, emulator artifact identities and
+serve admission — with cross-potential skew rejected loudly.
+"""
+import numpy as np
+import pytest
+
+from bdlz_tpu.bounce import (
+    BounceSolution,
+    BounceSolveError,
+    PotentialError,
+    PotentialSpec,
+    as_potential_spec,
+    load_potential_json,
+    potential_fingerprint,
+    reference_potential,
+    solve_bounce,
+    solve_bounce_batch,
+    solve_bounce_scalar_loop,
+    thin_wall_action,
+    thin_wall_radius,
+    vacua,
+    validate_potential,
+    wall_tension,
+    wall_width_mu,
+    write_potential_json,
+)
+from bdlz_tpu.bounce.potential import (
+    REFERENCE_P_CHI_TO_B,
+    REFERENCE_V_WALL,
+)
+from bdlz_tpu.bounce.shooting import bounce_profile
+from bdlz_tpu.config import (
+    config_from_dict,
+    static_choices_from_config,
+    validate,
+)
+from bdlz_tpu.lz.profile import find_crossings, load_profile_csv, write_profile_csv
+from bdlz_tpu.lz.sweep_bridge import (
+    probabilities_for_points,
+    profile_fingerprint,
+)
+
+#: The tiny_emulator-style physics base (same as test_scenarios.py).
+PHYS = {
+    "regime": "nonthermal",
+    "source_shape_sigma_y": 9.0,
+    "incident_flux_scale": 1.07e-9,
+    "Y_chi_init": 4.90e-10,
+}
+
+
+def _cfg(**kw):
+    return validate(config_from_dict({**PHYS, **kw}), backend="tpu")
+
+
+@pytest.fixture(scope="module")
+def ref_solution(jit_warmup):
+    """ONE reference-potential shoot, shared by the whole module (the
+    compiled lane-width-8 program is lru-cached, so later solves at the
+    same knobs reuse it)."""
+    spec = reference_potential()
+    return spec, solve_bounce(spec)
+
+
+@pytest.fixture(scope="module")
+def ref_profile(ref_solution):
+    spec, sol = ref_solution
+    return bounce_profile(spec, solution=sol)
+
+
+# ---------------------------------------------------------------------------
+# potential spec: validation, closed forms, identity, IO
+# ---------------------------------------------------------------------------
+
+class TestPotentialSpec:
+    def test_bad_knobs_rejected(self):
+        ref = reference_potential()
+        for field, bad, msg in (
+            ("lam4", 0.0, "lam4"),
+            ("lam4", -1.0, "lam4"),
+            ("vev", 0.0, "vev"),
+            ("eps", 0.0, "degenerate vacua"),
+            ("eps", -0.01, "degenerate vacua"),
+            ("g_delta", 0.0, "g_delta"),
+            ("m_mix0", -1e-6, "m_mix0"),
+            ("vev", float("nan"), "finite"),
+        ):
+            with pytest.raises(PotentialError, match=msg):
+                validate_potential(ref._replace(**{field: bad}))
+
+    def test_spinodal_rejected_at_validation_not_as_failed_shoot(self):
+        # eps past λ₄v⁴/(3√3) ≈ 0.0962: the well has no barrier, so the
+        # spec must fail loudly at validation time
+        ref = reference_potential()
+        with pytest.raises(PotentialError, match="spinodal"):
+            validate_potential(ref._replace(eps=0.2))
+
+    def test_vacua_ordering_and_tilt(self):
+        spec = reference_potential()
+        phi_false, phi_top, phi_true = vacua(spec)
+        assert phi_false < phi_top < phi_true
+        # the tilt pushes the true vacuum past +v and the barrier top
+        # off φ = 0 toward the false side
+        assert phi_true > spec.vev
+        assert phi_top < 0.0 < phi_true
+
+    def test_thin_wall_closed_forms(self):
+        spec = reference_potential()
+        sigma = wall_tension(spec)
+        assert sigma == pytest.approx(
+            (2.0 / 3.0) * np.sqrt(spec.lam4) * spec.vev**3
+        )
+        assert thin_wall_radius(spec) == pytest.approx(3.0 * sigma / spec.eps)
+        assert thin_wall_action(spec) == pytest.approx(
+            27.0 * np.pi**2 * sigma**4 / (2.0 * spec.eps**3)
+        )
+        assert wall_width_mu(spec) == pytest.approx(
+            0.5 * spec.vev * np.sqrt(spec.lam4)
+        )
+
+    def test_fingerprint_is_pinned_and_knob_sensitive(self):
+        spec = reference_potential()
+        # the identity every artifact built from the reference potential
+        # records — changing this breaks stored-identity compatibility
+        assert potential_fingerprint(spec) == "528b931f88909962"
+        assert potential_fingerprint(dict(spec._asdict())) == (
+            potential_fingerprint(spec)
+        )
+        assert potential_fingerprint(spec._replace(eps=spec.eps * (1 + 1e-15))) != (
+            potential_fingerprint(spec)
+        )
+
+    def test_json_round_trip_exact(self, tmp_path):
+        spec = reference_potential()
+        path = str(tmp_path / "pot.json")
+        write_potential_json(path, spec)
+        loaded = load_potential_json(path)
+        assert loaded == spec                    # bitwise: floats via repr
+        assert as_potential_spec(path) == spec   # the --bounce CLI path
+        assert potential_fingerprint(path) == potential_fingerprint(spec)
+
+    def test_mapping_keys_must_be_exact(self):
+        spec = reference_potential()
+        d = dict(spec._asdict())
+        with pytest.raises(PotentialError, match="missing"):
+            as_potential_spec({k: v for k, v in d.items() if k != "eps"})
+        with pytest.raises(PotentialError, match="unknown"):
+            as_potential_spec({**d, "epsilon": 0.05})
+        with pytest.raises(PotentialError, match="cannot interpret"):
+            as_potential_spec(42)
+
+
+# ---------------------------------------------------------------------------
+# shooting: thin-wall limit + batch/scalar bitwise parity
+# ---------------------------------------------------------------------------
+
+class TestShooting:
+    def test_reference_shoot_lands_in_thin_wall_limit(self, ref_solution):
+        # the analytic-limit satellite: at μR = 10 the shot bounce must
+        # agree with Coleman's closed forms — the wall radius to a few
+        # percent, the action to the documented ~6% margin
+        spec, sol = ref_solution
+        assert bool(sol.converged)
+        phi_false, phi_top, phi_true = vacua(spec)
+        # the release point is exponentially close to (but short of)
+        # the true vacuum — the thin-wall signature
+        assert phi_top < float(sol.phi0) < phi_true
+        assert abs(float(sol.phi0) - phi_true) < 0.1 * (phi_true - phi_top)
+        assert abs(float(sol.r_wall) / thin_wall_radius(spec) - 1.0) <= 0.05
+        assert abs(float(sol.action) / thin_wall_action(spec) - 1.0) <= 0.10
+        # the dense trajectory interpolates false vacuum at the far end
+        assert float(sol.phi[-1]) == pytest.approx(phi_false, abs=1e-3)
+
+    def test_batch_matches_scalar_loop_bitwise(self, ref_solution):
+        # THE parity contract the fixed-lane-width design exists for:
+        # a partial lane (3 specs, padded to width 8) through the ONE
+        # vmapped program vs the same program driven one spec at a time
+        # — every field of every lane bitwise equal, and invariant
+        # under batch permutation (lanes are value-independent)
+        spec, sol = ref_solution
+        specs = [
+            spec._replace(eps=spec.eps * 0.9),
+            spec,
+            spec._replace(eps=spec.eps * 1.1),
+        ]
+        batch = solve_bounce_batch(specs)
+        loop = solve_bounce_scalar_loop(specs)
+        assert bool(np.all(batch.converged))
+        for field in BounceSolution._fields:
+            a = np.asarray(getattr(batch, field))
+            b = np.asarray(getattr(loop, field))
+            assert np.array_equal(a, b), field
+        rev = solve_bounce_batch(specs[::-1])
+        for field in BounceSolution._fields:
+            a = np.asarray(getattr(batch, field))
+            r = np.asarray(getattr(rev, field))
+            assert np.array_equal(a, r[::-1]), field
+        # the reference lane inside the batch == the solo solve
+        for field in ("phi0", "r_wall", "action"):
+            assert np.asarray(getattr(batch, field))[1] == np.asarray(
+                getattr(sol, field)
+            ), field
+
+    def test_empty_batch_rejected(self):
+        with pytest.raises(BounceSolveError, match="at least one"):
+            solve_bounce_batch([])
+
+
+# ---------------------------------------------------------------------------
+# profile extraction + archived-P gate
+# ---------------------------------------------------------------------------
+
+class TestProfile:
+    def test_single_crossing_wall_window(self, ref_solution, ref_profile):
+        spec, _ = ref_solution
+        prof = ref_profile
+        assert prof.xi.shape == (801,)
+        assert np.all(np.diff(prof.xi) > 0)
+        assert np.all(prof.mix == spec.m_mix0)
+        # Δ > 0 inside the bubble (φ ≈ φ_true), < 0 outside — exactly
+        # one level crossing at the wall
+        assert prof.delta[0] > 0 > prof.delta[-1]
+        assert find_crossings(prof).xi_star.shape == (1,)
+
+    def test_reference_profile_reproduces_archived_P_exactly(self, ref_profile):
+        # the PR gate: not a tolerance — the shot profile's local LZ
+        # composition at v_w = 0.3 IS the archived number, bitwise
+        P = probabilities_for_points(
+            ref_profile, np.asarray([REFERENCE_V_WALL]), method="local"
+        )
+        assert float(P[0]) == REFERENCE_P_CHI_TO_B
+
+    def test_bounce_audit_gate_passes(self, ref_solution):
+        from bdlz_tpu.validation import bounce_audit
+
+        audit = bounce_audit()
+        assert audit.ok, audit.reason
+        assert audit.P_vs_archived == 0.0
+        assert audit.n_crossings == 1
+        assert audit.action_vs_thin_wall <= 0.10
+
+    def test_csv_round_trip_bitwise_both_schemas(self, tmp_path, ref_profile):
+        # the write-side satellite: a solver-derived profile archived
+        # through either schema re-ingests bit-identically
+        for schema in ("delta", "matrix"):
+            path = str(tmp_path / f"prof_{schema}.csv")
+            write_profile_csv(path, ref_profile, schema=schema)
+            back = load_profile_csv(path)
+            np.testing.assert_array_equal(back.xi, ref_profile.xi)
+            np.testing.assert_array_equal(back.delta, ref_profile.delta)
+            np.testing.assert_array_equal(back.mix, ref_profile.mix)
+
+    def test_profile_rejects_bad_solutions(self, ref_solution):
+        spec, sol = ref_solution
+        batched = BounceSolution(*(np.stack([f, f]) for f in sol))
+        with pytest.raises(BounceSolveError, match="batched"):
+            bounce_profile(spec, solution=batched)
+        failed = sol._replace(converged=np.asarray(False))
+        with pytest.raises(BounceSolveError, match="did not converge"):
+            bounce_profile(spec, solution=failed)
+        with pytest.raises(BounceSolveError, match="n_xi"):
+            bounce_profile(spec, solution=sol, n_xi=1)
+        with pytest.raises(BounceSolveError, match="escapes"):
+            bounce_profile(spec, solution=sol, xi_halfwidth_walls=1e4)
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: sweep manifest / emulator identity / serve admission
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def bounce_emulator(tmp_path_factory, ref_solution):
+    """A tiny chain-mode emulator box built FROM the potential spec."""
+    from bdlz_tpu.emulator import AxisSpec, build_emulator
+
+    spec, _ = ref_solution
+    base = _cfg(lz_mode="chain", lz_n_levels=3, P_chi_to_B=0.1)
+    axes = {
+        "m_chi_GeV": AxisSpec(0.9, 1.1, 2, "log"),
+        "v_w": AxisSpec(0.25, 0.35, 3, "lin"),
+    }
+    out = str(tmp_path_factory.mktemp("bounce_emu") / "artifact")
+    artifact, report = build_emulator(
+        base, axes, rtol=1e-2, n_probe=4, n_holdout=8, max_rounds=1,
+        n_y=400, chunk_size=64, out_dir=out, require_converged=False,
+        bounce=spec,
+    )
+    return base, axes, artifact, report
+
+
+class TestEndToEnd:
+    def test_sweep_manifest_carries_potential_fingerprint(
+        self, tmp_path, ref_solution, ref_profile
+    ):
+        # same physics through both doors: a bounce sweep and a sweep
+        # of the pre-derived profile are BITWISE-equal in outputs, but
+        # their manifest hashes must DIFFER — the potential fingerprint
+        # joins the identity alongside the derived profile's own
+        import json
+
+        from bdlz_tpu.parallel import run_sweep
+
+        spec, _ = ref_solution
+        cfg = _cfg()
+        static = static_choices_from_config(cfg)
+        axes = {"v_w": np.linspace(0.2, 0.6, 6)}
+        out_b = str(tmp_path / "by_bounce")
+        out_p = str(tmp_path / "by_profile")
+        res_b = run_sweep(cfg, dict(axes), static, mesh=None, chunk_size=8,
+                          n_y=400, out_dir=out_b, keep_outputs=True,
+                          bounce=spec)
+        res_p = run_sweep(cfg, dict(axes), static, mesh=None, chunk_size=8,
+                          n_y=400, out_dir=out_p, keep_outputs=True,
+                          lz_profile=ref_profile)
+        assert res_b.n_failed == 0 and res_p.n_failed == 0
+        np.testing.assert_array_equal(
+            res_b.outputs["DM_over_B"], res_p.outputs["DM_over_B"]
+        )
+        with open(f"{out_b}/manifest.json") as f:
+            h_b = json.load(f)["hash"]
+        with open(f"{out_p}/manifest.json") as f:
+            h_p = json.load(f)["hash"]
+        assert h_b != h_p
+
+    def test_sweep_rejects_both_doors_at_once(self, ref_solution, ref_profile):
+        from bdlz_tpu.parallel import run_sweep
+
+        spec, _ = ref_solution
+        cfg = _cfg()
+        with pytest.raises(ValueError, match="not both"):
+            run_sweep(cfg, {"v_w": np.linspace(0.2, 0.6, 3)},
+                      static_choices_from_config(cfg), bounce=spec,
+                      lz_profile=ref_profile)
+
+    def test_build_guards(self, ref_solution, ref_profile):
+        from bdlz_tpu.emulator import AxisSpec, EmulatorBuildError, build_emulator
+
+        spec, _ = ref_solution
+        axes = {"v_w": AxisSpec(0.25, 0.35, 2, "lin")}
+        with pytest.raises(EmulatorBuildError, match="scenario lz_mode"):
+            build_emulator(_cfg(P_chi_to_B=0.1), axes, bounce=spec)
+        base = _cfg(lz_mode="chain", lz_n_levels=3, P_chi_to_B=0.1)
+        with pytest.raises(EmulatorBuildError, match="not both"):
+            build_emulator(base, axes, bounce=spec, lz_profile=ref_profile)
+        with pytest.raises(EmulatorBuildError, match="elastic"):
+            build_emulator(base, axes, bounce=spec,
+                           elastic={"m_chi_GeV": (0.9, 1.1)})
+
+    def test_artifact_identity_carries_both_fingerprints(
+        self, bounce_emulator, ref_solution, ref_profile
+    ):
+        spec, _ = ref_solution
+        _, _, artifact, _ = bounce_emulator
+        ident = dict(artifact.identity)
+        assert ident["bounce"] == potential_fingerprint(spec)
+        # the derived profile's array-level fingerprint rides alongside,
+        # so solver-knob drift changes the identity even at a fixed
+        # potential
+        assert ident["lz_profile"] == profile_fingerprint(ref_profile)
+
+    def test_serve_admission_checks_potential_fingerprint(
+        self, bounce_emulator, ref_solution, ref_profile
+    ):
+        from bdlz_tpu.serve.service import YieldService
+
+        spec, _ = ref_solution
+        base, _, artifact, _ = bounce_emulator
+        # matching potential: admitted (the spec is re-shot and the
+        # derived profile then passes the lz_profile fingerprint check)
+        YieldService(artifact, base, warm=False, bounce=spec)
+        # the pre-derived profile is an equally valid admission ticket
+        YieldService(artifact, base, warm=False, lz_profile=ref_profile)
+        # cross-potential skew: rejected loudly BEFORE any shoot
+        with pytest.raises(ValueError, match="does not match the potential"):
+            YieldService(artifact, base, warm=False,
+                         bounce=spec._replace(eps=0.049))
+        with pytest.raises(ValueError, match="not both"):
+            YieldService(artifact, base, warm=False, bounce=spec,
+                         lz_profile=ref_profile)
+
+    def test_serve_rejects_bounce_without_potential_on_record(
+        self, tmp_path, ref_solution, ref_profile
+    ):
+        # an artifact built from a CSV profile records NO potential —
+        # claiming one at admission time must fail, not silently pass
+        from bdlz_tpu.emulator import AxisSpec, build_emulator
+        from bdlz_tpu.serve.service import YieldService
+
+        spec, _ = ref_solution
+        base = _cfg(lz_mode="chain", lz_n_levels=3, P_chi_to_B=0.1)
+        axes = {
+            "m_chi_GeV": AxisSpec(0.9, 1.1, 2, "log"),
+            "v_w": AxisSpec(0.25, 0.35, 3, "lin"),
+        }
+        artifact, _ = build_emulator(
+            base, axes, rtol=1e-2, n_probe=4, n_holdout=8, max_rounds=1,
+            n_y=400, chunk_size=64, require_converged=False,
+            lz_profile=ref_profile,
+        )
+        assert "bounce" not in dict(artifact.identity)
+        with pytest.raises(ValueError, match="does not match the potential"):
+            YieldService(artifact, base, warm=False, bounce=spec)

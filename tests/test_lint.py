@@ -311,3 +311,24 @@ def test_gradient_and_nuts_modules_clean():
     assert report.files_scanned == 5
     offenders = "\n".join(f.render() for f in report.active)
     assert not report.active, f"gradient/NUTS-layer findings:\n{offenders}"
+
+
+def test_bounce_modules_clean():
+    """The in-framework bounce solver (docs/scenarios.md
+    "Potential-space axes"): shooting.py carries the jitted
+    fixed-lane-width vmapped program (prime R1/R2 surface — host np
+    padding/stacking next to traced xp segment math), potential.py the
+    dual-use V/V' operators + host Newton vacua, and bounce_cli.py the
+    operator surface — exactly the code the STATIC_PARAM_NAMES
+    additions (bounce/lane_width/n_segments/n_bisect/n_dense/n_xi/
+    rho_max) must keep out of tracer-analysis false positives.  All
+    pinned per-file at zero unsuppressed findings."""
+    report = lint_paths([
+        str(PACKAGE / "bounce" / "potential.py"),
+        str(PACKAGE / "bounce" / "shooting.py"),
+        str(PACKAGE / "bounce" / "__init__.py"),
+        str(PACKAGE / "bounce_cli.py"),
+    ])
+    assert report.files_scanned == 4
+    offenders = "\n".join(f.render() for f in report.active)
+    assert not report.active, f"bounce-solver findings:\n{offenders}"

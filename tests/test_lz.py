@@ -49,12 +49,27 @@ class TestProfileIO:
         with pytest.raises(ProfileError, match="at least 2"):
             load_profile_csv(str(p))
 
-    def test_unsorted_xi_sorted(self, tmp_path):
+    def test_unsorted_xi_raises_with_row_index(self, tmp_path):
+        # Silent argsort used to reorder (Δ, m_mix) against the caller's
+        # file; the contract is now strictly-increasing-or-loud.
         p = tmp_path / "u.csv"
         p.write_text("xi,delta,m_mix\n1.0,2.0,0.2\n-1.0,-2.0,0.1\n")
-        prof = load_profile_csv(str(p))
-        assert prof.xi.tolist() == [-1.0, 1.0]
-        assert prof.mix.tolist() == [0.1, 0.2]
+        with pytest.raises(ProfileError, match="data row 2"):
+            load_profile_csv(str(p))
+
+    def test_duplicate_xi_raises_with_row_index(self, tmp_path):
+        p = tmp_path / "d.csv"
+        p.write_text(
+            "xi,delta,m_mix\n0.0,-1.0,0.1\n1.0,0.0,0.1\n1.0,1.0,0.1\n2.0,2.0,0.1\n"
+        )
+        with pytest.raises(ProfileError, match="data row 3"):
+            load_profile_csv(str(p))
+
+    def test_single_row_names_offending_row(self, tmp_path):
+        p = tmp_path / "one.csv"
+        p.write_text("xi,delta,m_mix\n0.5,1.0,0.1\n")
+        with pytest.raises(ProfileError, match="data row 1"):
+            load_profile_csv(str(p))
 
 
 class TestCrossingFinder:
