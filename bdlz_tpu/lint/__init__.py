@@ -1,20 +1,35 @@
-"""bdlz-lint — JAX-aware static analysis for the dual-backend contract.
+"""bdlz-lint — JAX-aware static analysis for the repo's contracts.
 
 The package must stay bit-reproducible on the NumPy backend while being
 jit/pjit-safe on the TPU path, and the regressions that break that are
 silent: host ``np.`` calls leaking into jitted code, Python branches on
 tracers, host syncs in hot paths, magic-number drift in the physics
 layer, stray global JAX config writes, and jitted entry points missing
-their static/donate declarations. This package turns each class into a
-lintable rule (R1–R6, see :mod:`bdlz_tpu.lint.rules`) over stdlib
-``ast`` — no third-party dependencies — with per-line suppression
-(``# bdlz-lint: disable=R4``) and a JSON mode for tooling:
+their static/donate declarations — the per-file rules R1–R7.  On top of
+those, the KNOB CONTRACT that keeps result identities honest is policed
+whole-program (cross-file symbol table, :mod:`bdlz_tpu.lint.contracts`):
+identity-home coverage for every ``Config`` field (R8, the PR-7
+``quad_panel_gl`` silent-resume drift class), validation coverage (R9),
+tri-state resolver conformance (R10), CLI↔config parity (R11), and the
+jit-in-a-loop retrace hazard (R12).  Everything is stdlib ``ast`` — no
+third-party dependencies — with per-line suppression
+(``# bdlz-lint: disable=R4``), stale-suppression detection, a JSON mode
+and a SARIF 2.1.0 mode for tooling, and a content-hash-keyed run cache
+through the provenance store:
 
     python -m bdlz_tpu.lint bdlz_tpu/ --format json
+    python -m bdlz_tpu.lint --changed-only          # pre-commit path
+    python -m bdlz_tpu.lint --format sarif > lint.sarif
 
-Tier-1 pins ``bdlz_tpu/`` at zero unsuppressed findings
-(``tests/test_lint.py``); the runtime counterpart of this static pass is
-the ``--sanitize`` flag on the CLIs (:mod:`bdlz_tpu.sanitize`).
+Tier-1 pins ``bdlz_tpu/`` at zero unsuppressed findings and zero stale
+suppressions (``tests/test_lint.py``); the runtime counterpart of this
+static pass is the ``--sanitize`` flag on the CLIs
+(:mod:`bdlz_tpu.sanitize`).  Rule table: docs/static_analysis.md.
 """
-from bdlz_tpu.lint.analyzer import LintReport, lint_paths, lint_source  # noqa: F401
+from bdlz_tpu.lint.analyzer import (  # noqa: F401
+    LintReport,
+    StaleSuppression,
+    lint_paths,
+    lint_source,
+)
 from bdlz_tpu.lint.rules import RULES, Finding, Rule  # noqa: F401

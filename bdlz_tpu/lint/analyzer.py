@@ -194,6 +194,20 @@ STATIC_PARAM_NAMES = {
     "fuse_exp",
     "reduce",
     "mesh",
+    # Config structural knobs mirrored in StaticChoices (config.py): the
+    # ODE-engine selectors and the quadrature tri-state are resolved to
+    # concrete host values BEFORE trace (engine_statics_for), and the
+    # depletion switch picks which kernel is built.  tests/test_lint.py
+    # pins that this set covers every StaticChoices field, so a new
+    # static knob cannot forget this += step.
+    "deplete_DM_from_source",
+    "ode_method",
+    "ode_rtol",
+    "ode_atol",
+    "ode_auto_h0",
+    "ode_pi_controller",
+    "ode_tabulated_av",
+    "quad_panel_gl",
 }
 
 #: R6 only hints on the names that are *always* structural in this repo.
@@ -248,6 +262,7 @@ class TraceSite:
     has_static: bool = False
     has_donate: bool = False
     decorated: Optional[FunctionInfo] = None  # decorator form
+    bound_name: Optional[str] = None  # `compiled = jax.jit(f)` binding
 
 
 class ModuleInfo:
@@ -312,6 +327,10 @@ class _Collector(ast.NodeVisitor):
     def __init__(self, mod: ModuleInfo) -> None:
         self.mod = mod
         self.stack: List[FunctionInfo] = []
+        # (id(value expr), target name) of the innermost simple
+        # assignment being visited, so `compiled = jax.jit(f)` records
+        # the binding on its TraceSite (R12 needs the call-site name)
+        self._pending_assign: Optional[Tuple[int, str]] = None
 
     # -- imports / aliases ------------------------------------------------
     def visit_Import(self, node: ast.Import) -> None:
@@ -349,7 +368,11 @@ class _Collector(ast.NodeVisitor):
                 self.mod.from_alias[node.targets[0].id] = tuple(
                     canon.rsplit(".", 1)
                 ) if "." in canon else (canon, "")
+        prev = self._pending_assign
+        if len(node.targets) == 1 and isinstance(node.targets[0], ast.Name):
+            self._pending_assign = (id(node.value), node.targets[0].id)
         self.generic_visit(node)
+        self._pending_assign = prev
 
     # -- functions --------------------------------------------------------
     def _visit_func(self, node) -> None:
@@ -435,6 +458,8 @@ class _Collector(ast.NodeVisitor):
             else None,
             scope=scope,
         )
+        if self._pending_assign and self._pending_assign[0] == id(node):
+            site.bound_name = self._pending_assign[1]
         self._read_jit_kwargs(node, site)
         self.mod.trace_sites.append(site)
 
@@ -903,14 +928,156 @@ def _emit_r6(project: Project, mod: ModuleInfo, findings: List[Finding],
             )
 
 
+class _R12Walker(ast.NodeVisitor):
+    """R12 — jitted callable re-invoked in a Python loop with a varying
+    structural argument.
+
+    The collector records the name each ``JIT_WRAPPERS`` site is bound
+    to (``compiled = jax.jit(f)``) or decorates; this walker tracks the
+    active ``for``-loop targets and flags a call through one of those
+    names whose ``STATIC_PARAM_NAMES``-named argument references a loop
+    variable without being declared static at the jit site — every
+    iteration presents a new static value, so every iteration
+    recompiles (the Pallas compile-churn class).
+    """
+
+    def __init__(
+        self,
+        mod: ModuleInfo,
+        sites: Dict[str, Tuple[TraceSite, Optional[FunctionInfo]]],
+        findings: List[Finding],
+    ) -> None:
+        self.mod = mod
+        self.sites = sites
+        self.findings = findings
+        self.loop_vars: List[Set[str]] = []
+
+    @staticmethod
+    def _target_names(target: ast.AST) -> Set[str]:
+        names: Set[str] = set()
+        for sub in ast.walk(target):
+            if isinstance(sub, ast.Name):
+                names.add(sub.id)
+        return names
+
+    def _active(self) -> Set[str]:
+        out: Set[str] = set()
+        for frame in self.loop_vars:
+            out |= frame
+        return out
+
+    def _varying(self, expr: ast.AST) -> Optional[str]:
+        active = self._active()
+        for sub in ast.walk(expr):
+            if isinstance(sub, ast.Name) and sub.id in active:
+                return sub.id
+        return None
+
+    def visit_For(self, node: ast.For) -> None:
+        self.loop_vars.append(self._target_names(node.target))
+        for child in node.body:
+            self.visit(child)
+        self.loop_vars.pop()
+        for child in node.orelse:
+            self.visit(child)
+
+    visit_AsyncFor = visit_For
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if (
+            self.loop_vars
+            and isinstance(node.func, ast.Name)
+            and node.func.id in self.sites
+        ):
+            site, target = self.sites[node.func.id]
+            covered = set(site.static_names)
+            if target is not None:
+                for pos in site.static_positions:
+                    if 0 <= pos < len(target.params):
+                        covered.add(target.params[pos])
+            hazards: List[Tuple[str, str]] = []
+            for kw in node.keywords:
+                if kw.arg and kw.arg in STATIC_PARAM_NAMES and (
+                    kw.arg not in covered
+                ):
+                    loop_var = self._varying(kw.value)
+                    if loop_var:
+                        hazards.append((kw.arg, loop_var))
+            if target is not None:
+                for i, arg in enumerate(node.args):
+                    if i >= len(target.params):
+                        break
+                    param = target.params[i]
+                    if param in STATIC_PARAM_NAMES and param not in covered:
+                        loop_var = self._varying(arg)
+                        if loop_var:
+                            hazards.append((param, loop_var))
+            for param, loop_var in hazards:
+                self.findings.append(
+                    Finding(
+                        path=self.mod.path,
+                        line=node.lineno,
+                        col=node.col_offset,
+                        rule="R12",
+                        message=(
+                            f"jitted `{node.func.id}` called in a Python "
+                            f"loop with structural argument `{param}` "
+                            f"varying over loop variable `{loop_var}` — "
+                            "recompiles every iteration"
+                        ),
+                    )
+                )
+        self.generic_visit(node)
+
+
+def _emit_r12(project: Project, mod: ModuleInfo, findings: List[Finding],
+              selected: Set[str]) -> None:
+    if "R12" not in selected:
+        return
+    sites: Dict[str, Tuple[TraceSite, Optional[FunctionInfo]]] = {}
+    for site in mod.trace_sites:
+        if site.wrapper not in JIT_WRAPPERS:
+            continue
+        target = site.decorated
+        if target is None and site.target_name:
+            target = project.resolve_bare(mod, site.target_name, site.scope)
+        name = site.bound_name or (
+            site.decorated.name if site.decorated is not None else None
+        )
+        if name:
+            sites[name] = (site, target)
+    if sites:
+        _R12Walker(mod, sites, findings).visit(mod.tree)
+
+
 # ---------------------------------------------------------------------------
 # driver
+
+
+@dataclass
+class StaleSuppression:
+    """A ``# bdlz-lint: disable=Rx`` comment that suppresses nothing."""
+
+    path: str
+    line: int
+    rule: str  # the stale id from the comment ("all" included)
+
+    def render(self) -> str:
+        return (
+            f"{self.path}:{self.line}: stale suppression "
+            f"`bdlz-lint: disable={self.rule}` — no {self.rule} finding "
+            "on this line; delete the comment"
+        )
+
+    def to_dict(self) -> dict:
+        return {"path": self.path, "line": self.line, "rule": self.rule}
 
 
 @dataclass
 class LintReport:
     findings: List[Finding]
     files_scanned: int
+    stale_suppressions: List[StaleSuppression] = field(default_factory=list)
 
     @property
     def active(self) -> List[Finding]:
@@ -920,6 +1087,27 @@ class LintReport:
     def suppressed(self) -> List[Finding]:
         return [f for f in self.findings if f.suppressed]
 
+    def restrict_to(self, paths: Sequence[str]) -> "LintReport":
+        """Report view filtered to ``paths`` (for ``--changed-only``).
+
+        The ANALYSIS always runs whole-program — a changed config.py can
+        break a contract whose finding lands in an unchanged CLI module,
+        so restriction is a reporting concern only, applied after the
+        full cross-file pass.
+        """
+        keep = {os.path.abspath(p) for p in paths}
+        return LintReport(
+            findings=[
+                f for f in self.findings if os.path.abspath(f.path) in keep
+            ],
+            files_scanned=self.files_scanned,
+            stale_suppressions=[
+                s
+                for s in self.stale_suppressions
+                if os.path.abspath(s.path) in keep
+            ],
+        )
+
     def to_dict(self) -> dict:
         counts: Dict[str, int] = {}
         for f in self.active:
@@ -928,8 +1116,12 @@ class LintReport:
             "files_scanned": self.files_scanned,
             "n_findings": len(self.active),
             "n_suppressed": len(self.suppressed),
+            "n_stale_suppressions": len(self.stale_suppressions),
             "counts_by_rule": counts,
             "findings": [f.to_dict() for f in self.findings],
+            "stale_suppressions": [
+                s.to_dict() for s in self.stale_suppressions
+            ],
             "rules": {
                 rid: {"title": r.title, "hint": r.hint}
                 for rid, r in RULES.items()
@@ -989,18 +1181,57 @@ def lint_source(source: str, path: str = "<memory>",
 
 
 def _run(modules: List[ModuleInfo], selected: Set[str]) -> LintReport:
+    # deferred import: contracts needs nothing from this module, but the
+    # package re-exports both and load order should not matter
+    from bdlz_tpu.lint.contracts import emit_contract_findings
+
     project = Project(modules)
     reachable = project.reachable_from_trace_sites()
     findings: List[Finding] = []
     for mod in modules:
         _RulePass(project, mod, reachable, findings, selected).visit(mod.tree)
         _emit_r6(project, mod, findings, selected)
+        _emit_r12(project, mod, findings, selected)
+    emit_contract_findings(project, findings, selected)
     for f in findings:
         rules_off = modules_suppressions(project, f)
         if "all" in rules_off or f.rule in rules_off:
             f.suppressed = True
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
-    return LintReport(findings=findings, files_scanned=len(modules))
+    return LintReport(
+        findings=findings,
+        files_scanned=len(modules),
+        stale_suppressions=_stale_suppressions(modules, findings, selected),
+    )
+
+
+def _stale_suppressions(
+    modules: List[ModuleInfo], findings: List[Finding], selected: Set[str]
+) -> List[StaleSuppression]:
+    """Suppression comments that no longer suppress any finding.
+
+    A rule id is only judged when it was part of this run (``R4`` can't
+    be called stale by a run that never evaluated R4); ``disable=all``
+    is only judged on a full-rule-set run.  Unknown rule ids are always
+    stale — they never suppressed anything.
+    """
+    present: Dict[Tuple[str, int], Set[str]] = {}
+    for f in findings:
+        present.setdefault((f.path, f.line), set()).add(f.rule)
+    full_run = selected >= set(RULES)
+    stale: List[StaleSuppression] = []
+    for mod in modules:
+        for line, ids in sorted(mod.suppressions.items()):
+            hit = present.get((mod.path, line), set())
+            for rid in sorted(ids):
+                if rid == "all":
+                    if full_run and not hit:
+                        stale.append(StaleSuppression(mod.path, line, rid))
+                elif rid not in RULES:
+                    stale.append(StaleSuppression(mod.path, line, rid))
+                elif rid in selected and rid not in hit:
+                    stale.append(StaleSuppression(mod.path, line, rid))
+    return stale
 
 
 def modules_suppressions(project: Project, f: Finding) -> Set[str]:

@@ -67,6 +67,51 @@ _RULE_LIST = (
         "deterministically; a sleep=time.sleep default-arg REFERENCE "
         "is the sanctioned pattern",
     ),
+    Rule(
+        "R8",
+        "Config field with zero or two identity homes",
+        "every Config field joins result identity through exactly one "
+        "home: the shared config payload (config_identity_dict), an "
+        "explicit identity key (provenance/identity.py, hash_extra, "
+        "build_identity) or StaticChoices membership for tri-state "
+        "knobs, OR membership in exactly one *_CONFIG_FIELDS exclusion "
+        "tuple that config_identity_dict consults — the PR-7 "
+        "quad_panel_gl silent-resume drift is exactly the zero-home "
+        "class",
+    ),
+    Rule(
+        "R9",
+        "Config field with no validate() check and no exemption",
+        "check the field in config.validate() or list it in "
+        "VALIDATION_EXEMPT_FIELDS with a justification — a knob the "
+        "schema accepts but nothing bounds fails three layers later "
+        "with a worse message",
+    ),
+    Rule(
+        "R10",
+        "direct truthiness test on a tri-state (None/bool) knob",
+        "None means 'engine decides', not False: route the knob "
+        "through its sanctioned resolver (resolve_* seam) or compare "
+        "explicitly (is None / is True / is False) — a bare truth "
+        "test silently collapses the tri-state",
+    ),
+    Rule(
+        "R11",
+        "CLI flag without a config twin, or serving knob without a flag",
+        "a driver flag's dest must name its Config field (or a "
+        "declared alias / operational-flag entry in lint.contracts), "
+        "and every SERVE/SCENARIO/SAMPLER config knob must be "
+        "reachable from some driver flag — orphans drift",
+    ),
+    Rule(
+        "R12",
+        "jitted callable re-invoked in a Python loop with a varying "
+        "structural argument",
+        "a STATIC_PARAM_NAMES argument that changes per iteration and "
+        "is not declared static recompiles the kernel every pass (the "
+        "Pallas compile-churn class) — declare it via "
+        "static_argnames, or hoist it out of the loop",
+    ),
 )
 
 RULES = {r.id: r for r in _RULE_LIST}

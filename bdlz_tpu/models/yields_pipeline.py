@@ -76,7 +76,7 @@ def point_yields(
     only the audited sweep layers resolve the tri-state on).
     """
     grid = KJMAGrid(*(xp.asarray(a) for a in grid))
-    if static.quad_panel_gl:
+    if static.quad_panel_gl is True:
         from bdlz_tpu.solvers.panels import integrate_YB_panel_gl
 
         Y_B = integrate_YB_panel_gl(
@@ -111,7 +111,7 @@ def point_yields_fast(
     default stays on the trapezoid — resolution happens in the audited
     sweep layers, never implicitly here.
     """
-    if static.quad_panel_gl:
+    if static.quad_panel_gl is True:
         from bdlz_tpu.solvers.panels import integrate_YB_panel_gl
 
         Y_B = integrate_YB_panel_gl(

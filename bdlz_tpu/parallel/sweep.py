@@ -162,7 +162,7 @@ def engine_identity_extra(
     scen = scenario_identity(static)
     if scen is not None:
         extra["lz_scenario"] = scen
-    if impl == "tabulated" and static.quad_panel_gl:
+    if impl == "tabulated" and static.quad_panel_gl is True:
         from bdlz_tpu.solvers.panels import (
             N_PANELS_DEFAULT,
             NODES_PER_PANEL_DEFAULT,
@@ -648,7 +648,7 @@ def _resolved_quad_nodes(static: StaticChoices, impl: str) -> "int | None":
     tri-state must already be resolved (True) by the caller for this to
     report a count — an unresolved None means the bit-pinned trapezoid.
     """
-    if impl == "tabulated" and static.quad_panel_gl:
+    if impl == "tabulated" and static.quad_panel_gl is True:
         from bdlz_tpu.solvers.panels import (
             N_PANELS_DEFAULT,
             NODES_PER_PANEL_DEFAULT,

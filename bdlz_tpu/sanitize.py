@@ -72,7 +72,7 @@ def is_enabled() -> bool:
 def _host_view(value: Any):
     """A host ndarray view of ``value``, or None for traced/abstract values."""
     try:
-        return np.asarray(value)  # bdlz-lint: disable=R1,R3 — the sanitizer's job is this host sync
+        return np.asarray(value)  # bdlz-lint: disable=R1 — the sanitizer's job is this host sync
     except Exception:
         return None  # tracers carry no data; jax_debug_nans covers them
 

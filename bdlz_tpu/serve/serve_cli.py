@@ -53,6 +53,7 @@ artifact fails HERE, loudly, not in a served number.
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import json
 import sys
 import time
@@ -133,6 +134,44 @@ def main(argv: Optional[list] = None) -> int:
                          "(--replicas only; docs/robustness.md): auto "
                          "= the config tri-state (fleet default ON), "
                          "off = the pre-health byte-identical behavior")
+    # ---- serving knobs with Config twins (dest == the Config field
+    # name: the bdlz-lint R11 CLI-parity contract).  Unset flags keep
+    # the config JSON's value — the flag surface is a strict per-run
+    # override, folded over the loaded config and re-validated below.
+    ap.add_argument("--breaker-window", type=int, default=None,
+                    dest="breaker_window",
+                    help="circuit-breaker sliding-window length in "
+                         "per-replica batch outcomes (default: config)")
+    ap.add_argument("--breaker-threshold", type=float, default=None,
+                    dest="breaker_threshold",
+                    help="bad-outcome fraction of the window that opens "
+                         "a replica's breaker (default: config)")
+    ap.add_argument("--breaker-cooldown-s", type=float, default=None,
+                    dest="breaker_cooldown_s",
+                    help="seconds an open breaker cools down before a "
+                         "half-open probe batch (default: config)")
+    ap.add_argument("--breaker-latency-slo-s", type=float, default=None,
+                    dest="breaker_latency_slo_s",
+                    help="per-batch latency SLO scored as a bad outcome "
+                         "when breached (default: config; config None = "
+                         "latency not scored)")
+    ap.add_argument("--rollback-budget", type=float, default=None,
+                    dest="rollback_budget",
+                    help="post-cutover bad-request fraction that triggers "
+                         "rollout auto-rollback (default: config)")
+    ap.add_argument("--tenant-routing", default=None, dest="tenant_routing",
+                    choices=("scenario", "hash"),
+                    help="multi-tenant routing-tag policy (--tenant-map "
+                         "only; default: config, whose None lets the "
+                         "engine decide)")
+    ap.add_argument("--autoscale-interval-s", type=float, default=None,
+                    dest="autoscale_interval_s",
+                    help="seconds between autoscaler rebalance passes "
+                         "(--tenant-map only; default: config)")
+    ap.add_argument("--pool-min-replicas", type=int, default=None,
+                    dest="pool_min_replicas",
+                    help="autoscaler floor: minimum replicas per resident "
+                         "pool (--tenant-map only; default: config)")
     ap.add_argument("--tenant-map", default=None, dest="tenant_map",
                     help="multi-tenant plane (serve/tenancy.py): JSON "
                          "text or path mapping scenario labels to "
@@ -175,6 +214,19 @@ def main(argv: Optional[list] = None) -> int:
 
     event_log = EventLog(path=args.events) if args.events else EventLog()
     base = validate(load_config(args.config))
+    overrides = {
+        k: getattr(args, k)
+        for k in (
+            "breaker_window", "breaker_threshold", "breaker_cooldown_s",
+            "breaker_latency_slo_s", "rollback_budget", "tenant_routing",
+            "autoscale_interval_s", "pool_min_replicas",
+        )
+        if getattr(args, k) is not None
+    }
+    if overrides:
+        # re-validate: a flag value gets exactly the checks a config
+        # value would (bad overrides fail here, not mid-serve)
+        base = validate(dataclasses.replace(base, **overrides))
     if args.tenant_map is not None:
         return _serve_tenant(args, ap, base, event_log)
     if args.artifact is None:

@@ -1,11 +1,22 @@
 #!/usr/bin/env bash
 # The repo's one lint command: ruff (pycodestyle/pyflakes baseline, config
-# in pyproject.toml) + bdlz-lint (the JAX-aware R1-R6 pass over bdlz_tpu/).
-# Exit 0 only when both passes are clean; a missing ruff binary downgrades
-# the style baseline to a warning (this container doesn't ship it) rather
-# than masking the bdlz-lint result.
+# in pyproject.toml) + bdlz-lint (the JAX-aware R1-R7 pass plus the
+# whole-program knob-contract rules R8-R12 over bdlz_tpu/, see
+# docs/static_analysis.md).  Exit 0 only when both passes are clean; a
+# missing ruff binary downgrades the style baseline to a warning (this
+# container doesn't ship it) rather than masking the bdlz-lint result.
+#
+# Default is the fast pre-commit path: the ANALYSIS always runs
+# whole-program (the contract rules are cross-file), but findings are
+# REPORTED only for git-changed files (--changed-only).  Pass --all for
+# the full report — scripts/tier1.sh uses that for the PR gate.
 set -u
 cd "$(dirname "$0")/.."
+
+scope="--changed-only"
+if [ "${1:-}" = "--all" ]; then
+    scope=""
+fi
 
 rc=0
 
@@ -22,7 +33,8 @@ fi
 # tests/test_lint.py additionally pins those two packages per-file) and
 # the provenance package (host-side identity/store code — pinned
 # per-file in test_lint.py so cache plumbing stays out of jit paths)
-echo "[lint] python -m bdlz_tpu.lint bdlz_tpu/"
-python -m bdlz_tpu.lint bdlz_tpu/ || rc=1
+echo "[lint] python -m bdlz_tpu.lint bdlz_tpu/ ${scope}"
+# shellcheck disable=SC2086 — $scope is deliberately word-split
+python -m bdlz_tpu.lint bdlz_tpu/ ${scope} || rc=1
 
 exit $rc

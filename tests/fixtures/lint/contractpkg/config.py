@@ -1,0 +1,54 @@
+"""bdlz-lint contract fixture: the Config half of a two-module package.
+
+Never imported; parsed by the analyzer only (tests/test_lint.py).  Seeds
+exactly one violation each for R8 and R9; the identity constructor the
+clean fields rely on lives in the SIBLING module (identity.py), so these
+findings exercise the cross-file symbol table, not a per-file pass.
+"""
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+REFERENCE_KEYS = ("T_p_GeV",)
+
+#: Orchestration knobs with their one identity home: this tuple.
+ROBUSTNESS_CONFIG_FIELDS = ("fault_injection",)
+
+
+@dataclass
+class Config:
+    T_p_GeV: float = 100.0
+    n_levels: int = 2
+    # clean tri-state: its identity home is the "seam_split" key the
+    # SIBLING module's constructor (identity.py) folds into hash_extra
+    # — resolvable only through the cross-file symbol table
+    seam_split: Optional[bool] = None
+    # clean tri-state: excluded (ROBUSTNESS_CONFIG_FIELDS) + exempt
+    fault_injection: Optional[bool] = None
+    # R8 (seeded): the PR-7 drift class — a tri-state knob with ZERO
+    # identity homes (not an identity key, not excluded, no
+    # StaticChoices berth): a resumed run silently reuses results
+    # computed under the other resolution
+    quad_panel_gl: Optional[bool] = None
+    # R9 (seeded): accepted by the schema, bounded nowhere
+    mystery_knob: float = 1.0
+
+
+#: R9 allowlist: fields validate() trusts as-given, on purpose.
+VALIDATION_EXEMPT_FIELDS = ("seam_split", "fault_injection", "quad_panel_gl")
+
+
+def validate(cfg: Config) -> Config:
+    if cfg.T_p_GeV <= 0.0:
+        raise ValueError("T_p_GeV must be positive")
+    if cfg.n_levels < 2:
+        raise ValueError("n_levels needs at least two levels")
+    return cfg
+
+
+def config_identity_dict(cfg: Config) -> Dict[str, Any]:
+    out: Dict[str, Any] = {k: getattr(cfg, k) for k in REFERENCE_KEYS}
+    for k, v in vars(cfg).items():
+        if k in REFERENCE_KEYS or k in ROBUSTNESS_CONFIG_FIELDS:
+            continue
+        out[k] = v
+    return out

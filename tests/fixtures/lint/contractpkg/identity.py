@@ -1,0 +1,20 @@
+"""bdlz-lint contract fixture: the identity half of the package.
+
+The ``seam_split`` key below is the ONE identity home of the sibling
+config.py's ``seam_split`` tri-state — the analyzer can only connect
+the two through its cross-file symbol table.  ``quad_panel_gl`` is
+deliberately absent: that is the seeded R8 drift.
+"""
+import hashlib
+import json
+
+
+def build_identity(cfg) -> str:
+    hash_extra = {
+        "seam_split": cfg.seam_split,
+        "n_levels": cfg.n_levels,
+    }
+    payload = {"T_p_GeV": cfg.T_p_GeV, **hash_extra}
+    return hashlib.sha256(
+        json.dumps(payload, sort_keys=True).encode()
+    ).hexdigest()

@@ -1,0 +1,44 @@
+"""bdlz-lint contract fixture: the driver half of the package.
+
+Seeds exactly one R11 violation (``--mystery-flag`` has no Config twin,
+no alias, no operational-dest entry) next to a clean structurally-named
+flag; seeds exactly one R10 violation (direct truthiness on the
+``seam_split`` tri-state outside a resolver) and one R12 violation (the
+jitted kernel re-invoked in a loop with a varying structural argument).
+Never imported; parsed by the analyzer only (tests/test_lint.py).
+"""
+import argparse
+
+import jax
+
+
+def make_parser():
+    ap = argparse.ArgumentParser()
+    # clean: dest names its Config twin
+    ap.add_argument("--t-p-gev", type=float, dest="T_p_GeV")
+    # R11 (seeded): no twin, no alias, not declared operational
+    ap.add_argument("--mystery-flag", type=float, dest="mystery_flag")
+    return ap
+
+
+def pick_seam(cfg):
+    # R10 (seeded): None ("engine decides") collapses to False here
+    if cfg.seam_split:
+        return "split"
+    return "single"
+
+
+def kernel(x, n_levels):
+    return x * n_levels
+
+
+compiled = jax.jit(kernel)
+
+
+def churn(x, levels):
+    out = []
+    for n in levels:
+        # R12 (seeded): structural argument varies per iteration and is
+        # not declared static at the jit site — recompiles every pass
+        out.append(compiled(x, n_levels=n))
+    return out

@@ -2,17 +2,20 @@
 
 Two directions, both load-bearing:
 
-* the package itself must stay at ZERO unsuppressed findings — every
-  rule-class regression (host np in jit, tracer branches, host syncs,
-  magic floats, stray config writes, missing static_argnums) becomes a
-  CI failure from now on;
+* the package itself must stay at ZERO unsuppressed findings (and zero
+  stale suppression comments) — every rule-class regression (host np in
+  jit, tracer branches, host syncs, magic floats, stray config writes,
+  missing static_argnums, knob-contract drift R8–R12) becomes a CI
+  failure from now on;
 * the analyzer must actually catch each class: a fixture with one
-  seeded violation per rule must trip all six.
+  seeded violation per rule must trip all of R1–R7 (per-file) and
+  R8–R12 (the cross-file contract fixture package).
 """
 import json
 import pathlib
 import subprocess
 import sys
+import textwrap
 
 from bdlz_tpu.lint import RULES, lint_paths, lint_source
 
@@ -22,6 +25,10 @@ FIXTURE = (
     REPO_ROOT / "tests" / "fixtures" / "lint" / "physics"
     / "seeded_violations.py"
 )
+CONTRACT_FIXTURE = REPO_ROOT / "tests" / "fixtures" / "lint" / "contractpkg"
+
+PER_FILE_RULES = {"R1", "R2", "R3", "R4", "R5", "R6", "R7"}
+CONTRACT_RULES = {"R8", "R9", "R10", "R11", "R12"}
 
 
 def _run_cli(*argv: str) -> subprocess.CompletedProcess:
@@ -39,6 +46,8 @@ def test_package_has_zero_unsuppressed_findings():
     assert report.files_scanned > 40
     offenders = "\n".join(f.render() for f in report.active)
     assert not report.active, f"unsuppressed bdlz-lint findings:\n{offenders}"
+    stale = "\n".join(s.render() for s in report.stale_suppressions)
+    assert not report.stale_suppressions, f"stale suppressions:\n{stale}"
 
 
 def test_cli_exits_zero_on_package():
@@ -47,11 +56,34 @@ def test_cli_exits_zero_on_package():
 
 
 def test_fixture_trips_every_rule():
-    report = lint_paths([str(FIXTURE)])
+    # the per-file fixture trips R1-R7; the contract fixture package
+    # (cross-file: config + identity constructor + driver) trips R8-R12
+    # — one lint run over both must trip the FULL rule table
+    report = lint_paths([str(FIXTURE), str(CONTRACT_FIXTURE)])
     tripped = {f.rule for f in report.active}
     assert tripped == set(RULES), (
         f"expected all of {sorted(RULES)}, got {sorted(tripped)}"
     )
+
+
+def test_contract_fixture_one_seeded_violation_per_new_rule():
+    report = lint_paths([str(CONTRACT_FIXTURE)])
+    by_rule = {}
+    for f in report.active:
+        by_rule.setdefault(f.rule, []).append(f)
+    assert {r: len(fs) for r, fs in by_rule.items()} == {
+        r: 1 for r in CONTRACT_RULES
+    }, "\n".join(f.render() for f in report.active)
+    # the R8 finding IS the PR-7 drift class, caught statically: the
+    # quad_panel_gl tri-state with no identity home would let a flipped
+    # resolution silently resume results computed under the other one
+    (r8,) = by_rule["R8"]
+    assert "quad_panel_gl" in r8.message
+    assert r8.path.endswith("config.py")
+    # R10/R11/R12 land in the driver module, R8/R9 in the config module
+    # — the pass is genuinely cross-file, not per-file
+    assert {by_rule[r][0].path.endswith("tool_cli.py")
+            for r in ("R10", "R11", "R12")} == {True}
 
 
 def test_cli_exits_nonzero_on_fixture_with_json_report():
@@ -59,7 +91,7 @@ def test_cli_exits_nonzero_on_fixture_with_json_report():
     assert proc.returncode == 1, proc.stdout + proc.stderr
     payload = json.loads(proc.stdout)
     assert payload["n_findings"] == 7
-    assert set(payload["counts_by_rule"]) == set(RULES)
+    assert set(payload["counts_by_rule"]) == PER_FILE_RULES
     assert all(
         {"path", "line", "col", "rule", "message", "hint", "suppressed"}
         <= set(f)
@@ -74,7 +106,7 @@ def test_per_line_suppression_syntax():
         "y = np.asarray(x)  # bdlz-lint: disable=R1",
     )
     report = lint_source(suppressed, path="physics/seeded_variant.py")
-    assert {f.rule for f in report.active} == set(RULES) - {"R1"}
+    assert {f.rule for f in report.active} == PER_FILE_RULES - {"R1"}
     assert [f.rule for f in report.suppressed] == ["R1"]
 
     all_off = "\n".join(
@@ -332,3 +364,228 @@ def test_bounce_modules_clean():
     assert report.files_scanned == 4
     offenders = "\n".join(f.render() for f in report.active)
     assert not report.active, f"bounce-solver findings:\n{offenders}"
+
+
+# ---------------------------------------------------------------------------
+# v2: knob-contract analyzer (R8-R12), stale suppressions, SARIF, cache
+
+
+def test_static_param_names_cover_every_static_choices_field():
+    """Auto-derived pin: a new StaticChoices field cannot forget the
+    manual STATIC_PARAM_NAMES += step (the field would start tripping
+    R2/R6 false positives in every consumer) — and no tracer-valued
+    PointParams field may ever leak INTO the static set, which would
+    exempt real physics inputs from the tracer rules."""
+    from bdlz_tpu.config import PointParams, StaticChoices
+    from bdlz_tpu.lint.analyzer import STATIC_PARAM_NAMES
+
+    missing = set(StaticChoices._fields) - STATIC_PARAM_NAMES
+    assert not missing, (
+        f"StaticChoices fields missing from STATIC_PARAM_NAMES: "
+        f"{sorted(missing)}"
+    )
+    leaked = set(PointParams._fields) & STATIC_PARAM_NAMES
+    assert not leaked, (
+        f"tracer-valued PointParams fields in STATIC_PARAM_NAMES: "
+        f"{sorted(leaked)}"
+    )
+
+
+def test_stale_suppression_detected_and_fails_cli(tmp_path):
+    # a disable comment on a clean line suppresses nothing -> reported
+    # as stale, and the CLI exits nonzero on it even with 0 findings
+    clean = "def f():\n    return 1  # bdlz-lint: disable=R4\n"
+    report = lint_source(clean, path="ops/clean.py")
+    assert not report.active
+    assert [(s.rule, s.line) for s in report.stale_suppressions] == [
+        ("R4", 2)
+    ]
+    mod = tmp_path / "clean.py"
+    mod.write_text(clean)
+    proc = _run_cli(str(mod))
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "stale suppression" in proc.stdout
+
+
+def test_live_suppression_is_not_stale():
+    source = FIXTURE.read_text().replace(
+        "y = np.asarray(x)",
+        "y = np.asarray(x)  # bdlz-lint: disable=R1",
+    )
+    report = lint_source(source, path="physics/seeded_variant.py")
+    assert not report.stale_suppressions
+    # an unknown rule id never suppresses anything -> always stale
+    report = lint_source(
+        "x = 1  # bdlz-lint: disable=R99\n", path="ops/clean.py"
+    )
+    assert [s.rule for s in report.stale_suppressions] == ["R99"]
+
+
+def test_rule_subset_does_not_misreport_other_rules_as_stale():
+    # a live R1 suppression must not be called stale by a run that
+    # never evaluated R1
+    source = FIXTURE.read_text().replace(
+        "y = np.asarray(x)",
+        "y = np.asarray(x)  # bdlz-lint: disable=R1",
+    )
+    report = lint_source(source, path="physics/seeded_variant.py",
+                         rules=["R5"])
+    assert not report.stale_suppressions
+
+
+_CROSSFILE_CONFIG = textwrap.dedent(
+    """
+    from dataclasses import dataclass
+    from typing import Optional
+
+    REFERENCE_KEYS = ("x0",)
+    {tuples}
+
+    @dataclass
+    class Config:
+        x0: float = 1.0
+        tri: Optional[bool] = None
+    """
+)
+
+_CROSSFILE_IDENTITY = textwrap.dedent(
+    """
+    def build_identity(cfg):
+        hash_extra = {extra}
+        return repr(sorted(hash_extra.items()))
+    """
+)
+
+
+def _crossfile_r8(tmp_path, name, tuples, extra):
+    pkg = tmp_path / name
+    pkg.mkdir()
+    (pkg / "config.py").write_text(
+        _CROSSFILE_CONFIG.format(tuples=tuples)
+    )
+    (pkg / "identity.py").write_text(
+        _CROSSFILE_IDENTITY.format(extra=extra)
+    )
+    report = lint_paths([str(pkg)], rules=["R8"])
+    return [f for f in report.active if f.rule == "R8"]
+
+
+def test_cross_file_symbol_table_exactly_one_home_is_clean(tmp_path):
+    # the tri-state's one home is the hash_extra key in the SIBLING
+    # module — connecting them requires the cross-file symbol table
+    findings = _crossfile_r8(
+        tmp_path, "clean_pkg",
+        tuples="",
+        extra='{"tri": cfg.tri}',
+    )
+    assert findings == []
+
+
+def test_cross_file_symbol_table_zero_homes_is_the_drift_class(tmp_path):
+    # same two modules, identity key removed: zero homes -> the PR-7
+    # silent-resume drift class, caught statically
+    findings = _crossfile_r8(
+        tmp_path, "zero_home_pkg",
+        tuples="",
+        extra='{"unrelated": 1}',
+    )
+    assert len(findings) == 1
+    assert "tri" in findings[0].message
+    assert "no identity home" in findings[0].message
+
+
+def test_cross_file_symbol_table_two_exclusion_sets_is_a_finding(tmp_path):
+    # membership in TWO exclusion tuples: two subsystems claim the
+    # knob -> finding even though an identity key also exists
+    findings = _crossfile_r8(
+        tmp_path, "two_home_pkg",
+        tuples=(
+            'A_CONFIG_FIELDS = ("tri",)\n'
+            'B_CONFIG_FIELDS = ("tri",)'
+        ),
+        extra='{"tri": cfg.tri}',
+    )
+    assert len(findings) == 1
+    assert "two exclusion tuples" in findings[0].message
+
+
+def test_r12_not_tripped_when_declared_static_or_loop_invariant():
+    base = (
+        "import jax\n"
+        "def kernel(x, n_levels):\n"
+        "    return x * n_levels\n"
+        "compiled = jax.jit(kernel{static})\n"
+        "def churn(x, levels):\n"
+        "    out = []\n"
+        "    for n in levels:\n"
+        "        out.append(compiled(x, n_levels={value}))\n"
+        "    return out\n"
+    )
+    # varying + not static -> finding
+    report = lint_source(
+        base.format(static="", value="n"), path="ops/churn.py",
+        rules=["R12"],
+    )
+    assert [f.rule for f in report.active] == ["R12"]
+    # declared static -> intentional per-value recompile, no finding
+    report = lint_source(
+        base.format(static=', static_argnames=("n_levels",)', value="n"),
+        path="ops/churn.py", rules=["R12"],
+    )
+    assert not report.active
+    # loop-invariant value -> no finding
+    report = lint_source(
+        base.format(static="", value="3"), path="ops/churn.py",
+        rules=["R12"],
+    )
+    assert not report.active
+
+
+def test_sarif_output_schema_and_contents():
+    proc = _run_cli(str(CONTRACT_FIXTURE), "--format", "sarif")
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    log = json.loads(proc.stdout)
+    assert log["version"] == "2.1.0"
+    (run,) = log["runs"]
+    assert run["tool"]["driver"]["name"] == "bdlz-lint"
+    rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert set(RULES) <= rule_ids
+    result_rules = {r["ruleId"] for r in run["results"]}
+    assert result_rules == CONTRACT_RULES
+    for r in run["results"]:
+        loc = r["locations"][0]["physicalLocation"]
+        assert loc["region"]["startLine"] >= 1
+        assert loc["region"]["startColumn"] >= 1  # SARIF is 1-based
+
+
+def test_cache_roundtrip_hit_and_content_invalidation(tmp_path):
+    from bdlz_tpu.lint.cache import cached_lint_paths
+    from bdlz_tpu.provenance.store import Store
+
+    src = tmp_path / "mod.py"
+    src.write_text("import time\ntime.sleep(0.0)\n")
+    store = Store(str(tmp_path / "store"))
+
+    live, hit = cached_lint_paths([str(src)], store=store)
+    assert not hit and [f.rule for f in live.active] == ["R7"]
+    cached, hit = cached_lint_paths([str(src)], store=store)
+    assert hit
+    # bit-for-bit: the cached report renders and serializes identically
+    assert cached.to_dict() == live.to_dict()
+
+    # content change -> new key -> live re-run sees the fix
+    src.write_text("import time\n")
+    fresh, hit = cached_lint_paths([str(src)], store=store)
+    assert not hit and not fresh.active
+
+
+def test_changed_only_restriction_is_reporting_not_analysis():
+    report = lint_paths([str(FIXTURE), str(CONTRACT_FIXTURE)])
+    # restricting to the contract package's config keeps ONLY its
+    # findings, but those findings came from the whole-program pass
+    cfg_path = str(CONTRACT_FIXTURE / "config.py")
+    view = report.restrict_to([cfg_path])
+    assert {f.rule for f in view.active} == {"R8", "R9"}
+    assert view.files_scanned == report.files_scanned
+    # the un-restricted report still carries everything
+    assert {f.rule for f in report.active} == set(RULES)
