@@ -206,6 +206,25 @@ class Config:
     # Autoscaler floor: no resident pool is ever scaled below this many
     # replicas (the ceiling is the service's fleet-wide replica budget).
     pool_min_replicas: int = 1
+    # ---- closed-loop continuous delivery (bdlz_tpu/refine/,
+    # docs/serving.md "Closed loop") — same orchestration-only exclusion
+    # rule: the daemon decides WHEN a traffic-specialized artifact is
+    # rebuilt and WHICH generation serves, never what any kernel
+    # computes (the candidate's own identity carries its traffic
+    # fingerprint; unaffected answers are pinned bit-identical). ----
+    # Tri-state gate (ode_* pattern): None = engine decides (OFF for a
+    # bare service; constructing a RefinementDaemon arms it), False =
+    # force off (a daemon refuses to attach), True = force on (the
+    # serve CLI arms traffic recording + the daemon loop).
+    self_improve: Optional[bool] = None
+    # Drift threshold for the refinement daemon: a stats window whose
+    # gated_rate OR out-of-domain fallback rate exceeds this fraction
+    # flags distribution drift and triggers an autonomous rebuild.
+    drift_gated_rate: float = 0.05
+    # Budget on autonomous spending: the maximum rebuild+rollout cycles
+    # one daemon may launch over its lifetime (each cycle pays a full
+    # elastic emulator rebuild).
+    rebuild_budget: int = 1
     # ---- provenance / result-cache knobs (bdlz_tpu/provenance/,
     # docs/provenance.md) — orchestration like the serve knobs: caching
     # changes WHERE a result comes from, never its bits (the sweep_cache
@@ -378,6 +397,12 @@ SERVE_CONFIG_FIELDS = (
     # tests/test_tenancy.py), so resizing tenancy stales no identity
     "tenant_routing", "memory_budget_bytes", "autoscale_interval_s",
     "pool_min_replicas",
+    # the closed-loop knobs (bdlz_tpu/refine/) share the rule: they
+    # gate WHEN the daemon rebuilds and WHICH artifact generation
+    # serves — the candidate surface itself self-identifies through
+    # its own refine_signal + traffic keys (build_identity), so these
+    # orchestration gates must never stale an identity
+    "self_improve", "drift_gated_rate", "rebuild_budget",
 )
 
 #: Valid values of the ``tenant_routing`` knob (None = engine decides).
@@ -415,7 +440,13 @@ EMULATOR_CONFIG_FIELDS = (
 VALID_POSTERIOR_WEIGHTS = ("planck",)
 
 #: Valid values of the ``refine_signal`` knob (None = legacy curvature).
-VALID_REFINE_SIGNALS = ("fisher",)
+#: ``"traffic"`` weights the refinement criterion by the observed query
+#: density of a served traffic snapshot (bdlz_tpu/refine/traffic.py);
+#: ``"traffic*planck"`` multiplies that by the Planck posterior weight —
+#: the closed-loop daemon's default product signal.  Both need a
+#: snapshot passed to ``build_emulator(traffic=...)`` and stamp its
+#: fingerprint on the artifact identity (``traffic`` key).
+VALID_REFINE_SIGNALS = ("fisher", "traffic", "traffic*planck")
 
 #: Valid MCMC samplers (mcmc_cli / sampling layer).
 VALID_SAMPLERS = ("stretch", "nuts")
@@ -581,7 +612,8 @@ def validate(cfg: Config, backend: Optional[str] = None) -> Config:
         raise ConfigError("ode_rtol and ode_atol must be positive")
     for k in ("ode_auto_h0", "ode_pi_controller", "ode_tabulated_av",
               "quad_panel_gl", "fault_injection", "retry_enabled",
-              "cache_enabled", "seam_split", "health_enabled"):
+              "cache_enabled", "seam_split", "health_enabled",
+              "self_improve"):
         v = getattr(cfg, k)
         if v is not None and not isinstance(v, bool):
             raise ConfigError(f"{k} must be true, false, or null, got {v!r}")
@@ -661,6 +693,16 @@ def validate(cfg: Config, backend: Optional[str] = None) -> Config:
         raise ConfigError(
             f"rollback_budget must be a fraction in (0, 1], got "
             f"{cfg.rollback_budget!r}"
+        )
+    if not (0.0 < cfg.drift_gated_rate <= 1.0):
+        raise ConfigError(
+            f"drift_gated_rate must be a fraction in (0, 1], got "
+            f"{cfg.drift_gated_rate!r}"
+        )
+    if not (isinstance(cfg.rebuild_budget, int) and cfg.rebuild_budget >= 1):
+        raise ConfigError(
+            f"rebuild_budget must be an integer >= 1 (autonomous "
+            f"rebuild+rollout cycles), got {cfg.rebuild_budget!r}"
         )
     if cfg.tenant_routing is not None and (
         cfg.tenant_routing not in VALID_TENANT_ROUTING

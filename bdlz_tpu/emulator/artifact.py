@@ -115,6 +115,7 @@ def build_identity(
     lz_profile_fp: "str | None" = None,
     refine_signal: "str | None" = None,
     bounce_fp: "str | None" = None,
+    traffic_fp: "str | None" = None,
 ) -> Dict[str, Any]:
     """The physics identity an artifact is valid for.
 
@@ -158,6 +159,13 @@ def build_identity(
     than loaded from a CSV) joins the same way as its own ``bounce``
     key — wildcard-when-unstated, so profile-fed artifacts keep their
     hashes, but two potentials can never share a surface.
+
+    ``traffic_fp`` (the content fingerprint of the served-traffic
+    snapshot a ``refine_signal="traffic"``/``"traffic*planck"`` build
+    was weighted by, ``bdlz_tpu/refine/traffic.py``) joins as its own
+    ``traffic`` key with the same wildcard rule: two snapshots place
+    nodes differently and must never share a surface, while a consumer
+    that states no snapshot (every pre-closed-loop caller) matches any.
     """
     from bdlz_tpu.config import (
         ROBUSTNESS_STATIC_FIELDS,
@@ -196,6 +204,13 @@ def build_identity(
         # posterior weighting: same single-home omit-at-default key,
         # same wildcard rule in check_identity
         out["refine_signal"] = str(refine_signal)
+    if traffic_fp is not None:
+        # the traffic-weighted refinement signal moves nodes per
+        # SNAPSHOT, not just per signal name: the snapshot fingerprint
+        # is its own key (wildcard rule in check_identity) so two
+        # traffic-specialized builds over different query distributions
+        # can never be confused
+        out["traffic"] = str(traffic_fp)
     scen = scenario_identity(static)
     if scen is not None:
         out["lz_scenario"] = scen
@@ -513,6 +528,12 @@ def check_identity(
         # potential matches either, while stating one pins it strictly
         # (cross-potential artifact/consumer skew must reject loudly)
         stored.pop("bounce", None)
+    if "traffic" not in want:
+        # wildcard like refine_signal: the snapshot fingerprint steers
+        # node placement, never what the exact engine computes — a
+        # caller with no stated snapshot (every serving front) matches
+        # either; stating one pins it strictly
+        stored.pop("traffic", None)
     sb = dict(stored.get("base", {}))
     wb = dict(want.get("base", {}))
     for key in set(exempt_config_keys) | set(artifact.axis_names):

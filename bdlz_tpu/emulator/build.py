@@ -764,6 +764,73 @@ def _posterior_probe_weights(
     return np.clip(np.exp(lp - lp_max), floor, 1.0)
 
 
+def _traffic_node_weights(
+    nodes: List[np.ndarray],
+    locations: np.ndarray,
+    floor: float = 1e-3,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Traffic weight of every grid node, from a served-query snapshot
+    (the closed-loop hook, bdlz_tpu/refine): query locations are clipped
+    into the box (out-of-box mass pulls refinement toward the nearest
+    edge cell — exactly where an expanded rebuild needs resolution),
+    binned per CELL on the current node grid, normalized to
+    ``clip(count / max count, floor, 1)``, then lifted to node level by
+    corner max (a node bordering a hot cell is hot).  The floor keeps
+    unvisited regions under COARSE control instead of none — same
+    contract as :func:`_posterior_node_weights`.  Returns
+    ``(node_weights, cell_weights)``; the cell weights score probes.
+    """
+    locs = np.atleast_2d(np.asarray(locations, dtype=np.float64))
+    for k, ax in enumerate(nodes):
+        locs[:, k] = np.clip(locs[:, k], float(ax[0]), float(ax[-1]))
+    counts, _ = np.histogramdd(locs, bins=[np.asarray(a) for a in nodes])
+    top = counts.max()
+    w_cell = (
+        np.clip(counts / top, floor, 1.0) if top > 0
+        else np.full(counts.shape, floor)
+    )
+    # cell -> node by adjacent-cell max (the inverse of
+    # _node_to_cell_max): node i touches cells i-1 and i along each axis
+    w_node = w_cell
+    for k in range(w_node.ndim):
+        edge_lo = tuple(
+            slice(0, 1) if j == k else slice(None)
+            for j in range(w_node.ndim)
+        )
+        edge_hi = tuple(
+            slice(-1, None) if j == k else slice(None)
+            for j in range(w_node.ndim)
+        )
+        ext = np.concatenate(
+            [w_node[edge_lo], w_node, w_node[edge_hi]], axis=k
+        )
+        lo = tuple(
+            slice(None, -1) if j == k else slice(None)
+            for j in range(ext.ndim)
+        )
+        hi = tuple(
+            slice(1, None) if j == k else slice(None)
+            for j in range(ext.ndim)
+        )
+        w_node = np.maximum(ext[lo], ext[hi])
+    return w_node, w_cell
+
+
+def _traffic_probe_weights(
+    nodes: List[np.ndarray], probes: np.ndarray, w_cell: np.ndarray
+) -> np.ndarray:
+    """The traffic cell weight at each probe point (cell lookup on the
+    current grid — probes in cold cells stop demanding splits)."""
+    idx = tuple(
+        np.clip(
+            np.searchsorted(nodes[k], probes[:, k], side="right") - 1,
+            0, len(nodes[k]) - 2,
+        )
+        for k in range(len(nodes))
+    )
+    return w_cell[idx]
+
+
 def build_emulator(
     base,
     spec: Mapping[str, AxisSpec],
@@ -792,6 +859,7 @@ def build_emulator(
     lz_profile=None,
     bounce=None,
     elastic=None,
+    traffic=None,
 ) -> Tuple[EmulatorArtifact, BuildReport]:
     """Build (and optionally save) an error-controlled yield-surface emulator.
 
@@ -853,6 +921,20 @@ def build_emulator(
     (no in-graph gradient) and the stiff/direct engines never evaluate
     through the differentiable closure this signal uses.
 
+    ``refine_signal="traffic"`` (or ``"traffic*planck"``) weights the
+    refinement criterion by OBSERVED query density instead: ``traffic``
+    (a :class:`~bdlz_tpu.refine.TrafficSnapshot`, required for these
+    signals and rejected without them) supplies served-query locations
+    that are binned per cell on the current grid each round —
+    ``clip(count/max, 1e-3, 1)`` — so the build spends exact
+    evaluations where the service's traffic actually lands and coarsens
+    unvisited regions under the same floor/error-gate contract as the
+    posterior hook.  ``"traffic*planck"`` composes both weights
+    multiplicatively even when ``posterior_weight`` is off.  The
+    snapshot fingerprint joins the artifact identity as its own
+    ``traffic`` key (wildcard-when-unstated), so two builds steered by
+    different snapshots hash apart.
+
     ``bounce`` (a :class:`~bdlz_tpu.bounce.PotentialSpec` / mapping /
     JSON path; scenario modes only, mutually exclusive with
     ``lz_profile``) shoots the wall profile in-framework from the
@@ -901,6 +983,48 @@ def build_emulator(
             f"refine_signal={rs!r} is not one of "
             f"{VALID_REFINE_SIGNALS} (or None = curvature)"
         )
+    # --- traffic-weighted refinement (closed-loop plane, bdlz_tpu/refine):
+    # a traffic signal multiplies the criterion by observed query density,
+    # so it REQUIRES the snapshot — and a snapshot without the signal
+    # would silently change nothing, which is a caller error, not a no-op
+    # (the lz_profile/scenario pairing rule, applied again). ---
+    traffic_on = rs in ("traffic", "traffic*planck")
+    if traffic_on and traffic is None:
+        raise EmulatorBuildError(
+            f"refine_signal={rs!r} weights refinement by served traffic; "
+            "pass traffic=<TrafficSnapshot> (bdlz_tpu.refine) to "
+            "build_emulator"
+        )
+    if traffic is not None and not traffic_on:
+        raise EmulatorBuildError(
+            f"traffic=<snapshot> requires refine_signal 'traffic' or "
+            f"'traffic*planck' (resolved: {rs!r}) — a snapshot the "
+            "refinement never consults would silently change nothing"
+        )
+    traffic_fp = None
+    traffic_locs = None
+    if traffic is not None:
+        t_axes = tuple(str(n) for n in traffic.axis_names)
+        if t_axes != tuple(spec):
+            raise EmulatorBuildError(
+                f"traffic snapshot axes {t_axes} do not match the "
+                f"emulator spec axes {tuple(spec)} (order included) — "
+                "query locations would be binned against the wrong "
+                "coordinates"
+            )
+        traffic_locs = np.atleast_2d(
+            np.asarray(traffic.locations, dtype=np.float64)
+        )
+        if traffic_locs.shape[0] == 0:
+            raise EmulatorBuildError(
+                "traffic snapshot carries zero query locations; nothing "
+                "to weight by — serve traffic first or drop the signal"
+            )
+        traffic_fp = str(traffic.fingerprint)
+    # "traffic*planck" composes BOTH weights multiplicatively even when
+    # the posterior_weight knob itself is off — the product signal is
+    # the point of the name
+    use_planck = pw is not None or rs == "traffic*planck"
     # Potential-space plane (docs/scenarios.md): a bounce spec is shot
     # into a wall profile once, host-side, then rides the lz_profile
     # machinery below unchanged — the potential fingerprint joins the
@@ -996,6 +1120,7 @@ def build_emulator(
             # either/or guard above
             lz_profile=None if bounce_fp is not None else lz_profile,
             bounce=bounce,
+            traffic=traffic,
         )
     # Engine resolution mirrors run_sweep, and is done HERE (once) so the
     # product population, the probe evaluations, and the artifact identity
@@ -1159,15 +1284,28 @@ def build_emulator(
         # mass lives, coarsening dead regions by the weight floor
         w_nodes = None
         lp_max = 0.0
-        if pw is not None:
+        if use_planck:
             w_nodes, lp_max = _posterior_node_weights(log_values)
+        # traffic weights are recomputed per round too — the locations
+        # are fixed but the cell grid they bin into just grew
+        w_cell_traffic = None
+        if traffic_on:
+            w_traffic, w_cell_traffic = _traffic_node_weights(
+                nodes, traffic_locs
+            )
+            w_nodes = (
+                w_traffic if w_nodes is None else w_nodes * w_traffic
+            )
         if pool_probes.shape[0]:
             emu = _emulated_fields(nodes, scales, log_values, pool_probes)
             errs = _probe_errors(emu, pool_exact)
-            score = (
-                errs * _posterior_probe_weights(pool_exact, lp_max)
-                if pw is not None else errs
-            )
+            score = errs
+            if use_planck:
+                score = score * _posterior_probe_weights(pool_exact, lp_max)
+            if w_cell_traffic is not None:
+                score = score * _traffic_probe_weights(
+                    nodes, pool_probes, w_cell_traffic
+                )
             failing = np.flatnonzero(score > refine_tol)
         else:
             # every probe so far was infrastructure-quarantined: nothing
@@ -1331,18 +1469,24 @@ def build_emulator(
     )
     max_rel_err = float(held_errs.max())
     weighted_max_rel_err = None
-    if pw is not None:
-        _w_final, lp_max_final = _posterior_node_weights(log_values)
-        weighted_max_rel_err = float(
-            (held_errs * _posterior_probe_weights(exact, lp_max_final)).max()
-        )
+    if use_planck or traffic_on:
+        w_held = np.ones_like(held_errs)
+        if use_planck:
+            _w_final, lp_max_final = _posterior_node_weights(log_values)
+            w_held = w_held * _posterior_probe_weights(exact, lp_max_final)
+        if traffic_on:
+            _, w_cell_final = _traffic_node_weights(nodes, traffic_locs)
+            w_held = w_held * _traffic_probe_weights(
+                nodes, held, w_cell_final
+            )
+        weighted_max_rel_err = float((held_errs * w_held).max())
     if not converged:
         msg = (
             f"emulator refinement exhausted {max_rounds} rounds with "
             f"held-out max rel err {max_rel_err:.3e} vs target {rtol:.1e}"
         )
-        if pw is not None:
-            msg += f" (posterior-weighted: {weighted_max_rel_err:.3e})"
+        if weighted_max_rel_err is not None:
+            msg += f" (weighted: {weighted_max_rel_err:.3e})"
         if require_converged:
             raise EmulatorBuildError(msg)
         print(f"[emulator] WARNING: {msg}", file=sys.stderr)
@@ -1388,6 +1532,9 @@ def build_emulator(
     if rs is not None:
         manifest["refine_signal"] = rs
         manifest["n_grad_evals"] = int(n_grad_evals)
+    if traffic_fp is not None:
+        manifest["traffic_fingerprint"] = traffic_fp
+        manifest["traffic_queries"] = int(traffic_locs.shape[0])
     artifact = EmulatorArtifact(
         axis_names=tuple(axis_names),
         axis_nodes=tuple(nodes),
@@ -1396,6 +1543,7 @@ def build_emulator(
         identity=build_identity(
             base, static, n_y, impl, posterior_weight=pw,
             lz_profile_fp=lz_fp, refine_signal=rs, bounce_fp=bounce_fp,
+            traffic_fp=traffic_fp,
         ),
         manifest=manifest,
         predicted_error=predicted,

@@ -208,6 +208,15 @@ STATIC_PARAM_NAMES = {
     "ode_pi_controller",
     "ode_tabulated_av",
     "quad_panel_gl",
+    # closed-loop continuous-delivery knobs (bdlz_tpu/refine/,
+    # docs/serving.md "Closed loop"): host-side orchestration — the
+    # refinement signal selects which weight tensors steer the build,
+    # the drift threshold and cycle budget gate the daemon's control
+    # loop.  None ever reaches a tracer; same specific-names-only rule.
+    "self_improve",
+    "refine_signal",
+    "drift_gated_rate",
+    "rebuild_budget",
 }
 
 #: R6 only hints on the names that are *always* structural in this repo.

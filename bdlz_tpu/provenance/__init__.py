@@ -19,6 +19,7 @@ from bdlz_tpu.provenance.identity import (
     static_payload,
     sweep_chunk_identity,
     sweep_identity,
+    traffic_snapshot_identity,
 )
 from bdlz_tpu.provenance.registry import (
     ARTIFACT_KIND,
@@ -55,6 +56,7 @@ __all__ = [
     "static_payload",
     "sweep_chunk_identity",
     "sweep_identity",
+    "traffic_snapshot_identity",
     "ARTIFACT_KIND",
     "LEASE_KIND",
     "fetch_artifact",

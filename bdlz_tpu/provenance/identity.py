@@ -340,6 +340,34 @@ def bench_leg_identity(
     )
 
 
+def traffic_snapshot_identity(
+    axis_names: Sequence[str],
+    locations: Any,
+    reasons: Sequence["str | None"],
+    occupancy: Mapping[str, Any],
+) -> Identity:
+    """One served-traffic snapshot's content key (bdlz_tpu/refine/).
+
+    Axis names + the query-location bytes + per-query fallback reasons +
+    the per-artifact occupancy summary.  The digest is the ``traffic``
+    key a traffic-weighted emulator build stamps on its artifact
+    identity (``emulator.artifact.build_identity``), so two snapshots
+    that would steer refinement differently can never share a surface.
+    """
+    return Identity(
+        "traffic_snapshot",
+        (
+            ("json", {
+                "schema": SCHEMA_VERSION,
+                "axes": [str(n) for n in axis_names],
+                "reasons": [None if r is None else str(r) for r in reasons],
+                "occupancy": dict(occupancy),
+            }),
+            array_part(locations),
+        ),
+    )
+
+
 # ---------------------------------------------------------------------------
 # source fingerprints
 # ---------------------------------------------------------------------------

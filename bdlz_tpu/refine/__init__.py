@@ -1,0 +1,41 @@
+"""Closed-loop continuous delivery (docs/serving.md "Closed loop").
+
+The serving plane records where traffic actually lands
+(``ServeStats.traffic_log``); this package folds that trace into a
+content-hashed :class:`TrafficSnapshot`, watches the observed
+distribution for drift (:class:`RefinementDaemon`), rebuilds the
+emulator weighted by the observed density (``refine_signal="traffic"``,
+``emulator/build.py``), and — when the candidate beats the serving
+surface on held-out traffic — publishes and cuts it over under
+observation with automatic rollback (:class:`DeliveryPipeline`), with
+zero operator action.
+"""
+from bdlz_tpu.refine.daemon import (
+    RefineError,
+    RefinementDaemon,
+    resolve_self_improve,
+)
+from bdlz_tpu.refine.delivery import DeliveryPipeline
+from bdlz_tpu.refine.traffic import (
+    TRAFFIC_SCHEMA_VERSION,
+    TrafficModel,
+    TrafficSnapshot,
+    TrafficSnapshotError,
+    load_snapshot,
+    save_snapshot,
+    snapshot_entry_name,
+)
+
+__all__ = [
+    "TRAFFIC_SCHEMA_VERSION",
+    "DeliveryPipeline",
+    "RefineError",
+    "RefinementDaemon",
+    "TrafficModel",
+    "TrafficSnapshot",
+    "TrafficSnapshotError",
+    "load_snapshot",
+    "resolve_self_improve",
+    "save_snapshot",
+    "snapshot_entry_name",
+]

@@ -303,6 +303,7 @@ class MicroBatcher:
             n_gated=int(result.n_gated),
             lz_mode=self.lz_mode,
         )
+        self.stats.record_queries(thetas, result.reasons)
         self._batch_index += 1
         for p, v, e in zip(batch, values, errors):
             # per-request error isolation: a poisoned request gets its

@@ -1012,6 +1012,9 @@ class FleetService:
             replica=replica_index,
             lz_mode=self.lz_mode,
         )
+        # closed-loop traffic trace (no-op unless the refinement daemon
+        # armed it): where the queries landed + why each fell back
+        self.stats.record_queries(item.thetas, reasons)
         if self.health is not None and heal_cause is None:
             # success bookkeeping (latency-SLO scored inside, on the
             # REPLICA's own seconds — host-side exact-fallback time
@@ -1133,6 +1136,7 @@ class FleetService:
             replica=-1,
             lz_mode=self.lz_mode,
         )
+        self.stats.record_queries(thetas, REASON_DEGRADED)
         for p, v in zip(batch, values):
             self.stats.record_latency(done - p.enqueued_at)
             if err is not None:

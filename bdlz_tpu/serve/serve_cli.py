@@ -159,6 +159,26 @@ def main(argv: Optional[list] = None) -> int:
                     dest="rollback_budget",
                     help="post-cutover bad-request fraction that triggers "
                          "rollout auto-rollback (default: config)")
+    ap.add_argument("--self-improve", default=None, dest="self_improve",
+                    choices=("auto", "on", "off"),
+                    help="closed-loop continuous delivery "
+                         "(bdlz_tpu/refine; --replicas only): the "
+                         "refinement daemon watches served traffic for "
+                         "drift, rebuilds traffic-weighted, and "
+                         "auto-publishes winning candidates through the "
+                         "rollout pipeline.  auto = the config "
+                         "tri-state (CLI default OFF; needs a "
+                         "provenance store via cache_root/"
+                         "BDLZ_CACHE_ROOT)")
+    ap.add_argument("--drift-gated-rate", type=float, default=None,
+                    dest="drift_gated_rate",
+                    help="gated-fallback or out-of-domain traffic "
+                         "fraction above which the refinement daemon "
+                         "declares drift (default: config)")
+    ap.add_argument("--rebuild-budget", type=int, default=None,
+                    dest="rebuild_budget",
+                    help="maximum autonomous rebuild+rollout cycles per "
+                         "serve session (default: config)")
     ap.add_argument("--tenant-routing", default=None, dest="tenant_routing",
                     choices=("scenario", "hash"),
                     help="multi-tenant routing-tag policy (--tenant-map "
@@ -220,9 +240,16 @@ def main(argv: Optional[list] = None) -> int:
             "breaker_window", "breaker_threshold", "breaker_cooldown_s",
             "breaker_latency_slo_s", "rollback_budget", "tenant_routing",
             "autoscale_interval_s", "pool_min_replicas",
+            "drift_gated_rate", "rebuild_budget",
         )
         if getattr(args, k) is not None
     }
+    if args.self_improve is not None:
+        # tri-state twin (the --health mapping): "auto" folds the
+        # explicit engine-decides value over whatever the config said
+        overrides["self_improve"] = {
+            "auto": None, "on": True, "off": False,
+        }[args.self_improve]
     if overrides:
         # re-validate: a flag value gets exactly the checks a config
         # value would (bad overrides fail here, not mid-serve)
@@ -253,7 +280,32 @@ def main(argv: Optional[list] = None) -> int:
             bounce=args.bounce,
         )
         service = None
+        from bdlz_tpu.refine import RefinementDaemon, resolve_self_improve
+
+        if resolve_self_improve(base):
+            from bdlz_tpu.provenance import resolve_store
+
+            refine_store = resolve_store(None, base, label="refine")
+            if refine_store is None:
+                ap.error(
+                    "--self-improve needs a provenance store for "
+                    "snapshots and candidate publishing; set cache_root "
+                    "in the config or BDLZ_CACHE_ROOT"
+                )
+            daemon = RefinementDaemon(
+                fleet, base, store=refine_store, event_log=event_log,
+            )
+        else:
+            daemon = None
     else:
+        from bdlz_tpu.refine import resolve_self_improve
+
+        if resolve_self_improve(base):
+            ap.error(
+                "--self-improve drives the fleet front's rollout "
+                "pipeline; add --replicas N"
+            )
+        daemon = None
         service = YieldService(
             artifact, base, field=args.field, max_batch_size=args.max_batch,
             lz_profile=args.lz_profile,
@@ -343,7 +395,7 @@ def main(argv: Optional[list] = None) -> int:
 
     if fleet is not None:
         try:
-            n_ok = _serve_requests_fleet(fleet, requests)
+            n_ok = _serve_requests_fleet(fleet, requests, daemon=daemon)
         finally:
             # the shutdown path: drain() above answered everything on
             # the happy path, so this fails only what an escaped error
@@ -434,6 +486,11 @@ def _serve_tenant(args, ap, base, event_log) -> int:
     if args.bench is not None:
         ap.error("--bench is not supported with --tenant-map (the bench "
                  "harness's serve_multitenant leg covers it)")
+    from bdlz_tpu.refine import resolve_self_improve
+
+    if resolve_self_improve(base):
+        ap.error("--self-improve watches ONE fleet's traffic; it is not "
+                 "supported with --tenant-map")
     if args.requests is None:
         ap.error("one of --requests or --bench is required")
     try:
@@ -572,7 +629,7 @@ def _serve_tenant(args, ap, base, event_log) -> int:
     return 1 if (n_lines and n_ok == 0) else 0
 
 
-def _serve_requests_fleet(fleet, requests) -> int:
+def _serve_requests_fleet(fleet, requests, daemon=None) -> int:
     """Drain parsed requests through the fleet front.
 
     Admission rejections (QueueFull) become structured per-request error
@@ -608,7 +665,14 @@ def _serve_requests_fleet(fleet, requests) -> int:
             submitted.append((rid, None, exc))
         fleet.run_once()
         fleet.poll(block=False)
+        if daemon is not None:
+            # one closed-loop tick per pump: fold is incremental, the
+            # drift test is a couple of window rates, and a detected
+            # drift runs its rebuild+delivery cycle right here
+            daemon.step()
     fleet.drain()
+    if daemon is not None:
+        daemon.step()
     for index, (rid, fut, err) in enumerate(submitted):
         if err is not None:
             print(json.dumps(_error_record(rid, err, latency_s=0.0)))

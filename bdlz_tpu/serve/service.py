@@ -82,11 +82,14 @@ def gate_fallback_masks(inside, pred_err, tol):
     else:
         gated = np.zeros(inside.shape, dtype=bool)
     fallback = ~inside | gated
-    reasons: "List[Optional[str]]" = [
-        REASON_OOD if not inside[i]
-        else (REASON_PREDICTED_ERROR if gated[i] else None)
-        for i in range(len(inside))
-    ]
+    # vectorized reason assignment (one np.where pass, not a per-request
+    # Python loop — this runs on every resolved batch of every front);
+    # bitwise parity with the loop reference is pinned in
+    # tests/test_refine.py
+    reason_arr = np.where(
+        ~inside, REASON_OOD, np.where(gated, REASON_PREDICTED_ERROR, "")
+    )
+    reasons: "List[Optional[str]]" = [r if r else None for r in reason_arr.tolist()]
     return fallback, gated, reasons
 
 

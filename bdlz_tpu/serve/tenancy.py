@@ -799,6 +799,7 @@ class MultiTenantService:
             replica=-1,
             lz_mode=pool.lz_mode,
         )
+        pool.stats.record_queries(thetas, REASON_POOL_EVICTED)
         pool._batch_index += 1
         for p, v in zip(batch, values):
             pool.stats.record_latency(done - p.enqueued_at)

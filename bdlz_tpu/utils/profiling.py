@@ -7,7 +7,7 @@ from __future__ import annotations
 import contextlib
 import dataclasses
 from dataclasses import dataclass, field
-from typing import Any, Dict, List
+from typing import Any, Dict, List, Tuple
 
 
 @dataclass(frozen=True)
@@ -140,6 +140,38 @@ class ServeStats:
     #: byte-identical to the pre-health service whenever nothing armed
     #: them (the zero-overhead pin in tests/test_health.py).
     extras: Dict[str, Any] = field(default_factory=dict)
+    #: Opt-in per-query traffic trace (the closed-loop refinement
+    #: daemon's input, bdlz_tpu/refine): one ``(theta tuple, reason)``
+    #: entry per answered request, ``reason`` as on the response
+    #: (None = emulator fast path).  ``None`` — the default — disables
+    #: recording entirely: :meth:`record_queries` is a no-op, rows and
+    #: :meth:`summary` are byte-identical to an unarmed service (the
+    #: zero-overhead pin in tests/test_refine.py).  Arm with
+    #: :meth:`arm_traffic_log`.
+    traffic_log: "List[Tuple[Tuple[float, ...], 'str | None']] | None" = None
+
+    def arm_traffic_log(self) -> None:
+        """Start recording per-query locations + fallback reasons."""
+        if self.traffic_log is None:
+            self.traffic_log = []
+
+    def record_queries(self, thetas: Any, reasons: Any = None) -> None:
+        """Append one entry per request of a resolved batch (no-op
+        unless :meth:`arm_traffic_log` ran).  ``thetas`` is the (B, d)
+        query block; ``reasons`` the per-request fallback reasons (a
+        single string broadcasts; None = all emulator-answered)."""
+        if self.traffic_log is None:
+            return
+        import numpy as np  # host-side stats (bdlz-lint R1 audit)
+
+        block = np.atleast_2d(np.asarray(thetas, dtype=np.float64))
+        b = block.shape[0]
+        if reasons is None:
+            reasons = [None] * b
+        elif isinstance(reasons, str):
+            reasons = [reasons] * b
+        for row, reason in zip(block, reasons):
+            self.traffic_log.append((tuple(float(v) for v in row), reason))
 
     def record_batch(self, **kw: Any) -> None:
         self.rows.append(ServeBatch(**kw))

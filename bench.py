@@ -68,7 +68,13 @@ at equal tolerance, then a deterministic seam-crossing query trace
 through the predicted-error-gated service — exact-fallback ratio,
 gated/ungated rates and effective QPS for both artifacts, and the
 gated answers spot-checked against the exact engine, all on one
-line).  Every secondary leg runs on EVERY
+line), BDLZ_BENCH_SI_QUERIES / BDLZ_BENCH_SI_BATCH / BDLZ_BENCH_SI_NY
+(the self_improve leg: per-hour request count, micro-batch bucket, and
+rebuild table resolution for the closed-loop self-improving service —
+a two-hour drifted trace on a fake clock through the refinement
+daemon's detect → traffic-steered rebuild → auto-publish cycle,
+reporting hour-1 vs hour-2 gated-fallback rates and the
+unaffected-region bitwise pin).  Every secondary leg runs on EVERY
 platform (flagged tpu_unavailable on the fallback path) so a
 relay-dead round still records full engine coverage.
 """
@@ -2328,6 +2334,169 @@ def main(argv=None) -> None:
         print(f"[bench] serve_multitenant metric unavailable: {exc}",
               file=sys.stderr)
 
+    # --- secondary metric: the closed-loop self-improving service ------
+    # ROADMAP item 4's acceptance instrument (bdlz_tpu/refine/): a
+    # deliberately NARROW seed emulator serves a replayed deterministic
+    # two-hour mixed trace (fake clock — each hour is 3600 fake-clock
+    # seconds) whose request distribution hangs half outside the box.
+    # The refinement daemon detects the drift from the armed per-query
+    # trace, persists the content-hashed traffic snapshot, rebuilds over
+    # the traffic-expanded box as elastic chunks steered by
+    # refine_signal="traffic", and the delivery pipeline auto-publishes
+    # the winner — zero operator action.  The line records hour-1 vs
+    # hour-2 gated-fallback rates (hour 2 must be lower after the ONE
+    # autonomous rebuild+rollout cycle) and the bitwise pin on a
+    # far-out-of-domain probe whose exact-fallback answer must be
+    # bit-identical before and after the rollout (unaffected regions
+    # never change under self-improvement).
+    def self_improve_metric():
+        import dataclasses as _dc  # noqa: F401 — config replaces below
+        import shutil
+        import tempfile
+
+        from bdlz_tpu.emulator.build import AxisSpec, build_emulator
+        from bdlz_tpu.provenance import Store
+        from bdlz_tpu.refine import RefinementDaemon
+        from bdlz_tpu.serve.fleet import FleetService
+
+        n_req = int(os.environ.get("BDLZ_BENCH_SI_QUERIES", 256))  # /hour
+        si_batch = max(
+            1, min(int(os.environ.get("BDLZ_BENCH_SI_BATCH", 8)), n_req)
+        )
+        si_ny = int(os.environ.get("BDLZ_BENCH_SI_NY", 200))
+        n_batches = max(1, n_req // si_batch)
+        dt = 3600.0 / n_batches  # one fake-clock hour per trace half
+
+        class _Tick:
+            t = 0.0
+
+            def __call__(self):
+                return self.t
+
+        tmp_store = tempfile.mkdtemp(prefix="bdlz_bench_refine_")
+        t_si = time.time()
+        try:
+            store = Store(tmp_store)
+            # the narrow seed box the traffic has drifted out of
+            seed_spec = {
+                "m_chi_GeV": AxisSpec(0.9, 1.0, 3, "log"),
+                "T_p_GeV": AxisSpec(90.0, 100.0, 3, "log"),
+            }
+            build_kw = dict(n_probe=6, max_rounds=2, n_y=si_ny,
+                            rtol=1e-3, chunk_size=16)
+            seed_art, _ = build_emulator(
+                base, seed_spec, cache=store, **build_kw
+            )
+            tick = _Tick()
+            svc = FleetService(
+                seed_art, base, max_batch_size=si_batch, n_replicas=2,
+                routing="round_robin", max_wait_s=1e-3, clock=tick,
+            )
+            daemon = RefinementDaemon(
+                svc, base, store=store, clock=tick,
+                window=n_req, min_queries=min(32, max(8, n_req // 4)),
+                drift_gated_rate=0.05, rebuild_budget=1,
+                observe_s=2.0 * dt, build_kw=build_kw, elastic=2,
+            )
+            rng = np.random.default_rng(7)
+            # mixed drifted distribution: ~half the mass outside the box
+            lo = np.array([0.95, 95.0])
+            hi = np.array([1.08, 108.0])
+            far_ood = np.array([2.0, 150.0])
+
+            def serve_block(thetas):
+                futs = [svc.submit(t) for t in np.atleast_2d(thetas)]
+                tick.t += dt
+                svc.run_once(force=True)
+                svc.poll(block=True)
+                return [f.result() for f in futs]
+
+            def hour():
+                start = len(svc.stats.rows)
+                for _ in range(n_batches):
+                    serve_block(rng.uniform(lo, hi, (si_batch, 2)))
+                    daemon.step()
+                rows = svc.stats.rows[start:]
+                n = sum(r.size for r in rows)
+                return {
+                    "gated_fallback_rate": round(
+                        sum(r.n_fallback for r in rows) / n, 4
+                    ),
+                    "gated_rate": round(
+                        sum(r.n_gated for r in rows) / n, 4
+                    ),
+                    "n_requests": n,
+                }
+
+            far_before = serve_block(far_ood)[0]
+            h1 = hour()
+            h2 = hour()
+            far_after = serve_block(far_ood)[0]
+            bitwise = (
+                np.float64(far_before.value).tobytes()
+                == np.float64(far_after.value).tobytes()
+            )
+            history = daemon.history
+            decision = history[0]["decision"] if history else None
+            si_seconds = time.time() - t_si
+            payload = {
+                "metric": "self_improve_gated_rate",
+                "value": h2["gated_fallback_rate"],
+                "unit": "gated-fallback fraction (ood + error-gated) of "
+                        "hour 2 of a replayed two-hour drifted trace, "
+                        "after one autonomous traffic-steered "
+                        "rebuild+rollout cycle (hour 1: %.4f)"
+                        % h1["gated_fallback_rate"],
+                "n_requests": 2 * n_batches * si_batch + 2,
+                "batch": si_batch,
+                "gated_fallback_hour1": h1["gated_fallback_rate"],
+                "gated_fallback_hour2": h2["gated_fallback_rate"],
+                "gated_rate_hour1": h1["gated_rate"],
+                "gated_rate_hour2": h2["gated_rate"],
+                "cycles": daemon.cycles,
+                "daemon_state": daemon.state,
+                "drift_gated_rate": daemon.drift_gated_rate,
+                "rebuild_budget": daemon.rebuild_budget,
+                "snapshot": history[0]["snapshot"] if history else None,
+                "train_snapshot": (
+                    history[0]["train_snapshot"] if history else None
+                ),
+                "decision": (
+                    {k: decision[k] for k in (
+                        "outcome", "candidate_score", "serving_score",
+                    )} if decision else None
+                ),
+                "seed_hash": seed_art.content_hash,
+                "serving_hash": svc.artifact_hash,
+                "elastic": True,
+                "n_y": si_ny,
+                "bitwise_equal_unaffected": bool(bitwise),
+                "n_failed": None,
+                "n_quarantined": None,
+                "n_retries": None,
+                "cache_hits": None,
+                "cache_misses": None,
+                "wall_seconds": round(si_seconds, 4),
+                "platform": jax.devices()[0].platform,
+                "tpu_unavailable": tpu_unavailable,
+            }
+            emit(payload)
+            return {
+                k: payload[k] for k in (
+                    "value", "gated_fallback_hour1", "gated_fallback_hour2",
+                    "cycles", "daemon_state", "bitwise_equal_unaffected",
+                )
+            }
+        finally:
+            shutil.rmtree(tmp_store, ignore_errors=True)
+
+    self_improve_summary = None
+    try:
+        self_improve_summary = run_leg("self_improve", self_improve_metric)
+    except Exception as exc:  # noqa: BLE001 — secondary metric is best-effort
+        print(f"[bench] self_improve metric unavailable: {exc}",
+              file=sys.stderr)
+
     # --- secondary metric: the differentiable pipeline (grad_sweep) ----
     # d(Ω_DM/Ω_b)/dθ throughput through jax.grad of the exact pipeline
     # (sampling/grad.py — the gradient layer NUTS and the Fisher-aware
@@ -2633,6 +2802,13 @@ def main(argv=None) -> None:
                 # vs single-tenant fleets; null = leg failed — its
                 # secondary line has the full detail)
                 "serve_multitenant": multitenant_summary,
+                # the closed-loop self-improving service (ROADMAP item
+                # 4: traffic-drift detection → autonomous traffic-
+                # steered rebuild → auto-publish rollout; hour-1 vs
+                # hour-2 gated-fallback rates + the unaffected-region
+                # bitwise pin; null = leg failed — its secondary line
+                # has the full detail)
+                "self_improve": self_improve_summary,
                 # the seam-split emulator A/B (split-domain build +
                 # error-gated serve trace vs single-domain; null = leg
                 # failed — its secondary line has the full detail)
