@@ -91,9 +91,35 @@ Sites and what their keys mean:
     The multi-tenant autoscaler's rebalance pass (``serve/tenancy.py``);
     ``key`` = pass counter.  Kinds ``raise``/``transient`` fail the
     pass — pools keep their current replica counts (the plane serves
-    through a sick autoscaler; budgeted by ``times``).  Operational
-    churn only: these sites never join any result identity, because
-    churn must not change bits.
+    through a sick autoscaler; budgeted by ``times``).
+``host_crash``
+    The cross-host fabric's whole-host death (``serve/fabric.py``);
+    ``key`` = the host's fabric TICK counter (None = the first tick),
+    so a plan armed on one host kills it at a chosen point mid-trace.
+    Kind ``raise`` kills the host's entire serving plane at that fabric
+    tick (``FabricHost.tick``): every in-flight and queued request on
+    the host resolves with typed ``ServiceUnavailable`` (the fleet
+    ``close()`` contract — never silent loss), its lease stops
+    extending, and the router fails the host's tenants over to
+    survivors once the TTL expires.
+``heartbeat_loss``
+    The fabric host's lease heartbeat (``serve/fabric.py``); ``key`` =
+    host index.  Kind ``raise`` silently STOPS the lease extension
+    while the host keeps answering — the split-brain drill: the router
+    must fence the live-but-silent host (refuse to route to it after
+    TTL expiry) even though the host itself still believes it is
+    healthy.  Kind ``transient`` skips ``times`` heartbeats, then
+    recovers (a GC pause, not a death).
+``store_partition``
+    The fabric host's provenance-store access (``serve/fabric.py``);
+    ``key`` = per-host store call counter (None = every call).  Kinds
+    ``raise``/``transient`` make the shared store unreachable from that
+    host — the host retries within its bounded retry policy, and on
+    exhaustion serves loud degraded-exact answers (reason
+    ``"store_partition"``) rather than stale-routed emulator answers;
+    rejoin is automatic once the partition (``times`` budget) heals.
+    Operational churn only: these sites never join any result identity,
+    because churn must not change bits.
 
 Resolution (:meth:`FaultPlan.resolve`) follows the tri-state knob
 pattern: ``Config.fault_injection`` ``None`` enables injection iff a
@@ -111,7 +137,8 @@ from typing import Any, Dict, List, NamedTuple, Optional
 VALID_SITES = (
     "step", "chunk_write", "probe", "serve_exact", "clock",
     "replica_dispatch", "registry_fetch", "store_read", "lease",
-    "worker_crash", "pool_evict", "autoscale",
+    "worker_crash", "pool_evict", "autoscale", "host_crash",
+    "heartbeat_loss", "store_partition",
 )
 VALID_KINDS = ("raise", "transient", "poison", "nan", "torn", "slow",
                "corrupt")

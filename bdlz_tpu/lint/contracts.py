@@ -98,9 +98,12 @@ CLI_OPERATIONAL_DESTS = frozenset({
     "param", "walkers", "steps", "burn", "checkpoint_dir",
     "checkpoint_every", "lz_table_n", "nuts_warmup", "max_tree_depth",
     # serve driver: service/batcher shape (constructor-level, identity-
-    # excluded by the SERVE_CONFIG_FIELDS rule) + tenant-map payload
+    # excluded by the SERVE_CONFIG_FIELDS rule) + tenant-map payload;
+    # host_id is cross-host attribution only (who answered, never what
+    # was answered — forbidden from joining any result identity,
+    # docs/serving.md "Cross-host fabric")
     "artifact", "requests", "bench", "field", "max_batch",
-    "max_wait_ms", "deadline_ms", "routing", "tenant_map",
+    "max_wait_ms", "deadline_ms", "routing", "tenant_map", "host_id",
     # LZ per-run identity inputs (lz/options.py): their single home is
     # the engine_identity_extra / build_identity hash_extra key
     "lz_profile", "lz_method", "lz_gamma_phi", "bounce",

@@ -283,3 +283,66 @@ def elect_coordinator(
         write_lease(store, coord_job, 0, rec)
         return True
     return False
+
+
+# ---- host-lease membership (the cross-host serving fabric) --------------
+#
+# The serving fabric (serve/fabric.py) needs per-HOST membership with the
+# exact semantics elect_coordinator has per job: TTL'd records through
+# the shared store, exclusive create, steal-on-expiry, torn-reads-as-
+# free.  One record per membership slot, under the job name
+# ``fabric_<fabric>`` — slot ``i`` is host ``i``'s seat, and only its
+# holder heartbeats it.  The policy half (heartbeat cadence, fencing,
+# failover) lives in serve/fabric.py; these are the storage hooks.
+
+def host_lease_job(fabric: str) -> str:
+    """The lease-plane job name of a fabric's membership records."""
+    return f"fabric_{fabric}"
+
+
+def read_host_lease(store, fabric: str, host_index: int):
+    """Host ``host_index``'s membership record, or None when absent or
+    torn (a torn record reads as a FENCED host — the store evicted it,
+    and the next successful heartbeat rewrites it whole)."""
+    from bdlz_tpu.provenance.registry import read_lease
+
+    return read_lease(store, host_lease_job(fabric), int(host_index))
+
+
+def publish_host_lease(
+    store, fabric: str, host_index: int, record, clock=None
+) -> bool:
+    """Register or heartbeat-extend one host's membership lease.
+    Returns True when ``record`` now holds the slot: fresh slot →
+    exclusive create; own slot (matching ``host_id``) → extend; expired
+    or torn slot → steal with a generation bump (host replacement —
+    the dead holder's seat must not stay orphaned past its TTL).  A
+    LIVE slot held by a different ``host_id`` refuses (False): two
+    hosts claiming one seat is an identity collision, never a race to
+    win."""
+    import time
+
+    from bdlz_tpu.provenance.registry import (
+        create_lease,
+        read_lease,
+        write_lease,
+    )
+
+    if clock is None:
+        clock = time.time
+    job = host_lease_job(fabric)
+    now = float(clock())
+    if create_lease(store, job, int(host_index), record):
+        return True
+    cur = read_lease(store, job, int(host_index))
+    if cur is not None and float(cur.get("expires_at", 0.0)) > now and (
+        cur.get("host_id") != record.get("host_id")
+    ):
+        return False
+    if cur is not None and cur.get("host_id") != record.get("host_id"):
+        # stealing an expired seat: the generation bump makes the
+        # replacement visible to routers that cached the old record
+        record = dict(record)
+        record["generation"] = int(cur.get("generation", 0)) + 1
+    write_lease(store, job, int(host_index), record)
+    return True

@@ -80,40 +80,10 @@ class CommitMismatchError(ElasticError):
     over, so this raises instead of picking a winner."""
 
 
-class ManualClock:
-    """Deterministic injectable clock for the in-process driver/tests:
-    time only moves when :meth:`advance` is called, so lease TTLs expire
-    exactly at scripted round boundaries and tier-1 never sleeps."""
-
-    def __init__(self, start: float = 0.0):
-        self._now = float(start)
-
-    def __call__(self) -> float:
-        return self._now
-
-    def advance(self, seconds: float) -> float:
-        self._now += float(seconds)
-        return self._now
-
-
-class WallClock:
-    """Real-time clock for driving :func:`run_sweep_elastic` alongside
-    EXTERNAL worker processes (``sweep_cli --elastic coordinator``):
-    ``now`` is wall time and :meth:`advance` actually waits, so the
-    driver's lease arithmetic agrees with workers using ``time.time``.
-    Both seams are injectable — ``sleep=time.sleep`` here is a default-
-    arg REFERENCE, the sanctioned bdlz-lint R7 pattern."""
-
-    def __init__(self, time_fn=time.time, sleep=time.sleep):
-        self._time = time_fn
-        self._sleep = sleep
-
-    def __call__(self) -> float:
-        return float(self._time())
-
-    def advance(self, seconds: float) -> float:
-        self._sleep(float(seconds))
-        return float(self._time())
+# The injectable clocks grew up here; they now live in utils/clock.py
+# (the serving fabric shares them) and this is a compatibility re-export
+# so no call site or test import breaks.
+from bdlz_tpu.utils.clock import ManualClock, WallClock  # noqa: E402,F401
 
 
 @dataclass

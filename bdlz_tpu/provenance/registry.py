@@ -182,6 +182,72 @@ def fetch_artifact_with_retry(
     )
 
 
+class ArtifactCache:
+    """Local pull-through cache in front of :func:`fetch_artifact`.
+
+    Content addressing makes this trivial: an artifact's hash IS its
+    identity, so a locally cached copy can be fully re-validated on
+    every hit without talking to the shared store at all.  The cache is
+    itself a :class:`Store` (reusing ``publish_artifact`` /
+    ``fetch_artifact`` wholesale), so a local hit runs the exact same
+    validation chain a registry stage does — a *validated* hit, never a
+    trusted one.  A corrupt local entry is evicted loudly on the failing
+    hit (the registry's corrupt-entry path: stderr line +
+    ``dropped_corrupt``) and re-fetched from the shared store — the
+    cache can degrade availability, never poison an answer.
+
+    The serving fabric fronts every cold admission with one of these per
+    host: whole-host failover re-admits a dead host's tenants by hash,
+    so the second host to serve an artifact pays a local validated load
+    instead of a shared-store round trip.  ``counters()`` lands on
+    ``ServeStats.extras`` (the opt-in summary extension seam).
+    """
+
+    def __init__(self, root: str):
+        self.store = Store(root)
+        self.hits = 0
+        self.misses = 0
+
+    @property
+    def evictions(self) -> int:
+        """Corrupt local entries evicted (and re-fetched) so far."""
+        return self.store.stats.dropped_corrupt
+
+    def fetch(self, store: Store, content_hash: str, fault_plan=None,
+              retry=None):
+        """Fetch-by-hash through the cache: validated local hit, or
+        pull-through from ``store`` (under ``fault_plan``/``retry``
+        exactly as :func:`fetch_artifact_with_retry`) + local fill."""
+        from bdlz_tpu.emulator.artifact import EmulatorArtifactError
+
+        local = os.path.join(self.store.root, ARTIFACT_KIND,
+                             str(content_hash))
+        if os.path.isdir(local):
+            try:
+                artifact = fetch_artifact(self.store, content_hash)
+                self.hits += 1
+                return artifact
+            except EmulatorArtifactError:
+                # corrupt (already deleted + counted by fetch_artifact)
+                # or impersonating (delete here) — either way the local
+                # copy is gone and the shared store is authoritative
+                shutil.rmtree(local, ignore_errors=True)
+        artifact = fetch_artifact_with_retry(
+            store, content_hash, fault_plan=fault_plan, retry=retry,
+        )
+        publish_artifact(self.store, artifact)
+        self.misses += 1
+        return artifact
+
+    def counters(self) -> dict:
+        """Hit/miss/eviction counters (``ServeStats.extras`` payload)."""
+        return {
+            "hits": self.hits,
+            "misses": self.misses,
+            "corrupt_evictions": self.evictions,
+        }
+
+
 # ---- lease records (the elastic scheduler's claim plane) ----------------
 #
 # One small JSON record per (job, chunk) under ``lease/`` in the shared

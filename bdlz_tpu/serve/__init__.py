@@ -19,7 +19,13 @@
   registry fetch, load-driven autoscaling with hysteresis under a
   fleet-wide replica ceiling, memory-budget LRU eviction with loud
   ``"pool_evicted"`` degraded-exact answering, and typed
-  ``TenancyError`` cross-scenario skew rejection.
+  ``TenancyError`` cross-scenario skew rejection;
+* cross-host fabric (``fabric.py``) — TTL'd host-lease membership
+  through the shared provenance store, lease-fenced global routing
+  (``GlobalRouter``) with whole-host failover by content-hash cold
+  admission on survivors, loud ``"store_partition"`` degraded-exact
+  serving on a partitioned host, and idle-cycle elastic sweep chunk
+  stealing.
 
 The full typed-error surface exports here — ``QueueFull`` (admission),
 ``DeadlineExceeded`` (shedding), ``ServiceUnavailable`` (closed
@@ -36,6 +42,14 @@ from bdlz_tpu.serve.batcher import (  # noqa: F401
     QueueFull,
     ServiceUnavailable,
     drain_results,
+)
+from bdlz_tpu.serve.fabric import (  # noqa: F401
+    REASON_STORE_PARTITION,
+    FabricError,
+    FabricHost,
+    FabricPartitionError,
+    GlobalRouter,
+    ServingFabric,
 )
 from bdlz_tpu.serve.fleet import (  # noqa: F401
     FleetResponse,

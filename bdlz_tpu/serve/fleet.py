@@ -115,6 +115,12 @@ class FleetResponse(NamedTuple):
     #: response names its mode, so a consumer can assert it got the
     #: physics it asked for.
     lz_mode: Optional[str] = None
+    #: The fabric host that answered (docs/serving.md, cross-host
+    #: fabric) — after a failover the consumer can see WHICH host's
+    #: plane served it.  None on single-host services (trailing
+    #: optional field: the pre-fabric response schema, extended in
+    #: place, never forked).
+    host_id: Optional[str] = None
 
 
 class _Replica:
@@ -523,6 +529,7 @@ class FleetService:
         store=None,
         lz_profile=None,
         bounce=None,
+        host_id: Optional[str] = None,
     ):
         from bdlz_tpu.emulator.artifact import build_identity
         from bdlz_tpu.provenance import resolve_store
@@ -536,6 +543,11 @@ class FleetService:
         #: — stamped on every stats row and FleetResponse; the identity
         #: check above already rejects cross-mode artifact/static skew.
         self.lz_mode = artifact_lz_mode(artifact)
+        #: The cross-host fabric's host identity (None = single-host
+        #: service): stamped on every stats row and FleetResponse so
+        #: cross-host traces are attributable.  Orchestration-only —
+        #: never joins any result identity.
+        self.host_id = host_id
         lz_profile = resolve_service_profile(artifact, lz_profile, bounce)
         #: The exact-fallback error gate (shared resolution with
         #: YieldService — resolve_error_gate): None = membership-only.
@@ -1011,6 +1023,7 @@ class FleetService:
             artifact_hash=item.artifact_hash,
             replica=replica_index,
             lz_mode=self.lz_mode,
+            host_id=self.host_id,
         )
         # closed-loop traffic trace (no-op unless the refinement daemon
         # armed it): where the queries landed + why each fell back
@@ -1037,6 +1050,7 @@ class FleetService:
                     replica=replica_index,
                     fallback_reason=reason,
                     lz_mode=self.lz_mode,
+                    host_id=self.host_id,
                 ))
         if self._observer is not None:
             self._observer(now)
@@ -1135,6 +1149,7 @@ class FleetService:
             artifact_hash=replica_set.artifact_hash,
             replica=-1,
             lz_mode=self.lz_mode,
+            host_id=self.host_id,
         )
         self.stats.record_queries(thetas, REASON_DEGRADED)
         for p, v in zip(batch, values):
@@ -1155,6 +1170,7 @@ class FleetService:
                     fallback_reason=REASON_DEGRADED,
                     degraded=True,
                     lz_mode=self.lz_mode,
+                    host_id=self.host_id,
                 ))
         if self._observer is not None:
             self._observer(done)

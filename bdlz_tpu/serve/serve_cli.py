@@ -34,7 +34,8 @@ optional ``"id"`` is echoed back; an optional ``"lz_mode"`` states the
 physics scenario the caller expects and is rejected with a structured
 error when it disagrees with the artifact's mode (cross-mode skew,
 docs/scenarios.md).  Responses are JSON lines on stdout:
-``{"id", "value", "lz_mode", "fallback_reason", "latency_s"}`` in request order
+``{"id", "value", "lz_mode", "fallback_reason", "host_id", "latency_s"}``
+in request order
 (``fallback_reason`` is null when the emulator fast path answered,
 ``"ood"`` for a domain miss, ``"predicted_error"`` when the per-cell
 error gate routed the request to the exact path; ``latency_s`` is
@@ -62,7 +63,7 @@ from typing import Optional
 import numpy as np  # host-side use only; jitted paths go through the backend.py xp seam (bdlz-lint R1 audit)
 
 
-def _error_record(rid, exc, **extra) -> dict:
+def _error_record(rid, exc, host_id=None, **extra) -> dict:
     """One structured JSONL error record.  ``error_type`` is the class
     name; for the typed serve surface (``bdlz_tpu.serve`` exports:
     ``QueueFull``, ``DeadlineExceeded``, ``ServiceUnavailable``,
@@ -85,6 +86,8 @@ def _error_record(rid, exc, **extra) -> dict:
         "error": f"{name}: {exc}",
         "error_type": name,
         "typed_error": isinstance(exc, typed),
+        # cross-host attribution (--host-id; null on single-host runs)
+        "host_id": host_id,
         **extra,
     }
 
@@ -218,6 +221,11 @@ def main(argv: Optional[list] = None) -> int:
     add_bounce_flag(ap)
     ap.add_argument("--events", default=None,
                     help="JSON-lines event log path (default stderr)")
+    ap.add_argument("--host-id", default=None, dest="host_id",
+                    help="cross-host fabric host identity stamped on "
+                         "every answer/error record, stats row and "
+                         "response (docs/serving.md; default: none — "
+                         "records carry host_id null)")
     args = ap.parse_args(argv)
     _berr = bounce_flag_error(args)
     if _berr:
@@ -278,6 +286,7 @@ def main(argv: Optional[list] = None) -> int:
             health={"auto": None, "on": True, "off": False}[args.health],
             lz_profile=args.lz_profile,
             bounce=args.bounce,
+            host_id=args.host_id,
         )
         service = None
         from bdlz_tpu.refine import RefinementDaemon, resolve_self_improve
@@ -358,7 +367,9 @@ def main(argv: Optional[list] = None) -> int:
                 obj = json.loads(line)
             except Exception as exc:  # noqa: BLE001 — report per request
                 # unparseable line: no client id to echo back
-                print(json.dumps(_error_record(None, exc, line=ln)))
+                print(json.dumps(_error_record(
+                    None, exc, host_id=args.host_id, line=ln,
+                )))
                 continue
             rid = obj.get("id", ln) if isinstance(obj, dict) else ln
             front = fleet if fleet is not None else service
@@ -380,13 +391,15 @@ def main(argv: Optional[list] = None) -> int:
                         {k: v for k, v in obj.items() if k != "id"}
                     )
             except Exception as exc:  # noqa: BLE001 — report per request
-                print(json.dumps(_error_record(rid, exc, line=ln)))
+                print(json.dumps(_error_record(
+                    rid, exc, host_id=args.host_id, line=ln,
+                )))
                 continue
             if theta.shape != (len(artifact.axis_names),):
                 print(json.dumps(_error_record(rid, ValueError(
                     f"theta has {theta.size} coordinates, this "
                     f"artifact takes {len(artifact.axis_names)}"
-                ), line=ln)))
+                ), host_id=args.host_id, line=ln)))
                 continue
             requests.append((rid, theta))
     finally:
@@ -434,7 +447,7 @@ def main(argv: Optional[list] = None) -> int:
                 # per-request failures (DeadlineExceeded, a dead exact
                 # fallback) answer THIS line; the rest keep serving
                 print(json.dumps(_error_record(
-                    rid, exc,
+                    rid, exc, host_id=args.host_id,
                     latency_s=round(time.monotonic() - t0, 6),
                 )))
                 continue
@@ -445,6 +458,8 @@ def main(argv: Optional[list] = None) -> int:
                 # the physics scenario that answered (docs/scenarios.md)
                 "lz_mode": service.lz_mode,
                 "fallback_reason": answer.fallback_reason,
+                # cross-host attribution (--host-id; null single-host)
+                "host_id": args.host_id,
                 "latency_s": round(time.monotonic() - t0, 6),
             }))
     finally:
@@ -513,6 +528,7 @@ def _serve_tenant(args, ap, base, event_log) -> int:
         lz_profile=args.lz_profile,
         bounce=args.bounce,
         memory_budget_bytes=args.memory_budget,
+        host_id=args.host_id,
     )
     event_log.emit(
         "serve_start",
@@ -547,13 +563,14 @@ def _serve_tenant(args, ap, base, event_log) -> int:
                 obj = json.loads(line)
             except Exception as exc:  # noqa: BLE001 — report per request
                 print(json.dumps(_error_record(
-                    None, exc, line=ln, pool=None, scenario=None,
+                    None, exc, host_id=svc.host_id, line=ln, pool=None,
+                    scenario=None,
                 )))
                 continue
             if not isinstance(obj, dict):
                 print(json.dumps(_error_record(ln, ValueError(
                     "request line must be a JSON object"
-                ), line=ln, pool=None, scenario=None)))
+                ), host_id=svc.host_id, line=ln, pool=None, scenario=None)))
                 continue
             rid = obj.get("id", ln)
             scenario = obj.get("scenario")
@@ -579,7 +596,8 @@ def _serve_tenant(args, ap, base, event_log) -> int:
                     )
             except Exception as exc:  # noqa: BLE001 — report per request
                 print(json.dumps(_error_record(
-                    rid, exc, line=ln, pool=pool, scenario=scenario,
+                    rid, exc, host_id=svc.host_id, line=ln, pool=pool,
+                    scenario=scenario,
                 )))
                 continue
             fut.add_done_callback(_stamp(len(submitted), t0))
@@ -597,8 +615,8 @@ def _serve_tenant(args, ap, base, event_log) -> int:
                 resp = fut.result(timeout=0)
             except Exception as exc:  # noqa: BLE001 — report per request
                 print(json.dumps(_error_record(
-                    rid, exc, latency_s=latency, pool=None,
-                    scenario=scenario,
+                    rid, exc, host_id=svc.host_id, latency_s=latency,
+                    pool=None, scenario=scenario,
                 )))
                 continue
             n_ok += 1
@@ -618,6 +636,8 @@ def _serve_tenant(args, ap, base, event_log) -> int:
                 # loud degraded markers: every breaker open ("degraded")
                 # or the pool is memory-evicted ("pool_evicted")
                 "degraded": resp.degraded,
+                # cross-host attribution (--host-id; null single-host)
+                "host_id": resp.host_id,
                 "latency_s": latency,
             }))
     finally:
@@ -675,13 +695,17 @@ def _serve_requests_fleet(fleet, requests, daemon=None) -> int:
         daemon.step()
     for index, (rid, fut, err) in enumerate(submitted):
         if err is not None:
-            print(json.dumps(_error_record(rid, err, latency_s=0.0)))
+            print(json.dumps(_error_record(
+                rid, err, host_id=fleet.host_id, latency_s=0.0,
+            )))
             continue
         latency = round(resolved_at.get(index, 0.0), 6)
         try:
             resp = fut.result(timeout=0)
         except Exception as exc:  # noqa: BLE001 — report per request
-            print(json.dumps(_error_record(rid, exc, latency_s=latency)))
+            print(json.dumps(_error_record(
+                rid, exc, host_id=fleet.host_id, latency_s=latency,
+            )))
             continue
         n_ok += 1
         print(json.dumps({
@@ -701,6 +725,8 @@ def _serve_requests_fleet(fleet, requests, daemon=None) -> int:
             # loud degraded-mode marker (every breaker open, answered
             # by the exact pipeline — docs/robustness.md)
             "degraded": resp.degraded,
+            # cross-host attribution (--host-id; null single-host)
+            "host_id": resp.host_id,
             "latency_s": latency,
         }))
     return n_ok

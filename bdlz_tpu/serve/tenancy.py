@@ -251,6 +251,8 @@ class MultiTenantService:
         autoscale_interval_s: Optional[float] = None,
         pool_min_replicas: Optional[int] = None,
         replica_budget: Optional[int] = None,
+        host_id: Optional[str] = None,
+        artifact_cache=None,
     ):
         from bdlz_tpu.provenance import resolve_store
         from bdlz_tpu.serve.rollout import looks_like_content_hash
@@ -282,6 +284,16 @@ class MultiTenantService:
         #: The shared registry retry policy (cold admission + readmit
         #: fetches run under it — bounded deterministic backoff).
         self.registry_retry = resolve_engine_retry(retry, base)
+        #: The cross-host fabric's host identity (None = single-host
+        #: plane): stamped on every pool fleet's rows/responses and on
+        #: degraded answers, so cross-host traces are attributable.
+        self.host_id = host_id
+        #: Optional local pull-through :class:`ArtifactCache`
+        #: (provenance/registry.py): cold admission and readmit fetch
+        #: THROUGH it, so whole-host failover re-admits a dead host's
+        #: tenants from a validated local copy when one exists —
+        #: fetch-by-hash, never a rebuild.  None = direct store fetch.
+        self.artifact_cache = artifact_cache
 
         # ---- tenant map + routing policy ----------------------------
         self._tenant_map: Dict[str, str] = {}
@@ -558,10 +570,16 @@ class MultiTenantService:
         from bdlz_tpu.provenance import fetch_artifact_with_retry
 
         t0 = time.monotonic()
-        artifact = fetch_artifact_with_retry(
-            self._store, content_hash, fault_plan=self._faults,
-            retry=self.registry_retry,
-        )
+        if self.artifact_cache is not None:
+            artifact = self.artifact_cache.fetch(
+                self._store, content_hash, fault_plan=self._faults,
+                retry=self.registry_retry,
+            )
+        else:
+            artifact = fetch_artifact_with_retry(
+                self._store, content_hash, fault_plan=self._faults,
+                retry=self.registry_retry,
+            )
         mode = artifact_lz_mode(artifact)
         if scenario in VALID_LZ_MODES and scenario != mode:
             raise TenancyError(
@@ -597,6 +615,7 @@ class MultiTenantService:
             stats=pool.stats, warm=self._warm,
             error_gate_tol=self._error_gate_tol, health=self._health,
             store=self._store, lz_profile=profile, bounce=bounce,
+            host_id=self.host_id,
         )
         if self._warm:
             # the PR-9 re-provision probe: a full bucket at the hull's
@@ -639,6 +658,13 @@ class MultiTenantService:
                 "seconds": seconds,
                 "readmit": prior is not None,
             })
+        if self.artifact_cache is not None:
+            # host-wide pull-through counters, snapshotted at this
+            # pool's (re)admission — the extras seam keeps the summary
+            # schema byte-identical whenever no cache is armed
+            pool.stats.extras["artifact_cache"] = (
+                self.artifact_cache.counters()
+            )
         self._enforce_memory_budget(keep=pool)
         return pool
 
@@ -798,6 +824,7 @@ class MultiTenantService:
             artifact_hash=pool.artifact_hash,
             replica=-1,
             lz_mode=pool.lz_mode,
+            host_id=self.host_id,
         )
         pool.stats.record_queries(thetas, REASON_POOL_EVICTED)
         pool._batch_index += 1
@@ -819,6 +846,7 @@ class MultiTenantService:
                     fallback_reason=REASON_POOL_EVICTED,
                     degraded=True,
                     lz_mode=pool.lz_mode,
+                    host_id=self.host_id,
                 ))
         return b + len(expired)
 
@@ -975,7 +1003,7 @@ class MultiTenantService:
                 "admission_seconds": list(p.admission_seconds),
             })
             pools[content_hash] = s
-        return {
+        out = {
             "pools": pools,
             "tenant_routing": self.tenant_routing,
             "total_replicas": self.total_replicas(),
@@ -990,6 +1018,13 @@ class MultiTenantService:
             "autoscale_skipped": self.autoscale_skipped,
             "resizes": self.resizes,
         }
+        # fabric extensions — absent entirely when nothing armed them
+        # (the extras schema pin, service level)
+        if self.host_id is not None:
+            out["host_id"] = self.host_id
+        if self.artifact_cache is not None:
+            out["artifact_cache"] = self.artifact_cache.counters()
+        return out
 
 
 __all__ = [

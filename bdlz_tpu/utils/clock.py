@@ -1,0 +1,49 @@
+"""Injectable clocks (bdlz-lint R7) — the single home.
+
+``ManualClock``/``WallClock`` grew up inside the elastic scheduler
+(``parallel/scheduler.py``) and were shadowed by ad-hoc fake-clock twins
+in the serve tests; the cross-host fabric needs the same pair on the
+serving side, so they live here and the old homes re-export.  Every
+layer that waits (lease TTLs, autoscale intervals, host heartbeats)
+takes one of these — tier-1 tests never sleep.
+"""
+from __future__ import annotations
+
+import time
+
+
+class ManualClock:
+    """Deterministic injectable clock for in-process drivers/tests:
+    time only moves when :meth:`advance` is called, so lease TTLs expire
+    exactly at scripted round boundaries and tier-1 never sleeps."""
+
+    def __init__(self, start: float = 0.0):
+        self._now = float(start)
+
+    def __call__(self) -> float:
+        return self._now
+
+    def advance(self, seconds: float) -> float:
+        self._now += float(seconds)
+        return self._now
+
+
+class WallClock:
+    """Real-time clock for driving in-process control loops alongside
+    EXTERNAL worker processes (``sweep_cli --elastic coordinator``, the
+    multi-process serving fabric): ``now`` is wall time and
+    :meth:`advance` actually waits, so the driver's lease arithmetic
+    agrees with workers using ``time.time``.  Both seams are injectable
+    — ``sleep=time.sleep`` here is a default-arg REFERENCE, the
+    sanctioned bdlz-lint R7 pattern."""
+
+    def __init__(self, time_fn=time.time, sleep=time.sleep):
+        self._time = time_fn
+        self._sleep = sleep
+
+    def __call__(self) -> float:
+        return float(self._time())
+
+    def advance(self, seconds: float) -> float:
+        self._sleep(float(seconds))
+        return float(self._time())

@@ -99,6 +99,11 @@ class ServeBatch:
     #: read straight off the stats.  None only on rows recorded by a
     #: bare MicroBatcher with no service behind it.
     lz_mode: "str | None" = None
+    #: The fabric host that dispatched the batch (docs/serving.md,
+    #: cross-host fabric) — cross-host traces must be attributable to
+    #: the host that answered.  None on single-host services (the
+    #: pre-fabric row schema, extended in place, never forked).
+    host_id: "str | None" = None
 
 
 @dataclass

@@ -2334,6 +2334,234 @@ def main(argv=None) -> None:
         print(f"[bench] serve_multitenant metric unavailable: {exc}",
               file=sys.stderr)
 
+    # --- secondary metric: cross-host fabric availability under a ------
+    # whole-host kill (ISSUE-20).  A 2-host in-process ServingFabric
+    # serves one tenant off a shared store; a canned host_crash fault
+    # kills host 0 mid-trace.  The contract measured: queued requests on
+    # the corpse fail TYPED (never silent) and client retries re-answer
+    # through the submit ladder on the survivor, which cold-admits the
+    # tenant by content hash through its pull-through cache (a fetch,
+    # never a rebuild); availability must stay >= 0.99 and every
+    # unaffected answer must be bitwise-equal to a clean single-host
+    # fleet over the same thetas.
+    def serve_crosshost_metric(artifact):
+        import dataclasses
+        import tempfile
+
+        from bdlz_tpu.provenance import Store, publish_artifact
+        from bdlz_tpu.serve import (
+            FabricHost,
+            GlobalRouter,
+            ServiceUnavailable,
+            ServingFabric,
+        )
+        from bdlz_tpu.serve.fleet import FleetService
+        from bdlz_tpu.serve.tenancy import pool_base
+
+        xh_batch = int(os.environ.get("BDLZ_BENCH_XH_BATCH", 16))
+        xh_ticks = max(8, int(os.environ.get("BDLZ_BENCH_XH_TICKS", 12)))
+        xh_ttl = float(os.environ.get("BDLZ_BENCH_XH_TTL_S", 0.06))
+        kill_tick = max(2, xh_ticks // 3)
+
+        # the canned churn: host 0 dies at its kill_tick-th fabric tick
+        plan_obj = {"faults": [
+            {"site": "host_crash", "kind": "raise", "key": kill_tick},
+        ]}
+
+        class _Tick:
+            t = 0.0
+
+            def __call__(self):
+                return self.t
+
+        lo = np.array([nodes[0] for nodes in artifact.axis_nodes])
+        hi = np.array([nodes[-1] for nodes in artifact.axis_nodes])
+        rng = np.random.default_rng(29)
+        n_req = xh_ticks * xh_batch
+        thetas = rng.uniform(lo, hi, size=(n_req, len(lo)))
+
+        scfg = dataclasses.replace(base, error_gate_tol=False)
+        answered = {}
+        pending = []
+        retry = []
+        typed_losses = 0
+        untyped_losses = 0
+        t_crash = None
+        first_survivor_t = None
+
+        with tempfile.TemporaryDirectory() as xh_root:
+            store = Store(os.path.join(xh_root, "store"))
+            content_hash = publish_artifact(store, artifact)
+            tick = _Tick()
+            hosts = [
+                FabricHost(
+                    scfg, fabric="bench", host_id=f"h{i}", host_index=i,
+                    store=store, tenant_map={"coherent": content_hash},
+                    clock=tick, ttl_s=xh_ttl,
+                    cache_root=os.path.join(xh_root, f"cache{i}"),
+                    fault_plan=json.dumps(plan_obj) if i == 0 else None,
+                    max_batch_size=xh_batch, max_wait_s=1e-3,
+                    n_replicas=1,
+                )
+                for i in range(2)
+            ]
+            fab = ServingFabric(
+                hosts, GlobalRouter(store, "bench", 2, clock=tick)
+            )
+            fab.register_all()
+
+            def _submit(k):
+                try:
+                    pending.append(
+                        (k, fab.submit(thetas[k], scenario="coherent"))
+                    )
+                except ServiceUnavailable:
+                    retry.append(k)  # no live host this instant
+
+            def _collect():
+                nonlocal typed_losses, untyped_losses, first_survivor_t
+                still = []
+                for k, f in pending:
+                    if not f.done():
+                        still.append((k, f))
+                        continue
+                    try:
+                        resp = f.result(timeout=0)
+                    except ServiceUnavailable:
+                        # the whole availability story: loss is TYPED,
+                        # so the client can retry through the ladder
+                        typed_losses += 1
+                        retry.append(k)
+                    except Exception:  # noqa: BLE001 — silent-loss audit
+                        untyped_losses += 1
+                    else:
+                        answered[k] = resp
+                        if (
+                            resp.host_id == "h1"
+                            and t_crash is not None
+                            and first_survivor_t is None
+                        ):
+                            first_survivor_t = tick.t
+                pending[:] = still
+
+            t_trace = time.time()
+            cursor = 0
+            for t in range(xh_ticks):
+                resubmits, retry[:] = list(retry), []
+                for k in resubmits:
+                    _submit(k)
+                for k in range(cursor, cursor + xh_batch):
+                    _submit(k)
+                cursor += xh_batch
+                tick.t += 0.02
+                fab.tick()
+                if t_crash is None and not hosts[0].alive:
+                    t_crash = tick.t
+                _collect()
+            for _ in range(6):  # drain + retry rounds for the tail
+                fab.drain()
+                _collect()
+                if not retry and not pending:
+                    break
+                resubmits, retry[:] = list(retry), []
+                for k in resubmits:
+                    _submit(k)
+                tick.t += 0.02
+                fab.tick()
+            trace_seconds = time.time() - t_trace
+
+            availability = len(answered) / n_req
+            summary = fab.summary()
+            survivor_adm = list(hosts[1].service.admission_events)
+            survivor_cache = hosts[1].artifact_cache.counters()
+            by_host = {
+                hid: sum(1 for r in answered.values() if r.host_id == hid)
+                for hid in ("h0", "h1")
+            }
+            fab.close()
+
+            # the clean control fleet: same artifact, same config, no
+            # faults, one host — every answer must match bit-for-bit
+            rcfg = dataclasses.replace(
+                pool_base(scfg, artifact),
+                fault_plan=None, fault_injection=False,
+            )
+            ref = FleetService(
+                artifact, rcfg, max_batch_size=xh_batch, n_replicas=1,
+                max_wait_s=1e-3,
+            )
+            rfuts = [ref.submit(th) for th in thetas]
+            ref.drain()
+            ref_vals = np.array(
+                [f.result(timeout=0).value for f in rfuts]
+            )
+            ref.close()
+            got = np.array([
+                answered[k].value if k in answered else np.nan
+                for k in range(n_req)
+            ])
+            ok = np.array([k in answered for k in range(n_req)])
+            bitwise = bool(np.array_equal(got[ok], ref_vals[ok]))
+
+        failover_s = (
+            None if first_survivor_t is None or t_crash is None
+            else round(first_survivor_t - t_crash, 4)
+        )
+        payload = {
+            "metric": "serve_crosshost_availability",
+            "value": round(availability, 4),
+            "unit": "answered fraction on a 2-host fabric with host 0 "
+                    "killed at fabric tick %d (typed-loss client "
+                    "retries, fake-clock trace, batch %d)"
+                    % (kill_tick, xh_batch),
+            "n_requests": n_req,
+            "n_hosts": 2,
+            "kill_tick": kill_tick,
+            "host_lease_ttl_s": xh_ttl,
+            "typed_losses": typed_losses,
+            "untyped_losses": untyped_losses,
+            "failovers": summary["failovers"],
+            "failover_latency_s": failover_s,
+            "answered_by": by_host,
+            "survivor_admissions": len(survivor_adm),
+            "survivor_cache": survivor_cache,
+            "readmit_was_fetch": bool(
+                len(survivor_adm) == 1
+                and not survivor_adm[0]["readmit"]
+                and survivor_cache["misses"] == 1
+            ),
+            "bitwise_equal_unaffected": bitwise,
+            "fault_plan": plan_obj["faults"],
+            "wall_seconds": round(trace_seconds, 4),
+            "platform": jax.devices()[0].platform,
+            "tpu_unavailable": tpu_unavailable,
+        }
+        emit(payload)
+        return {
+            k: payload[k] for k in (
+                "value", "typed_losses", "untyped_losses", "failovers",
+                "failover_latency_s", "survivor_admissions",
+                "readmit_was_fetch", "bitwise_equal_unaffected",
+            )
+        }
+
+    crosshost_summary = None
+    try:
+        _xh_hit = leg_lookup("serve_crosshost")
+        if _xh_hit is not None:
+            crosshost_summary = _xh_hit.get("summary")
+        elif emu_artifact is None:
+            print("[bench] serve_crosshost skipped: no emulator artifact "
+                  "this round", file=sys.stderr)
+        else:
+            crosshost_summary = run_leg(
+                "serve_crosshost",
+                lambda: serve_crosshost_metric(emu_artifact),
+            )
+    except Exception as exc:  # noqa: BLE001 — secondary metric is best-effort
+        print(f"[bench] serve_crosshost metric unavailable: {exc}",
+              file=sys.stderr)
+
     # --- secondary metric: the closed-loop self-improving service ------
     # ROADMAP item 4's acceptance instrument (bdlz_tpu/refine/): a
     # deliberately NARROW seed emulator serves a replayed deterministic
@@ -2802,6 +3030,12 @@ def main(argv=None) -> None:
                 # vs single-tenant fleets; null = leg failed — its
                 # secondary line has the full detail)
                 "serve_multitenant": multitenant_summary,
+                # the cross-host serving fabric under a whole-host kill
+                # (availability with typed-loss client retries, failover
+                # latency, survivor fetch-not-rebuild readmission,
+                # bitwise pin vs a clean single-host fleet; null = leg
+                # failed — its secondary line has the full detail)
+                "serve_crosshost": crosshost_summary,
                 # the closed-loop self-improving service (ROADMAP item
                 # 4: traffic-drift detection → autonomous traffic-
                 # steered rebuild → auto-publish rollout; hour-1 vs

@@ -1,0 +1,24 @@
+#!/usr/bin/env bash
+# Tier-2 on-demand gate: every @pytest.mark.slow suite — the real-
+# subprocess / wall-clock tests tier-1 excludes via -m 'not slow'
+# (scripts/tier1.sh).  Run it before merging changes that touch the
+# cross-process protocols it covers:
+#
+#   tests/test_multihost.py   two-process jax.distributed fleets
+#   tests/test_elastic_mp.py  external elastic-worker churn (sweep_cli)
+#   tests/test_provenance.py  registry fetch-vs-evict race
+#   tests/test_fabric.py      2-process whole-host failover
+#   tests/test_bench.py       bench harness smoke + leg-cache replay
+#   ... plus any other slow-marked test pytest collects.
+#
+# Same interpreter pins as tier-1 so "slow green" means the same thing
+# on every machine.  Extra args pass through to pytest (e.g.
+# scripts/slow_suite.sh tests/test_fabric.py to run one suite).
+set -u
+cd "$(dirname "$0")/.."
+
+slow_budget_s=2400
+exec timeout -k 10 "$slow_budget_s" env JAX_PLATFORMS=cpu \
+    python -m pytest "${@:-tests/}" -q -m slow \
+    --continue-on-collection-errors -p no:cacheprovider \
+    -p no:xdist -p no:randomly --durations=10

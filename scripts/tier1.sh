@@ -5,9 +5,12 @@
 # pytest invocation below is byte-for-byte the ROADMAP.md "Tier-1 verify"
 # command (update both together).  The -m 'not slow' filter is what keeps
 # the real-subprocess suites (tests/test_multihost.py two-process fleets,
-# tests/test_elastic_mp.py elastic worker churn) out of the gate; their
-# fast single-process protocol coverage (lease expiry, commit verify,
-# in-process churn) runs here via tests/test_elastic.py.
+# tests/test_elastic_mp.py elastic worker churn, tests/test_fabric.py
+# 2-process host-kill failover) out of the gate; their fast
+# single-process protocol coverage (lease expiry, commit verify,
+# in-process churn, fabric failover on a fake clock) runs here, and
+# scripts/slow_suite.sh is the on-demand tier-2 gate that runs the
+# slow-marked suites themselves.
 set -u
 cd "$(dirname "$0")/.."
 

@@ -1,0 +1,134 @@
+"""Worker for the real 2-process whole-host failover test.
+
+Launched by ``tests/test_fabric.py::TestFabricMP`` as
+``python _mp_fabric_worker.py <role> <store_root> <content_hash>``
+with both roles sharing one trusted store root (the membership plane
+AND the artifact registry):
+
+* the **victim** registers fabric seat 0 on a SHORT (2 s) wall-clock
+  lease, cold-admits the tenant, answers a fixed request trace, prints
+  the values, and exits WITHOUT standing down — the real host-death
+  shape: its lease dangles until TTL expiry;
+* the **survivor** registers seat 1, heartbeats until the router's
+  live set no longer contains the victim (pure TTL arithmetic — no
+  channel to the corpse), then serves the SAME trace: the router must
+  pick the survivor, cold admission must be a validated fetch-by-hash
+  through its pull-through cache (one miss, zero rebuilds beyond the
+  fetch), and every value must be bitwise-equal to the victim's.
+
+Exit 0 with a JSON result line on stdout; any contract violation is a
+loud traceback + nonzero exit the parent test surfaces.
+"""
+import json
+import os
+import pathlib
+import sys
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+FABRIC = "mpfab"
+SCENARIO = "coherent"
+
+
+def _base():
+    from bdlz_tpu.config import config_from_dict
+
+    # the tiny_emulator fixture's base, verbatim
+    return config_from_dict({
+        "regime": "nonthermal",
+        "P_chi_to_B": 0.14925839040304145,
+        "source_shape_sigma_y": 9.0,
+        "incident_flux_scale": 1.07e-9,
+        "Y_chi_init": 4.90e-10,
+    })
+
+
+def _thetas():
+    import numpy as np
+
+    rng = np.random.default_rng(5)
+    return np.column_stack([
+        rng.uniform(0.92, 1.08, 4),    # m_chi_GeV
+        rng.uniform(92.0, 108.0, 4),   # T_p_GeV
+        rng.uniform(0.26, 0.34, 4),    # v_w
+    ])
+
+
+def _host(store, content_hash, role, index, ttl_s, cache_root=None):
+    from bdlz_tpu.serve import FabricHost
+
+    return FabricHost(
+        _base(), fabric=FABRIC, host_id=role, host_index=index,
+        store=store, tenant_map={SCENARIO: content_hash},
+        ttl_s=ttl_s, cache_root=cache_root, max_batch_size=4,
+    )
+
+
+def _serve_trace(host):
+    futs = [host.submit(t, scenario=SCENARIO) for t in _thetas()]
+    host.drain()
+    out = [f.result(timeout=0) for f in futs]
+    assert all(r.host_id == host.host_id for r in out), "host_id stamp"
+    assert all(not r.degraded for r in out), "clean serve degraded?"
+    return [r.value for r in out]
+
+
+def victim(store, content_hash):
+    host = _host(store, content_hash, "victim", 0, ttl_s=2.0)
+    host.register()
+    values = _serve_trace(host)
+    print(json.dumps({"values": values}))
+    sys.stdout.flush()
+    # host death: NO close(), NO lease release — the seat dangles
+    os._exit(0)
+
+
+def survivor(store, content_hash, cache_root):
+    from bdlz_tpu.serve import GlobalRouter
+
+    host = _host(
+        store, content_hash, "survivor", 1, ttl_s=30.0,
+        cache_root=cache_root,
+    )
+    router = GlobalRouter(store, FABRIC, 2)
+    host.register()
+    deadline = time.time() + 60.0
+    waited_out_victim = False
+    while time.time() < deadline:
+        host.heartbeat()
+        live = {r["host_id"] for r in router.live()}
+        if "victim" not in live:
+            waited_out_victim = True
+            break
+        time.sleep(0.1)
+    assert waited_out_victim, "victim's lease never expired"
+    routed = router.route(scenario=SCENARIO)
+    assert routed["host_id"] == "survivor", routed
+    values = _serve_trace(host)
+    print(json.dumps({
+        "values": values,
+        "admissions": len(host.service.admission_events),
+        "cache": host.artifact_cache.counters(),
+    }))
+    sys.stdout.flush()
+    host.close()
+
+
+def main():
+    from bdlz_tpu.provenance import Store
+
+    role, store_root, content_hash = sys.argv[1:4]
+    store = Store(store_root)
+    if role == "victim":
+        victim(store, content_hash)
+    elif role == "survivor":
+        survivor(store, content_hash, sys.argv[4])
+    else:
+        raise SystemExit(f"unknown role {role!r}")
+
+
+if __name__ == "__main__":
+    main()

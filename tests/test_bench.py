@@ -110,6 +110,9 @@ def test_bench_leg_cache_replays_cpu_round(tmp_path, jax_compile_cache):
         # with the evict→degrade→readmit trace still run end to end
         BDLZ_BENCH_MT_BATCH="8", BDLZ_BENCH_MT_TICKS="8",
         BDLZ_BENCH_MT_NY="200", BDLZ_BENCH_MT_GRID="2",
+        # tiny cross-host leg: the 2-host kill→failover→readmit trace
+        # still runs end to end
+        BDLZ_BENCH_XH_BATCH="8", BDLZ_BENCH_XH_TICKS="8",
         # tiny seam leg: the split/build/serve machinery still runs,
         # but no acceptance numbers are asserted on THIS test (replay
         # equality is)
@@ -205,6 +208,12 @@ def test_bench_cpu_smoke(jax_compile_cache):
         BDLZ_BENCH_MT_TICKS="8",
         BDLZ_BENCH_MT_NY="200",
         BDLZ_BENCH_MT_GRID="2",
+        # small serve_crosshost leg: a 2-host fabric with host 0
+        # killed mid-trace — the availability / typed-loss / failover
+        # / fetch-not-rebuild readmission acceptance asserts below pin
+        # this exact line
+        BDLZ_BENCH_XH_BATCH="8",
+        BDLZ_BENCH_XH_TICKS="8",
         # the seam_split leg at its ACCEPTANCE settings (rtol 1e-4,
         # full round budget): the >=10x fallback ratio and the <=1e-3
         # gated-agreement are asserted below on this exact line
@@ -290,6 +299,7 @@ def test_bench_cpu_smoke(jax_compile_cache):
             "serve_bench_queries_per_sec_per_chip",
             "chaos_serve_availability",
             "serve_multitenant_availability",
+            "serve_crosshost_availability",
             "grad_sweep_points_per_sec_per_chip",
             "bounce_profiles_per_sec_per_chip",
             "self_improve_gated_rate",
@@ -304,6 +314,7 @@ def test_bench_cpu_smoke(jax_compile_cache):
                            "seam_split_fallback_ratio",
                            "chaos_serve_availability",
                            "serve_multitenant_availability",
+                           "serve_crosshost_availability",
                            "nuts_ess_per_eval"):
             continue  # query/serving/sampler metrics, not sweep lines
         assert {"n_failed", "n_quarantined", "n_retries"} <= set(s), s["metric"]
@@ -397,6 +408,7 @@ def test_bench_cpu_smoke(jax_compile_cache):
                            "seam_split_fallback_ratio",
                            "chaos_serve_availability",
                            "serve_multitenant_availability",
+                           "serve_crosshost_availability",
                            "nuts_ess_per_eval"):
             continue
         assert {"cache_hits", "cache_misses"} <= set(s), s["metric"]
@@ -587,6 +599,42 @@ def test_bench_cpu_smoke(jax_compile_cache):
         "forced_evictions": mt["forced_evictions"],
         "autoscale_passes": mt["autoscale_passes"],
         "bitwise_equal_unaffected": mt["bitwise_equal_unaffected"],
+    }
+    # the serve_crosshost line (docs/serving.md "Cross-host fabric"):
+    # host 0 of a 2-host fabric killed mid-trace — queued work fails
+    # TYPED and client retries re-answer through the submit ladder on
+    # the survivor, which cold-admits the tenant from the registry by
+    # content hash (one pull-through cache miss, never a rebuild), with
+    # every answer bitwise-equal to a clean single-host fleet
+    xh = next(s for s in secondary
+              if s["metric"] == "serve_crosshost_availability")
+    assert {"value", "n_requests", "n_hosts", "kill_tick",
+            "host_lease_ttl_s", "typed_losses", "untyped_losses",
+            "failovers", "failover_latency_s", "answered_by",
+            "survivor_admissions", "survivor_cache", "readmit_was_fetch",
+            "bitwise_equal_unaffected", "fault_plan", "wall_seconds",
+            "platform", "tpu_unavailable"} <= set(xh)
+    assert xh["value"] >= 0.99
+    assert xh["n_hosts"] == 2
+    assert xh["untyped_losses"] == 0       # loss is TYPED or nothing
+    assert xh["typed_losses"] > 0          # the kill actually bit
+    assert xh["failovers"] >= 1            # the ladder actually walked
+    assert xh["failover_latency_s"] is not None
+    assert xh["answered_by"]["h0"] > 0 and xh["answered_by"]["h1"] > 0
+    assert xh["survivor_admissions"] == 1  # one cold admission, by hash
+    assert xh["readmit_was_fetch"] is True
+    assert xh["survivor_cache"]["misses"] == 1
+    assert xh["bitwise_equal_unaffected"] is True
+    assert {"site", "kind"} <= set(xh["fault_plan"][0])
+    assert d["serve_crosshost"] == {
+        "value": xh["value"],
+        "typed_losses": xh["typed_losses"],
+        "untyped_losses": xh["untyped_losses"],
+        "failovers": xh["failovers"],
+        "failover_latency_s": xh["failover_latency_s"],
+        "survivor_admissions": xh["survivor_admissions"],
+        "readmit_was_fetch": xh["readmit_was_fetch"],
+        "bitwise_equal_unaffected": xh["bitwise_equal_unaffected"],
     }
     # the self_improve line (ROADMAP item 4's acceptance, checked on the
     # line itself): after ONE autonomous traffic-steered rebuild+rollout

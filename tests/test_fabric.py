@@ -1,0 +1,612 @@
+"""Cross-host serving fabric (bdlz_tpu/serve/fabric.py + the host-lease
+hooks in parallel/multihost.py).
+
+Pins the ISSUE-20 acceptance contract on a fake clock, single-process:
+TTL'd host-lease membership (exclusive create, heartbeat extend,
+expired-seat steal with a generation bump, LIVE-seat identity collision
+refused, torn record reads as fenced and heals), lease-fenced routing
+(``heartbeat_loss`` — a live-but-silent host is fenced by TTL
+arithmetic alone), whole-host failover (a crashed host's in-flight and
+queued requests fail with typed ``ServiceUnavailable`` — never silent
+loss — the submit ladder re-routes to a survivor, and the survivor
+cold-admits the dead host's tenant from the registry by content hash
+through its pull-through cache: a validated fetch, never a rebuild,
+with answers bitwise-equal to the pre-crash host), partition-tolerant
+serving (``store_partition`` → bounded retry → loud degraded-exact
+answers reason ``"store_partition"`` → automatic rejoin when the store
+heals), idle-host elastic chunk stealing (results bitwise-equal to a
+serial ``run_sweep``; admission pressure stops the stealing within one
+tick), and the zero-overhead default-OFF pins for the three new fault
+sites.
+
+The real 2-process host-kill twin lives in ``tests/_mp_fabric_worker.py``
+under ``@pytest.mark.slow`` (tier-2, ``scripts/slow_suite.sh``).
+"""
+import dataclasses
+
+import numpy as np
+import pytest
+
+from bdlz_tpu.config import (
+    config_from_dict,
+    static_choices_from_config,
+    validate,
+)
+from bdlz_tpu.faults import VALID_SITES, FaultPlan
+from bdlz_tpu.parallel.multihost import (
+    host_lease_job,
+    publish_host_lease,
+    read_host_lease,
+)
+from bdlz_tpu.serve import (
+    REASON_STORE_PARTITION,
+    FabricError,
+    FabricHost,
+    GlobalRouter,
+    ServiceUnavailable,
+    ServingFabric,
+)
+from bdlz_tpu.utils.retry import RetryPolicy
+
+PHYS = {
+    "regime": "nonthermal",
+    "source_shape_sigma_y": 9.0,
+    "incident_flux_scale": 1.07e-9,
+    "Y_chi_init": 4.90e-10,
+}
+
+
+def _cfg(**kw):
+    return validate(config_from_dict({**PHYS, **kw}), backend="tpu")
+
+
+class _Tick:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def assert_bitwise(a, b, label):
+    a, b = np.asarray(a), np.asarray(b)
+    same = (a == b) | (np.isnan(a) & np.isnan(b))
+    assert same.all(), f"{label}: bit drift at {np.argwhere(~same)[:4]}"
+
+
+@pytest.fixture(scope="module")
+def fabric_plane(tmp_path_factory, jit_warmup):
+    """Two tiny published two-channel artifacts (distinct physics →
+    distinct hashes) in one shared store — the minimal two-tenant world
+    every fabric here routes over."""
+    from bdlz_tpu.emulator import AxisSpec, build_emulator
+    from bdlz_tpu.provenance import Store, publish_artifact
+
+    base = _cfg(P_chi_to_B=0.1)
+    base_b = _cfg(P_chi_to_B=0.2)
+    spec = {
+        "m_chi_GeV": AxisSpec(0.9, 1.1, 2, "log"),
+        "v_w": AxisSpec(0.25, 0.35, 3, "lin"),
+    }
+    kw = dict(rtol=1e-2, n_probe=4, n_holdout=8, max_rounds=1, n_y=400,
+              chunk_size=64, require_converged=False)
+    root = tmp_path_factory.mktemp("fabric")
+    art_a, _ = build_emulator(base, spec, out_dir=str(root / "a"), **kw)
+    art_b, _ = build_emulator(base_b, spec, out_dir=str(root / "b"), **kw)
+    store = Store(str(root / "store"))
+    h_a = publish_artifact(store, art_a)
+    h_b = publish_artifact(store, art_b)
+    return {
+        "base": base,
+        "store": store,
+        "tenant_map": {"coherent": h_a, "heavy": h_b},
+        "h_a": h_a,
+        "h_b": h_b,
+        "root": root,
+    }
+
+
+def _host(plane, clock, idx, *, fabric="fab", ttl_s=30.0, **kw):
+    kw.setdefault("max_batch_size", 4)
+    return FabricHost(
+        plane["base"], fabric=fabric, host_id=f"h{idx}", host_index=idx,
+        store=plane["store"], tenant_map=plane["tenant_map"],
+        clock=clock, ttl_s=ttl_s, **kw,
+    )
+
+
+def _fabric(plane, clock, n=2, *, fabric="fab", host_kw=None):
+    hosts = [
+        _host(plane, clock, i, fabric=fabric,
+              **(host_kw or {}).get(i, {}))
+        for i in range(n)
+    ]
+    router = GlobalRouter(plane["store"], fabric, n, clock=clock)
+    fab = ServingFabric(hosts, router)
+    fab.register_all()
+    return fab
+
+
+def _thetas(n, seed=5):
+    rng = np.random.default_rng(seed)
+    return np.column_stack([
+        rng.uniform(0.92, 1.08, n), rng.uniform(0.26, 0.34, n)
+    ])
+
+
+# ---------------------------------------------------------------------------
+# host-lease membership
+# ---------------------------------------------------------------------------
+
+class TestHostLeaseMembership:
+    def test_register_creates_ttl_lease(self, fabric_plane):
+        clock = _Tick()
+        fab = _fabric(fabric_plane, clock, n=1, fabric="memb")
+        try:
+            rec = read_host_lease(fabric_plane["store"], "memb", 0)
+            assert rec["host_id"] == "h0" and rec["fabric"] == "memb"
+            assert rec["expires_at"] == pytest.approx(30.0)
+            assert rec["pools"] == {}  # nothing admitted yet
+        finally:
+            fab.close()
+
+    def test_heartbeat_extends_and_advertises_pools(self, fabric_plane):
+        clock = _Tick()
+        fab = _fabric(fabric_plane, clock, n=1, fabric="adv")
+        try:
+            fut = fab.submit(_thetas(1)[0], scenario="coherent")
+            fab.drain()
+            assert fut.result(timeout=0).artifact_hash == fabric_plane["h_a"]
+            clock.t = 10.0
+            fab.tick()  # heartbeat refreshes expiry AND the pool ad
+            rec = read_host_lease(fabric_plane["store"], "adv", 0)
+            assert rec["expires_at"] == pytest.approx(40.0)
+            assert rec["pools"] == {"coherent": fabric_plane["h_a"]}
+            assert rec["capacity"]["n_pools"] == 1
+        finally:
+            fab.close()
+
+    def test_live_seat_collision_is_typed_refusal(self, fabric_plane):
+        clock = _Tick()
+        fab = _fabric(fabric_plane, clock, n=1, fabric="coll")
+        imposter = _host(fabric_plane, clock, 0, fabric="coll")
+        imposter.host_id = "imposter"  # same seat, different identity
+        try:
+            with pytest.raises(FabricError, match="collision"):
+                imposter.register()
+        finally:
+            fab.close()
+            imposter.close()
+
+    def test_expired_seat_stolen_with_generation_bump(self, fabric_plane):
+        clock = _Tick()
+        store = fabric_plane["store"]
+        old = {"schema": 1, "host_id": "dead", "host_index": 0,
+               "generation": 4, "expires_at": 5.0}
+        assert publish_host_lease(store, "steal", 0, old, clock=clock)
+        clock.t = 6.0  # past the old holder's TTL
+        new = {"schema": 1, "host_id": "fresh", "host_index": 0,
+               "generation": 0, "expires_at": 36.0}
+        assert publish_host_lease(store, "steal", 0, new, clock=clock)
+        rec = read_host_lease(store, "steal", 0)
+        # the replacement is visible to routers that cached the corpse
+        assert rec["host_id"] == "fresh" and rec["generation"] == 5
+
+    def test_torn_host_lease_fences_then_heals(self, fabric_plane):
+        from bdlz_tpu.provenance import lease_entry_name
+
+        clock = _Tick()
+        fab = _fabric(fabric_plane, clock, n=1, fabric="torn")
+        try:
+            store = fabric_plane["store"]
+            path = store.path_for(
+                lease_entry_name(host_lease_job("torn"), 0)
+            )
+            with open(path, "w", encoding="utf-8") as f:
+                f.write('{"host_id": "h')  # torn mid-write
+            # a torn record reads as a FENCED seat...
+            assert read_host_lease(store, "torn", 0) is None
+            assert fab.router.live() == []
+            # ...and the next successful heartbeat rewrites it whole
+            assert fab.hosts[0].heartbeat()
+            rec = read_host_lease(store, "torn", 0)
+            assert rec["host_id"] == "h0"
+            assert [r["host_id"] for r in fab.router.live()] == ["h0"]
+        finally:
+            fab.close()
+
+
+# ---------------------------------------------------------------------------
+# routing + fencing
+# ---------------------------------------------------------------------------
+
+class TestRouterFencing:
+    def test_route_prefers_scenario_advertiser(self, fabric_plane):
+        clock = _Tick()
+        fab = _fabric(fabric_plane, clock, n=2, fabric="pref")
+        try:
+            # warm "coherent" onto host1 by hand, then advertise it
+            fab.hosts[1].submit(_thetas(1)[0], scenario="coherent")
+            fab.hosts[1].drain()
+            fab.tick()
+            # host0 is less loaded (0 pools), but host1 ADVERTISES the
+            # scenario — affinity beats load
+            rec = fab.router.route(scenario="coherent")
+            assert rec["host_id"] == "h1"
+            # hash-tagged routing sees the same advertisement
+            rec = fab.router.route(artifact_hash=fabric_plane["h_a"])
+            assert rec["host_id"] == "h1"
+            # an unadvertised scenario falls back to least-loaded
+            assert fab.router.route(scenario="heavy")["host_id"] == "h0"
+        finally:
+            fab.close()
+
+    def test_heartbeat_loss_fences_live_but_silent_host(self, fabric_plane):
+        plan = FaultPlan.from_obj([
+            {"site": "heartbeat_loss", "kind": "raise", "chunk": 0},
+        ])
+        clock = _Tick()
+        fab = _fabric(fabric_plane, clock, n=2, fabric="hbl",
+                      host_kw={0: {"fault_plan": plan}})
+        try:
+            clock.t = 31.0  # past both registration TTLs
+            fab.tick()      # host1 extends; host0's heartbeat is eaten
+            sick = fab.hosts[0]
+            assert sick.alive and sick.heartbeats_lost == 1
+            assert not sick.partitioned  # silent loss, NOT a partition
+            # the host still answers — but the router must fence it on
+            # TTL arithmetic alone
+            assert [r["host_id"] for r in fab.router.live()] == ["h1"]
+            assert fab.router.route(scenario="coherent")["host_id"] == "h1"
+        finally:
+            fab.close()
+
+    def test_no_live_host_is_typed_refusal(self, fabric_plane):
+        clock = _Tick()
+        fab = _fabric(fabric_plane, clock, n=2, fabric="dead")
+        try:
+            clock.t = 100.0  # everyone's lease is ancient history
+            with pytest.raises(ServiceUnavailable, match="no live host"):
+                fab.router.route(scenario="coherent")
+        finally:
+            fab.close()
+
+
+# ---------------------------------------------------------------------------
+# whole-host failover
+# ---------------------------------------------------------------------------
+
+class TestFailover:
+    def test_crash_failover_readmit_roundtrip(self, fabric_plane,
+                                              tmp_path):
+        """THE acceptance pin: kill one of two hosts with queued work —
+        every queued future fails TYPED, the submit ladder re-routes to
+        the survivor while the corpse's lease is still unexpired, the
+        survivor cold-admits the tenant from the registry through its
+        pull-through cache (fetch-by-hash, never a rebuild), and its
+        answers are bitwise-equal to the dead host's."""
+        clock = _Tick()
+        fab = _fabric(fabric_plane, clock, n=2, fabric="fo", host_kw={
+            1: {"cache_root": str(tmp_path / "h1cache")},
+        })
+        try:
+            thetas = _thetas(4)
+            # ladder start: both hosts are empty, seat 0 wins the tie
+            futs = [fab.submit(t, scenario="coherent") for t in thetas]
+            fab.drain()
+            v0 = [f.result(timeout=0) for f in futs]
+            assert {r.host_id for r in v0} == {"h0"}
+            fab.tick()  # advertise host0's pool
+
+            # queued work dies TYPED at crash — never silent loss
+            doomed = [fab.submit(t, scenario="coherent") for t in thetas]
+            failed = fab.hosts[0].crash()
+            assert failed == len(doomed)
+            for f in doomed:
+                with pytest.raises(ServiceUnavailable):
+                    f.result(timeout=0)
+
+            # the corpse's lease has NOT expired: routing still points
+            # at it, and the ladder walks to the survivor
+            assert fab.router.route(
+                scenario="coherent")["host_id"] == "h0"
+            refuts = [fab.submit(t, scenario="coherent") for t in thetas]
+            assert fab.failovers >= 1
+            fab.drain()
+            v1 = [f.result(timeout=0) for f in refuts]
+            assert {r.host_id for r in v1} == {"h1"}
+            assert all(not r.degraded for r in v1)
+
+            # bitwise-identical answers on the survivor
+            assert_bitwise([r.value for r in v1],
+                           [r.value for r in v0], "failover values")
+
+            # readmission was a validated FETCH, not a rebuild: one
+            # admission event, one cache miss (pull-through fill)
+            ev = fab.hosts[1].service.admission_events
+            assert len(ev) == 1 and not ev[0]["readmit"]
+            assert fab.hosts[1].artifact_cache.counters() == {
+                "hits": 0, "misses": 1, "corrupt_evictions": 0,
+            }
+            pool = fab.hosts[1].service.pool("coherent")
+            assert pool.stats.extras["artifact_cache"]["misses"] == 1
+            assert pool.stats.as_rows()[-1]["host_id"] == "h1"
+
+            # after TTL expiry the corpse is fenced outright
+            clock.t = 62.0
+            fab.tick()
+            assert [r["host_id"] for r in fab.router.live()] == ["h1"]
+        finally:
+            fab.close()
+
+    def test_injected_host_crash_site(self, fabric_plane):
+        plan = FaultPlan.from_obj([
+            {"site": "host_crash", "kind": "raise", "chunk": 0},
+        ])
+        clock = _Tick()
+        fab = _fabric(fabric_plane, clock, n=2, fabric="hc",
+                      host_kw={0: {"fault_plan": plan}})
+        try:
+            fut = fab.hosts[0].submit(_thetas(1)[0], scenario="coherent")
+            fab.tick()  # host0 dies AT the tick; host1 unaffected
+            assert not fab.hosts[0].alive and fab.hosts[1].alive
+            with pytest.raises(ServiceUnavailable):
+                fut.result(timeout=0)
+            # dead host refuses synchronously (the ladder's signal)
+            with pytest.raises(ServiceUnavailable, match="dead"):
+                fab.hosts[0].submit(_thetas(1)[0], scenario="coherent")
+        finally:
+            fab.close()
+
+
+# ---------------------------------------------------------------------------
+# store partition → degraded-exact → rejoin
+# ---------------------------------------------------------------------------
+
+class TestStorePartition:
+    def test_partition_degrades_exact_then_rejoins(self, fabric_plane):
+        # register() is store call 0; the first heartbeat's bounded
+        # retry burns calls 1,2,3 — all partitioned — then the store
+        # heals and call 4 lands
+        plan = FaultPlan.from_obj([
+            {"site": "store_partition", "kind": "raise", "chunk": k}
+            for k in (1, 2, 3)
+        ])
+        clock = _Tick()
+        fab = _fabric(fabric_plane, clock, n=1, fabric="part",
+                      host_kw={0: {"fault_plan": plan,
+                                   "partition_retries": 3}})
+        host = fab.hosts[0]
+        try:
+            futs = [fab.submit(t, scenario="coherent")
+                    for t in _thetas(4)]
+            host.drain()
+            clean = [f.result(timeout=0) for f in futs]
+            assert all(not r.degraded for r in clean)
+
+            assert not host.heartbeat()  # retries exhausted
+            assert host.partitioned
+
+            # admitted tenant: LOUD degraded-exact, not stale-routed
+            f = host.submit(_thetas(1)[0], scenario="coherent")
+            r = f.result(timeout=0)
+            assert r.degraded and r.replica == -1
+            assert r.fallback_reason == REASON_STORE_PARTITION
+            assert r.host_id == "h0" and np.isfinite(r.value)
+            row = host.service.pool("coherent").stats.as_rows()[-1]
+            assert row["replica"] == -1 and row["host_id"] == "h0"
+
+            # un-admitted tenant needs the unreachable registry: typed
+            with pytest.raises(ServiceUnavailable, match="partitioned"):
+                host.submit(_thetas(1)[0], scenario="heavy").result(
+                    timeout=0)
+
+            # rejoin is automatic: the next heartbeat lands and serving
+            # returns to the fast path
+            assert host.heartbeat() and not host.partitioned
+            f = fab.submit(_thetas(1)[0], scenario="coherent")
+            host.drain()
+            assert not f.result(timeout=0).degraded
+            assert host.degraded_partition_answers == 1
+        finally:
+            fab.close()
+
+
+# ---------------------------------------------------------------------------
+# idle-host elastic chunk stealing
+# ---------------------------------------------------------------------------
+
+SWEEP_AXES = {"m_chi_GeV": [0.5, 1.0, 2.0], "T_p_GeV": [80.0, 150.0]}
+SWEEP_CHUNK = 2
+SWEEP_N_Y = 200
+
+
+def _retry():
+    return RetryPolicy(max_attempts=2, backoff_s=0.0, sleep=lambda s: None)
+
+
+class TestChunkStealing:
+    def test_idle_host_drains_queue_bitwise(self, fabric_plane):
+        """An idle host steals the whole elastic queue; a later elastic
+        fold is 100% warm and bitwise-equal to serial run_sweep — and
+        admission pressure stops the stealing within one tick."""
+        from bdlz_tpu.parallel.scheduler import (
+            LeasePlane,
+            ensure_job_record,
+            plan_elastic_sweep,
+            run_sweep_elastic,
+        )
+        from bdlz_tpu.parallel.sweep import run_sweep
+
+        base = fabric_plane["base"]
+        static = static_choices_from_config(base)
+        serial = run_sweep(
+            base, SWEEP_AXES, static, mesh=None, chunk_size=SWEEP_CHUNK,
+            n_y=SWEEP_N_Y, retry=_retry(),
+        )
+
+        clock = _Tick()
+        fab = _fabric(fabric_plane, clock, n=1, fabric="chunks")
+        host = fab.hosts[0]
+        try:
+            plan = plan_elastic_sweep(
+                base, SWEEP_AXES, static, chunk_size=SWEEP_CHUNK,
+                n_y=SWEEP_N_Y, retry=_retry(),
+            )
+            store = fabric_plane["store"]
+            ensure_job_record(store, plan)
+            leases = LeasePlane(
+                store, plan.job, plan.n_chunks, ttl_s=60.0, clock=clock,
+            )
+            host.attach_sweep(plan, leases)
+
+            # one idle tick = one stolen chunk (steal_chunks_per_tick=1)
+            fab.tick()
+            assert host.chunks_stolen == 1
+
+            # admission pressure RELEASES the queue: a queued request
+            # makes the host non-idle, and the stealing pass yields
+            fut = fab.submit(_thetas(1)[0], scenario="coherent")
+            assert not host.serving_idle()
+            assert host._maybe_steal_chunks() == 0
+            host.drain()
+            assert fut.result(timeout=0).artifact_hash == (
+                fabric_plane["h_a"]
+            )
+
+            # idle again: the remaining chunks drain through the ticks
+            for _ in range(plan.n_chunks):
+                fab.tick()
+            assert host.chunks_stolen == plan.n_chunks
+            assert all(
+                leases.state(ci) == "done" for ci in range(plan.n_chunks)
+            )
+            assert fab.summary()["hosts"][0]["chunks_stolen"] == (
+                plan.n_chunks
+            )
+
+            # the committed chunks ARE the sweep: a coordinator folds
+            # them 100% warm, bitwise-equal to serial
+            res = run_sweep_elastic(
+                base, SWEEP_AXES, static, store=store,
+                chunk_size=SWEEP_CHUNK, n_y=SWEEP_N_Y, retry=_retry(),
+            )
+            assert res.cache_hits == plan.n_chunks
+            assert res.cache_misses == 0
+            for f in serial.outputs:
+                assert_bitwise(res.outputs[f], serial.outputs[f], f)
+        finally:
+            fab.close()
+
+
+# ---------------------------------------------------------------------------
+# real 2-process host-kill failover (tier-2: scripts/slow_suite.sh)
+# ---------------------------------------------------------------------------
+
+class TestFabricMP:
+    """Whole-host failover across REAL OS processes: a victim host on a
+    short wall-clock lease serves a trace and dies without standing
+    down; a survivor waits out the dangling lease by TTL arithmetic
+    alone, wins the routing, cold-admits the tenant by content hash
+    (one pull-through cache miss — a fetch, never a rebuild), and
+    answers the same trace bitwise-identically."""
+
+    @pytest.mark.slow
+    def test_host_kill_failover_across_processes(self, tmp_path,
+                                                 tiny_emulator):
+        import json
+        import os
+        import subprocess
+        import sys
+
+        from bdlz_tpu.provenance import Store, publish_artifact
+
+        _, _, art, _ = tiny_emulator
+        shared = str(tmp_path / "shared")
+        h = publish_artifact(Store(shared), art)
+        worker = os.path.join(os.path.dirname(__file__),
+                              "_mp_fabric_worker.py")
+
+        def _run(args):
+            p = subprocess.run(
+                [sys.executable, worker, *args],
+                capture_output=True, text=True, timeout=300,
+            )
+            assert p.returncode == 0, (
+                f"{args[0]} violated the fabric contract:\n"
+                f"{p.stdout}\n{p.stderr}"
+            )
+            return json.loads(p.stdout.strip().splitlines()[-1])
+
+        v = _run(["victim", shared, h])
+        s = _run(["survivor", shared, h, str(tmp_path / "cache")])
+        assert_bitwise(s["values"], v["values"], "survivor values")
+        assert s["admissions"] == 1
+        assert s["cache"] == {
+            "hits": 0, "misses": 1, "corrupt_evictions": 0,
+        }
+
+
+# ---------------------------------------------------------------------------
+# fault-site + schema pins (zero-overhead, default OFF)
+# ---------------------------------------------------------------------------
+
+class TestFabricPins:
+    def test_new_sites_registered(self):
+        assert VALID_SITES[-3:] == (
+            "host_crash", "heartbeat_loss", "store_partition",
+        )
+
+    def test_sites_default_off_zero_overhead(self, fabric_plane):
+        # no plan armed → the hooks are never consulted and the served
+        # surface is byte-identical to the pre-fabric plane
+        clock = _Tick()
+        fab = _fabric(fabric_plane, clock, n=1, fabric="off")
+        host = fab.hosts[0]
+        try:
+            assert host._faults is None
+            fut = fab.submit(_thetas(1)[0], scenario="coherent")
+            host.drain()
+            r = fut.result(timeout=0)
+            assert not r.degraded and r.fallback_reason is None
+            assert r.host_id == "h0"
+            s = host.summary()
+            assert s["alive"] and not s["partitioned"]
+            assert s["heartbeats_lost"] == 0
+            assert s["degraded_partition_answers"] == 0
+            assert s["service"]["host_id"] == "h0"
+        finally:
+            fab.close()
+
+    def test_artifact_cache_pull_through(self, fabric_plane, tmp_path,
+                                         capsys):
+        """The satellite contract: second fetch of the same hash is a
+        validated LOCAL hit; a corrupt local entry evicts loudly and
+        pull-through refills it."""
+        import os
+
+        from bdlz_tpu.provenance import ArtifactCache
+
+        cache = ArtifactCache(str(tmp_path / "pull"))
+        store, h = fabric_plane["store"], fabric_plane["h_a"]
+        art = cache.fetch(store, h)
+        assert art.content_hash == h
+        assert cache.counters() == {
+            "hits": 0, "misses": 1, "corrupt_evictions": 0,
+        }
+        assert cache.fetch(store, h).content_hash == h
+        assert cache.counters()["hits"] == 1  # local, validated
+
+        npz = os.path.join(
+            cache.store.root, "emulator_artifact", h, "artifact.npz"
+        )
+        with open(npz, "wb") as f:
+            f.write(b"bitrot")
+        art = cache.fetch(store, h)  # evict loudly, refetch, refill
+        assert art.content_hash == h
+        assert "corrupt" in capsys.readouterr().err
+        assert cache.counters() == {
+            "hits": 1, "misses": 2, "corrupt_evictions": 1,
+        }
+        assert cache.fetch(store, h).content_hash == h
+        assert cache.counters()["hits"] == 2

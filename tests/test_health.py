@@ -81,6 +81,9 @@ PRE_HEALTH_ROW_KEYS = (
     # every row for BOTH health states — a schema extension, not health
     # overhead, so it belongs in the frozen baseline
     "lz_mode",
+    # the cross-host fabric (docs/serving.md) stamps host identity the
+    # same way — trailing-optional (None off-fabric), both health states
+    "host_id",
 )
 
 
